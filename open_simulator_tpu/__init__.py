@@ -1,0 +1,24 @@
+"""open-simulator-tpu: a TPU-native Kubernetes cluster-capacity simulator.
+
+A ground-up re-design of Open-Simulator ("simon") for TPU hardware:
+cluster state lives in dense device arrays, the kube-scheduler's
+Filter/Score plugin pipeline is expressed as pod x node tensor ops, the
+sequential bind loop is a `lax.scan`, and capacity planning (the add-node
+search) is a vmapped sweep sharded over a `jax.sharding.Mesh`.
+
+Layer map (mirrors SURVEY.md section 1, re-expressed TPU-first):
+
+  L0  state store        -> encode/ : dense SoA snapshot arrays (was: fake clientset)
+  L1  event fabric       -> (gone)  : dataflow-pure scan carry (was: informers/watch)
+  L2  scheduling engine  -> engine/ : lax.scan over pods; ops/ filter+score tensor ops
+  L3  simulator core     -> core.py : simulate() facade
+  L3b workload expansion -> models/ : fake controller-manager (pure host python)
+  L4  capacity planner   -> apply/  : batched node-count sweep (was: interactive loop)
+  L5  REST server        -> server/
+  L6  CLI                -> cli/
+  aux GPU-share          -> ops/gpu_share.py (per-device [N,G] memory arrays)
+  aux queue ordering     -> engine/queue.py (greed / affinity / toleration sorts)
+  aux chart renderer     -> chart/
+"""
+
+__version__ = "0.1.0"

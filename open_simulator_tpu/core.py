@@ -1,0 +1,162 @@
+"""simulate(): the one-call library API.
+
+The analog of the reference's Simulate facade (pkg/simulator/core.go:75-131):
+build the cluster, expand workloads, schedule everything, report. The
+entire reference pipeline of fake clientset + informers + scheduler
+goroutine + channel handshake collapses into: encode -> scan -> decode.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from open_simulator_tpu.encode.snapshot import ClusterSnapshot, EncodeOptions, encode_cluster
+from open_simulator_tpu.engine.queue import sort_pods_greedy
+from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
+from open_simulator_tpu.k8s.loader import ClusterResources, make_valid_node
+from open_simulator_tpu.k8s.objects import Node, Pod
+from open_simulator_tpu.models.expand import expand_app_resources, expand_cluster_pods
+
+
+@dataclass
+class AppResource:
+    """One app to deploy, in order (reference: core.go:62-65)."""
+
+    name: str
+    resources: ClusterResources
+
+
+@dataclass
+class UnscheduledPod:
+    pod: Pod
+    reason: str
+
+
+@dataclass
+class ScheduledPod:
+    pod: Pod
+    node_name: str
+
+
+@dataclass
+class NodeStatus:
+    node: Node
+    pods: List[Pod] = field(default_factory=list)
+
+
+@dataclass
+class SimulateResult:
+    """reference: core.go:20-44."""
+
+    unscheduled_pods: List[UnscheduledPod]
+    scheduled_pods: List[ScheduledPod]
+    node_status: List[NodeStatus]
+    elapsed_s: float = 0.0
+    snapshot: Optional[ClusterSnapshot] = None
+
+    def placements(self) -> Dict[str, str]:
+        return {sp.pod.key: sp.node_name for sp in self.scheduled_pods}
+
+
+def format_failure_reason(counts: np.ndarray, op_names: List[str], n_active: int) -> str:
+    """Reproduce the scheduler's diagnostic line
+    ('0/4 nodes are available: 3 Insufficient cpu, 1 node(s) had taint ...')."""
+    parts = [
+        f"{int(c)} {op_names[i]}"
+        for i, c in enumerate(counts)
+        if int(c) > 0 and i < len(op_names)
+    ]
+    return f"0/{n_active} nodes are available: " + ", ".join(parts) + "."
+
+
+def decode_result(
+    snapshot: ClusterSnapshot,
+    node_assign: np.ndarray,
+    fail_counts: np.ndarray,
+    active: np.ndarray,
+    elapsed_s: float = 0.0,
+) -> SimulateResult:
+    n_active = int(np.sum(active))
+    scheduled: List[ScheduledPod] = []
+    unscheduled: List[UnscheduledPod] = []
+    pods_by_node: Dict[int, List[Pod]] = {}
+    forced = snapshot.arrays.forced_node
+    for i, pod in enumerate(snapshot.pods):
+        ni = int(node_assign[i])
+        if ni >= 0:
+            scheduled.append(ScheduledPod(pod=pod, node_name=snapshot.node_names[ni]))
+            pods_by_node.setdefault(ni, []).append(pod)
+        else:
+            if int(forced[i]) == -2:  # nodeName pointed at a node that doesn't exist
+                reason = f'node "{pod.node_name}" not found'
+            else:
+                reason = format_failure_reason(fail_counts[i], snapshot.op_names, n_active)
+            unscheduled.append(UnscheduledPod(pod=pod, reason=reason))
+    node_status = [
+        NodeStatus(node=snapshot.nodes[ni], pods=pods_by_node.get(ni, []))
+        for ni in range(snapshot.n_nodes)
+        if active[ni]
+    ]
+    return SimulateResult(
+        unscheduled_pods=unscheduled,
+        scheduled_pods=scheduled,
+        node_status=node_status,
+        elapsed_s=elapsed_s,
+        snapshot=snapshot,
+    )
+
+
+def build_pod_sequence(
+    cluster: ClusterResources,
+    apps: List[AppResource],
+    use_greed: bool = False,
+) -> List[Pod]:
+    """Cluster pods first (placed + pending), then each app in config order
+    (reference: core.go:93-131). --use-greed sorts each app's pods by
+    descending dominant share (the reference parses but never wires this
+    flag; here it works)."""
+    nodes = cluster.nodes
+    pods = expand_cluster_pods(cluster)
+    totals: Dict[str, int] = {}
+    for n in nodes:
+        for r, v in n.allocatable.items():
+            totals[r] = totals.get(r, 0) + v
+    for app in apps:
+        app_pods = expand_app_resources(app.resources, nodes, app.name)
+        if use_greed:
+            app_pods = sort_pods_greedy(app_pods, totals)
+        pods.extend(app_pods)
+    return pods
+
+
+def simulate(
+    cluster: ClusterResources,
+    apps: List[AppResource],
+    use_greed: bool = False,
+    encode_options: Optional[EncodeOptions] = None,
+) -> SimulateResult:
+    """Run one full simulation on the default device (TPU when present)."""
+    t0 = time.perf_counter()
+    nodes = [make_valid_node(n) for n in cluster.nodes]
+    cluster = _with_nodes(cluster, nodes)
+    pods = build_pod_sequence(cluster, apps, use_greed=use_greed)
+    snapshot = encode_cluster(nodes, pods, encode_options)
+    cfg = make_config(snapshot)
+    arrs = device_arrays(snapshot)
+    out = schedule_pods(arrs, arrs.active, cfg)
+    node_assign = np.asarray(out.node)
+    fail_counts = np.asarray(out.fail_counts)
+    elapsed = time.perf_counter() - t0
+    return decode_result(snapshot, node_assign, fail_counts, np.asarray(arrs.active), elapsed)
+
+
+def _with_nodes(cluster: ClusterResources, nodes: List[Node]) -> ClusterResources:
+    import copy
+
+    out = copy.copy(cluster)
+    out.nodes = nodes
+    return out

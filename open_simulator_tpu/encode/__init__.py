@@ -1,0 +1,39 @@
+"""Snapshot encoder: typed k8s objects -> dense device arrays.
+
+This layer replaces the reference's fake clientset + informer fabric
+(SURVEY.md L0/L1): instead of an in-memory object store that the scheduler
+queries per pod, the *entire* cluster is encoded once into
+structure-of-arrays form, and every scheduling predicate becomes a tensor
+op over those arrays.
+
+Key design moves (TPU-first, not a translation):
+
+* **Compat classes.** All *static* pod-vs-node predicates (nodeName,
+  nodeSelector, required node affinity, taints vs tolerations,
+  unschedulable) are deduplicated host-side: pods sharing the same
+  (selector, affinity, tolerations) signature form one class, and a single
+  ``[C, N]`` boolean matrix is computed once. The scan step gathers one
+  ``[N]`` row per pod — no ``[P, N]`` materialization, no ragged predicate
+  trees on device.
+
+* **Selector groups.** Every distinct label selector mentioned by any
+  pod-affinity / anti-affinity / topology-spread constraint becomes a
+  column in a ``[N, S]`` occupancy-count carry; "pods matching selector s
+  in topology domain d" is then a one-hot matmul, which is exactly the
+  shape the MXU wants.
+
+* **Topology one-hots.** Non-hostname topology keys (zone, region, ...)
+  get a ``[K-1, N, D]`` one-hot domain encoding; the hostname key is the
+  identity and is special-cased (domains == nodes).
+
+* **Anti-affinity term registry.** Each distinct required anti-affinity
+  term (selector x topology-key) of any pod is a column of a ``[N, T]``
+  "blocked domains" carry, so the reverse direction of anti-affinity
+  (existing pods rejecting the incoming pod) is one mat-vec per step.
+"""
+
+from open_simulator_tpu.encode.snapshot import (
+    ClusterSnapshot,
+    EncodeOptions,
+    encode_cluster,
+)

@@ -1,0 +1,500 @@
+"""Cluster snapshot encoding: typed objects -> structure-of-arrays.
+
+See package docstring for the design. Everything here is host-side numpy;
+the engine converts to device arrays once per simulation.
+
+Reference parity notes: this layer subsumes the reference's fake clientset
+sync (pkg/simulator/simulator.go:366-448 syncClusterResourceList) and the
+scheduler cache snapshot (vendor/.../internal/cache/snapshot.go) — both
+become "build dense arrays once".
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import chex
+import numpy as np
+
+from open_simulator_tpu.k8s import objects as k8s
+from open_simulator_tpu.k8s.loader import new_fake_nodes
+from open_simulator_tpu.k8s.objects import LabelSelector, Node, Pod
+from open_simulator_tpu.k8s.selectors import (
+    intolerable_prefer_taints,
+    labels_match_selector,
+    preferred_node_affinity_score,
+    required_node_affinity_match,
+    tolerates_taints,
+)
+
+HOSTNAME_KEY = "kubernetes.io/hostname"
+
+# Filter-op order mirrors the vendored filter plugin execution order
+# (vendor/.../apis/config/v1beta2/default_plugins.go:30-100); reason
+# messages mirror the scheduler's diagnostic strings.
+OP_UNSCHEDULABLE = 0
+OP_NODE_AFFINITY = 1
+OP_TAINT = 2
+OP_PORTS = 3
+OP_FIT_BASE = 4  # one slot per resource follows
+
+
+def filter_op_table(resources: Sequence[str]) -> List[str]:
+    ops = [
+        "node(s) were unschedulable",
+        "node(s) didn't match Pod's node affinity/selector",
+        "node(s) had taint that the pod didn't tolerate",
+        "node(s) didn't have free ports for the requested pod ports",
+    ]
+    ops += [f"Insufficient {r}" for r in resources]
+    ops += [
+        "node(s) didn't match pod affinity rules",
+        "node(s) didn't match pod anti-affinity rules",
+        "node(s) didn't match pod topology spread constraints",
+        "Insufficient GPU memory in one or more devices",
+    ]
+    return ops
+
+
+@dataclass
+class EncodeOptions:
+    max_new_nodes: int = 0  # extra padded node slots cloned from the template
+    new_node_template: Optional[Node] = None
+    max_gpus_per_node: int = 8
+    # Upper bound on distinct non-hostname topology domains (zones etc.).
+    # Raised automatically if the cluster has more.
+    min_domain_pad: int = 4
+
+
+@chex.dataclass(frozen=True)
+class SnapshotArrays:
+    """Dense arrays (a jax pytree); all shapes static. Axis glossary:
+    N nodes, R resources, C compat classes, K topo keys (0=hostname),
+    K1=K-1 non-hostname keys, D domains, S selector groups, T anti-affinity
+    terms, Pt host ports, A/B required (anti-)affinity terms per pod,
+    Cs spread constraints per pod, Ap preferred terms per pod, G gpus."""
+
+    # node axis
+    alloc: np.ndarray          # [N, R] f32
+    active: np.ndarray         # [N] bool  (default activation; sweeps override)
+    is_new_node: np.ndarray    # [N] bool
+    topo_onehot: np.ndarray    # [K1, N, D] f32
+    has_key: np.ndarray        # [K, N] f32
+    gpu_cap_mem: np.ndarray    # [N] f32   per-device memory capacity
+    gpu_count: np.ndarray      # [N] f32
+    gpu_slot: np.ndarray       # [N, G] f32  1.0 for real device slots
+    # compat classes
+    class_affinity: np.ndarray  # [C, N] bool  nodeSelector+required node affinity
+    class_taint: np.ndarray     # [C, N] bool  NoSchedule/NoExecute tolerated
+    class_node_aff_score: np.ndarray  # [C, N] f32 raw preferred-affinity weight sum
+    class_taint_prefer: np.ndarray    # [C, N] f32 intolerable PreferNoSchedule count
+    unschedulable: np.ndarray   # [N] bool
+    # pod axis
+    req: np.ndarray            # [P, R] f32
+    class_id: np.ndarray       # [P] i32
+    forced_node: np.ndarray    # [P] i32 (-1 = schedule)
+    ports: np.ndarray          # [P, Pt] bool
+    match_groups: np.ndarray   # [P, S] bool
+    aff_group: np.ndarray      # [P, A] i32
+    aff_key: np.ndarray        # [P, A] i32
+    aff_valid: np.ndarray      # [P, A] bool
+    aff_self: np.ndarray       # [P, A] bool
+    anti_group: np.ndarray     # [P, B] i32
+    anti_key: np.ndarray       # [P, B] i32
+    anti_valid: np.ndarray     # [P, B] bool
+    own_terms: np.ndarray      # [P, T] bool
+    hit_terms: np.ndarray      # [P, T] bool
+    term_key: np.ndarray       # [T] i32
+    spread_group: np.ndarray   # [P, Cs] i32
+    spread_key: np.ndarray     # [P, Cs] i32
+    spread_skew: np.ndarray    # [P, Cs] f32
+    spread_hard: np.ndarray    # [P, Cs] bool
+    spread_valid: np.ndarray   # [P, Cs] bool
+    pref_group: np.ndarray     # [P, Ap] i32
+    pref_key: np.ndarray       # [P, Ap] i32
+    pref_weight: np.ndarray    # [P, Ap] f32 (negative = anti-affinity preference)
+    pref_valid: np.ndarray     # [P, Ap] bool
+    gpu_mem: np.ndarray        # [P] f32 per-device gpu memory request
+    gpu_cnt: np.ndarray        # [P] f32 number of devices wanted
+    gpu_forced: np.ndarray     # [P, G] bool pre-pinned device ids (gpu-index anno)
+    gpu_has_forced: np.ndarray  # [P] bool
+
+
+@dataclass
+class ClusterSnapshot:
+    arrays: SnapshotArrays
+    node_names: List[str]
+    nodes: List[Node]                 # same order as the node axis (incl. padded new nodes)
+    pods: List[Pod]                   # same order as the pod axis
+    resources: List[str]
+    topo_keys: List[str]
+    group_desc: List[str]
+    op_names: List[str]
+    n_real_nodes: int
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.node_names)
+
+    @property
+    def n_pods(self) -> int:
+        return len(self.pods)
+
+
+def _selector_group_key(sel: Optional[LabelSelector], namespaces: Sequence[str]) -> Optional[tuple]:
+    if sel is None:
+        return None
+    return sel.canonical_key(tuple(namespaces))
+
+
+class _Vocab:
+    def __init__(self):
+        self.index: Dict[Any, int] = {}
+        self.items: List[Any] = []
+
+    def add(self, key) -> int:
+        if key not in self.index:
+            self.index[key] = len(self.items)
+            self.items.append(key)
+        return self.index[key]
+
+    def __len__(self):
+        return len(self.items)
+
+
+def _pad2(rows: List[List], width: int, fill) -> np.ndarray:
+    width = max(width, 1)
+    out = np.full((len(rows), width), fill, dtype=np.asarray(fill).dtype)
+    for i, row in enumerate(rows):
+        for j, v in enumerate(row[:width]):
+            out[i, j] = v
+    return out
+
+
+def encode_cluster(
+    nodes: List[Node],
+    pods: List[Pod],
+    options: Optional[EncodeOptions] = None,
+) -> ClusterSnapshot:
+    """Encode (nodes + optional padded new-node slots, ordered pods) into arrays."""
+    opts = options or EncodeOptions()
+
+    all_nodes = [n for n in nodes]
+    n_real = len(all_nodes)
+    if opts.max_new_nodes > 0:
+        if opts.new_node_template is None:
+            raise ValueError("max_new_nodes > 0 requires a new_node_template")
+        all_nodes += new_fake_nodes(opts.new_node_template, opts.max_new_nodes)
+    N = len(all_nodes)
+    if N == 0:
+        raise ValueError("cannot encode a cluster with zero nodes")
+    node_index = {n.name: i for i, n in enumerate(all_nodes)}
+
+    # ---- resource vocab ------------------------------------------------
+    # gpu-share resources stay in the fit vocabulary: the reference's
+    # vendored NodeResourcesFit checks the *resource form* of
+    # alibabacloud.com/gpu-mem against node allocatable, while the
+    # annotation form drives the gpu-share device packing — both coexist.
+    res_vocab = ["cpu", "memory", "ephemeral-storage", "pods"]
+    seen = set(res_vocab)
+    for n in all_nodes:
+        for r in n.allocatable:
+            if r not in seen:
+                seen.add(r)
+                res_vocab.append(r)
+    for p in pods:
+        for r in p.requests():
+            if r not in seen:
+                seen.add(r)
+                res_vocab.append(r)
+    R = len(res_vocab)
+    res_idx = {r: i for i, r in enumerate(res_vocab)}
+
+    alloc = np.zeros((N, R), dtype=np.float32)
+    for i, n in enumerate(all_nodes):
+        for r, v in n.allocatable.items():
+            if r in res_idx:
+                alloc[i, res_idx[r]] = float(v)
+
+    active = np.zeros(N, dtype=bool)
+    active[:n_real] = True
+    is_new = np.zeros(N, dtype=bool)
+    is_new[n_real:] = True
+
+    # ---- topology keys & domains --------------------------------------
+    topo_vocab = _Vocab()
+    topo_vocab.add(HOSTNAME_KEY)
+
+    def _register_topo(key: str) -> int:
+        return topo_vocab.add(key or HOSTNAME_KEY)
+
+    group_vocab = _Vocab()
+    group_sel: List[Tuple[LabelSelector, Tuple[str, ...]]] = []
+
+    def _register_group(sel: Optional[LabelSelector], namespaces: Sequence[str]) -> int:
+        gk = _selector_group_key(sel, namespaces)
+        if gk is None:
+            gk = ("__nothing__",)
+            sel = LabelSelector(match_labels={"__never__": "__never__"})
+        before = len(group_vocab)
+        gid = group_vocab.add(gk)
+        if len(group_vocab) > before:
+            group_sel.append((sel, tuple(namespaces)))
+        return gid
+
+    term_vocab = _Vocab()  # (gid, kid) -> tid, for required anti-affinity
+
+    pod_aff_terms: List[List[Tuple[int, int, bool]]] = []
+    pod_anti_terms: List[List[Tuple[int, int]]] = []
+    pod_spread: List[List[Tuple[int, int, float, bool]]] = []
+    pod_pref: List[List[Tuple[int, int, float]]] = []
+
+    for p in pods:
+        affs = []
+        for t in p.pod_affinity_required:
+            gid = _register_group(t.selector, t.namespaces)
+            kid = _register_topo(t.topology_key)
+            self_match = (
+                labels_match_selector(p.meta.labels, t.selector) and p.meta.namespace in t.namespaces
+            )
+            affs.append((gid, kid, self_match))
+        pod_aff_terms.append(affs)
+
+        antis = []
+        for t in p.pod_anti_affinity_required:
+            gid = _register_group(t.selector, t.namespaces)
+            kid = _register_topo(t.topology_key)
+            term_vocab.add((gid, kid))
+            antis.append((gid, kid))
+        pod_anti_terms.append(antis)
+
+        spreads = []
+        for c in p.topology_spread:
+            gid = _register_group(c.label_selector, (p.meta.namespace,))
+            kid = _register_topo(c.topology_key)
+            spreads.append((gid, kid, float(c.max_skew), c.when_unsatisfiable == "DoNotSchedule"))
+        pod_spread.append(spreads)
+
+        prefs = []
+        for t in p.pod_affinity_preferred:
+            gid = _register_group(t.selector, t.namespaces)
+            kid = _register_topo(t.topology_key)
+            prefs.append((gid, kid, float(t.weight or 1)))
+        for t in p.pod_anti_affinity_preferred:
+            gid = _register_group(t.selector, t.namespaces)
+            kid = _register_topo(t.topology_key)
+            prefs.append((gid, kid, -float(t.weight or 1)))
+        pod_pref.append(prefs)
+
+    K = len(topo_vocab)
+    K1 = max(K - 1, 1)
+    S = max(len(group_vocab), 1)
+    T = max(len(term_vocab), 1)
+
+    # Domain encoding for non-hostname keys.
+    domain_vals: List[Dict[str, int]] = [dict() for _ in range(K1)]
+    topo_val = np.zeros((K1, N), dtype=np.int64)
+    has_key = np.zeros((K, N), dtype=np.float32)
+    for i, n in enumerate(all_nodes):
+        labels = n.meta.labels
+        has_key[0, i] = 1.0  # hostname: every node is its own domain
+        for kid in range(1, K):
+            key = topo_vocab.items[kid]
+            if key in labels:
+                has_key[kid, i] = 1.0
+                dv = domain_vals[kid - 1]
+                val = labels[key]
+                if val not in dv:
+                    dv[val] = len(dv)
+                topo_val[kid - 1, i] = dv[val]
+            else:
+                topo_val[kid - 1, i] = -1
+    D = max(opts.min_domain_pad, max((len(d) for d in domain_vals), default=1), 1)
+    topo_onehot = np.zeros((K1, N, D), dtype=np.float32)
+    for kk in range(K1):
+        for i in range(N):
+            v = topo_val[kk, i]
+            if v >= 0:
+                topo_onehot[kk, i, v] = 1.0
+
+    # ---- selector-group membership ------------------------------------
+    match_groups = np.zeros((len(pods), S), dtype=bool)
+    for pi, p in enumerate(pods):
+        for gid, (sel, namespaces) in enumerate(group_sel):
+            if p.meta.namespace in namespaces and labels_match_selector(p.meta.labels, sel):
+                match_groups[pi, gid] = True
+
+    # ---- anti-affinity term registry ----------------------------------
+    term_key_arr = np.zeros(T, dtype=np.int64)
+    for (gid, kid), tid in term_vocab.index.items():
+        term_key_arr[tid] = kid
+    own_terms = np.zeros((len(pods), T), dtype=bool)
+    hit_terms = np.zeros((len(pods), T), dtype=bool)
+    for pi, p in enumerate(pods):
+        for gid, kid in pod_anti_terms[pi]:
+            own_terms[pi, term_vocab.index[(gid, kid)]] = True
+        for (gid, kid), tid in term_vocab.index.items():
+            if match_groups[pi, gid]:
+                hit_terms[pi, tid] = True
+
+    # ---- compat classes ------------------------------------------------
+    class_vocab = _Vocab()
+    class_pods: List[Pod] = []
+    class_id = np.zeros(len(pods), dtype=np.int64)
+    for pi, p in enumerate(pods):
+        sig = (
+            tuple(sorted(p.node_selector.items())),
+            json.dumps(p.node_affinity_required, sort_keys=True) if p.node_affinity_required else "",
+            json.dumps(p.node_affinity_preferred, sort_keys=True) if p.node_affinity_preferred else "",
+            tuple((t.key, t.operator, t.value, t.effect) for t in p.tolerations),
+        )
+        before = len(class_vocab)
+        cid = class_vocab.add(sig)
+        if len(class_vocab) > before:
+            class_pods.append(p)
+        class_id[pi] = cid
+    C = max(len(class_vocab), 1)
+    class_affinity = np.ones((C, N), dtype=bool)
+    class_taint = np.ones((C, N), dtype=bool)
+    class_na_score = np.zeros((C, N), dtype=np.float32)
+    class_tt_prefer = np.zeros((C, N), dtype=np.float32)
+    for ci, p in enumerate(class_pods):
+        for ni, n in enumerate(all_nodes):
+            class_affinity[ci, ni] = required_node_affinity_match(
+                n.meta.labels, n.name, p.node_selector, p.node_affinity_required
+            )
+            class_taint[ci, ni] = tolerates_taints(n.taints, p.tolerations)
+            class_na_score[ci, ni] = preferred_node_affinity_score(
+                n.meta.labels, p.node_affinity_preferred
+            )
+            class_tt_prefer[ci, ni] = float(intolerable_prefer_taints(n.taints, p.tolerations))
+    unschedulable = np.array([n.unschedulable for n in all_nodes], dtype=bool)
+
+    # ---- ports ---------------------------------------------------------
+    port_vocab = _Vocab()
+    for p in pods:
+        for hp in p.host_ports():
+            port_vocab.add((hp.host_port, hp.protocol))
+    Pt = max(len(port_vocab), 1)
+    ports = np.zeros((len(pods), Pt), dtype=bool)
+    for pi, p in enumerate(pods):
+        for hp in p.host_ports():
+            ports[pi, port_vocab.index[(hp.host_port, hp.protocol)]] = True
+
+    # ---- per-pod basics ------------------------------------------------
+    P = len(pods)
+    req = np.zeros((P, R), dtype=np.float32)
+    forced = np.full(P, -1, dtype=np.int64)
+    gpu_mem = np.zeros(P, dtype=np.float32)
+    gpu_cnt = np.zeros(P, dtype=np.float32)
+    G = max(1, min(opts.max_gpus_per_node, 64))
+    gpu_forced = np.zeros((P, G), dtype=bool)
+    gpu_has_forced = np.zeros(P, dtype=bool)
+    for pi, p in enumerate(pods):
+        for r, v in p.requests().items():
+            if r in res_idx:
+                req[pi, res_idx[r]] = float(v)
+        if p.node_name:
+            forced[pi] = node_index.get(p.node_name, -2)  # -2: unknown node -> fails
+        mem, cnt = p.gpu_request()
+        gpu_mem[pi] = float(mem)
+        gpu_cnt[pi] = float(cnt)
+        idx_anno = p.meta.annotations.get(k8s.ANNO_GPU_INDEX, "")
+        if idx_anno:
+            gpu_has_forced[pi] = True
+            for tok in str(idx_anno).split("-"):
+                if tok.isdigit() and int(tok) < G:
+                    gpu_forced[pi, int(tok)] = True
+
+    # ---- gpu node arrays ----------------------------------------------
+    gpu_count = np.zeros(N, dtype=np.float32)
+    gpu_cap_mem = np.zeros(N, dtype=np.float32)
+    gpu_slot = np.zeros((N, G), dtype=np.float32)
+    for i, n in enumerate(all_nodes):
+        cnt, per_mem = n.gpu_info()
+        cnt = min(cnt, G)
+        gpu_count[i] = float(cnt)
+        gpu_cap_mem[i] = float(per_mem)
+        gpu_slot[i, :cnt] = 1.0
+
+    # ---- ragged term arrays -> padded ---------------------------------
+    A = max((len(t) for t in pod_aff_terms), default=0)
+    B = max((len(t) for t in pod_anti_terms), default=0)
+    Cs = max((len(t) for t in pod_spread), default=0)
+    Ap = max((len(t) for t in pod_pref), default=0)
+
+    aff_group = _pad2([[t[0] for t in row] for row in pod_aff_terms], A, np.int64(0))
+    aff_key = _pad2([[t[1] for t in row] for row in pod_aff_terms], A, np.int64(0))
+    aff_valid = _pad2([[True for _ in row] for row in pod_aff_terms], A, np.bool_(False))
+    aff_self = _pad2([[t[2] for t in row] for row in pod_aff_terms], A, np.bool_(False))
+    anti_group = _pad2([[t[0] for t in row] for row in pod_anti_terms], B, np.int64(0))
+    anti_key = _pad2([[t[1] for t in row] for row in pod_anti_terms], B, np.int64(0))
+    anti_valid = _pad2([[True for _ in row] for row in pod_anti_terms], B, np.bool_(False))
+    spread_group = _pad2([[t[0] for t in row] for row in pod_spread], Cs, np.int64(0))
+    spread_key = _pad2([[t[1] for t in row] for row in pod_spread], Cs, np.int64(0))
+    spread_skew = _pad2([[t[2] for t in row] for row in pod_spread], Cs, np.float32(1.0))
+    spread_hard = _pad2([[t[3] for t in row] for row in pod_spread], Cs, np.bool_(False))
+    spread_valid = _pad2([[True for _ in row] for row in pod_spread], Cs, np.bool_(False))
+    pref_group = _pad2([[t[0] for t in row] for row in pod_pref], Ap, np.int64(0))
+    pref_key = _pad2([[t[1] for t in row] for row in pod_pref], Ap, np.int64(0))
+    pref_weight = _pad2([[t[2] for t in row] for row in pod_pref], Ap, np.float32(0.0))
+    pref_valid = _pad2([[True for _ in row] for row in pod_pref], Ap, np.bool_(False))
+
+    arrays = SnapshotArrays(
+        alloc=alloc,
+        active=active,
+        is_new_node=is_new,
+        topo_onehot=topo_onehot,
+        has_key=has_key,
+        gpu_cap_mem=gpu_cap_mem,
+        gpu_count=gpu_count,
+        gpu_slot=gpu_slot,
+        class_affinity=class_affinity,
+        class_taint=class_taint,
+        class_node_aff_score=class_na_score,
+        class_taint_prefer=class_tt_prefer,
+        unschedulable=unschedulable,
+        req=req,
+        class_id=class_id.astype(np.int32),
+        forced_node=forced.astype(np.int32),
+        ports=ports,
+        match_groups=match_groups,
+        aff_group=aff_group.astype(np.int32),
+        aff_key=aff_key.astype(np.int32),
+        aff_valid=aff_valid,
+        aff_self=aff_self,
+        anti_group=anti_group.astype(np.int32),
+        anti_key=anti_key.astype(np.int32),
+        anti_valid=anti_valid,
+        own_terms=own_terms,
+        hit_terms=hit_terms,
+        term_key=term_key_arr.astype(np.int32),
+        spread_group=spread_group.astype(np.int32),
+        spread_key=spread_key.astype(np.int32),
+        spread_skew=spread_skew.astype(np.float32),
+        spread_hard=spread_hard,
+        spread_valid=spread_valid,
+        pref_group=pref_group.astype(np.int32),
+        pref_key=pref_key.astype(np.int32),
+        pref_weight=pref_weight.astype(np.float32),
+        pref_valid=pref_valid,
+        gpu_mem=gpu_mem,
+        gpu_cnt=gpu_cnt,
+        gpu_forced=gpu_forced,
+        gpu_has_forced=gpu_has_forced,
+    )
+
+    group_desc = [f"group#{i}" for i in range(S)]
+    return ClusterSnapshot(
+        arrays=arrays,
+        node_names=[n.name for n in all_nodes],
+        nodes=all_nodes,
+        pods=list(pods),
+        resources=res_vocab,
+        topo_keys=list(topo_vocab.items),
+        group_desc=group_desc,
+        op_names=filter_op_table(res_vocab),
+        n_real_nodes=n_real,
+    )

@@ -1,0 +1,51 @@
+"""Pod queue-ordering strategies (host-side).
+
+The reference ships three sort.Interface implementations in pkg/algo
+(greed.go, affinity.go, toleration.go) — of which only Share() is live in
+scoring and the --use-greed flag is parsed but never consumed (SURVEY.md
+section 2a "Queue-sort algos"). Here all three are implemented and the
+CLI flag actually works: ordering is a host-side permutation of the pod
+sequence before encoding, which is exactly what a queue is to a scan.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from open_simulator_tpu.k8s.objects import Pod
+
+
+def _dominant_share(pod: Pod, totals: Dict[str, int]) -> float:
+    """max over resources of req_r / cluster_total_r
+    (reference: pkg/algo/greed.go:70-83 Share)."""
+    share = 0.0
+    for r, v in pod.requests().items():
+        total = totals.get(r, 0)
+        if total == 0:
+            share = max(share, 1.0 if v > 0 else 0.0)
+        else:
+            share = max(share, v / total)
+    return share
+
+
+def sort_pods_greedy(pods: List[Pod], cluster_totals: Dict[str, int]) -> List[Pod]:
+    """GreedQueue (greed.go:37-67): pre-assigned pods first, then by
+    descending dominant-resource share — schedule the big rocks first.
+    Stable sort keeps submission order among equals."""
+    return sorted(
+        pods,
+        key=lambda p: (0 if p.node_name else 1, -_dominant_share(p, cluster_totals)),
+    )
+
+
+def sort_pods_affinity(pods: List[Pod]) -> List[Pod]:
+    """AffinityQueue (affinity.go:21-23): pods with node selectors or
+    required affinity first (they are the most constrained)."""
+    def has_affinity(p: Pod) -> bool:
+        return bool(p.node_selector) or p.node_affinity_required is not None
+    return sorted(pods, key=lambda p: 0 if has_affinity(p) else 1)
+
+
+def sort_pods_toleration(pods: List[Pod]) -> List[Pod]:
+    """TolerationQueue (toleration.go:19-21): pods with tolerations first."""
+    return sorted(pods, key=lambda p: 0 if p.tolerations else 1)

@@ -1,0 +1,252 @@
+"""The scan scheduler.
+
+One `lax.scan` step == one trip through the vendored scheduleOne pipeline
+(vendor/.../scheduler/scheduler.go:425-520): feasibility masks (Filter),
+weighted scores (Score), argmax (selectHost), carry update (Reserve+Bind).
+Pods with a preset nodeName take the forced-bind fast path, mirroring how
+already-placed cluster pods enter the fake clientset without scheduling
+(pkg/simulator/simulator.go:303-349).
+
+Reason accounting: per node, the *first* failing filter op (in the
+vendored execution order) is charged, and per-op failure counts are
+emitted per pod — the host formats the scheduler's familiar
+"0/N nodes are available: 2 Insufficient cpu, ..." diagnostics from them.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from open_simulator_tpu.encode.snapshot import (
+    OP_FIT_BASE,
+    ClusterSnapshot,
+    SnapshotArrays,
+)
+from open_simulator_tpu.ops import filters, gpu_share, scores
+
+
+class EngineConfig(NamedTuple):
+    """Static (hashable) engine configuration — the analog of the
+    KubeSchedulerConfiguration profile the reference assembles in
+    GetAndSetSchedulerConfig (pkg/simulator/utils.go:325-356)."""
+
+    n_resources: int
+    cpu_mem_idx: Tuple[int, ...] = (0, 1)
+    enable_gpu: bool = False
+    # score weights (v1beta2 defaults + Simon appended with weight 1)
+    w_balanced: float = 1.0
+    w_least: float = 1.0
+    w_node_aff: float = 1.0
+    w_taint: float = 1.0
+    w_interpod: float = 1.0
+    w_spread: float = 2.0
+    w_simon: float = 1.0
+    w_gpu: float = 1.0
+
+    @property
+    def n_ops(self) -> int:
+        return OP_FIT_BASE + self.n_resources + 4
+
+
+class SimState(NamedTuple):
+    """The scan carry — the whole mutable world of the simulation.
+    (The reference spreads this across the fake clientset, the scheduler
+    cache, and the gpu-share cache; here it is five dense arrays.)"""
+
+    used: jnp.ndarray         # [N, R]
+    group_count: jnp.ndarray  # [N, S]
+    term_block: jnp.ndarray   # [N, T]
+    ports_used: jnp.ndarray   # [N, Pt] bool
+    gpu_used: jnp.ndarray     # [N, G]
+
+
+class ScheduleOutput(NamedTuple):
+    node: jnp.ndarray         # [P] i32, -1 = unscheduled
+    fail_counts: jnp.ndarray  # [P, OPS] i32
+    feasible: jnp.ndarray     # [P] i32 feasible-node count
+    state: SimState
+
+
+def device_arrays(snapshot: ClusterSnapshot) -> SnapshotArrays:
+    """Host numpy -> device arrays (one transfer; the analog of the
+    host->HBM snapshot hop described in SURVEY.md section 2c)."""
+    return jax.tree_util.tree_map(jnp.asarray, snapshot.arrays)
+
+
+def init_state(arrs: SnapshotArrays) -> SimState:
+    n, r = arrs.alloc.shape
+    s = arrs.match_groups.shape[1]
+    t = arrs.own_terms.shape[1]
+    pt = arrs.ports.shape[1]
+    g = arrs.gpu_slot.shape[1]
+    f32 = jnp.float32
+    return SimState(
+        used=jnp.zeros((n, r), f32),
+        group_count=jnp.zeros((n, s), f32),
+        term_block=jnp.zeros((n, t), f32),
+        ports_used=jnp.zeros((n, pt), dtype=bool),
+        gpu_used=jnp.zeros((n, g), f32),
+    )
+
+
+def _pod_xs(arrs: SnapshotArrays) -> Dict[str, jnp.ndarray]:
+    """The pod-axis arrays fed to scan as xs."""
+    names = [
+        "req", "class_id", "forced_node", "ports", "match_groups",
+        "aff_group", "aff_key", "aff_valid", "aff_self",
+        "anti_group", "anti_key", "anti_valid",
+        "own_terms", "hit_terms",
+        "spread_group", "spread_key", "spread_skew", "spread_hard", "spread_valid",
+        "pref_group", "pref_key", "pref_weight", "pref_valid",
+        "gpu_mem", "gpu_cnt", "gpu_forced", "gpu_has_forced",
+    ]
+    return {k: getattr(arrs, k) for k in names}
+
+
+def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: SimState, x):
+    n_nodes = arrs.alloc.shape[0]
+    f32 = jnp.float32
+
+    cm_aff = arrs.class_affinity[x["class_id"]]      # [N]
+    cm_taint = arrs.class_taint[x["class_id"]]
+    na_row = arrs.class_node_aff_score[x["class_id"]]
+    tt_row = arrs.class_taint_prefer[x["class_id"]]
+
+    # ---- filter pipeline (ordered; see filter_op_table) ---------------
+    ok_unsched = ~arrs.unschedulable
+    ok_aff = cm_aff
+    ok_taint = cm_taint
+    ok_ports = filters.ports_free(state.ports_used, x["ports"])
+    fit = filters.fit_per_resource(state.used, arrs.alloc, x["req"])   # [N, R]
+    ok_pod_aff = filters.pod_affinity_ok(
+        state.group_count, arrs.topo_onehot, arrs.has_key,
+        x["aff_group"], x["aff_key"], x["aff_valid"], x["aff_self"],
+    )
+    ok_pod_anti = filters.pod_anti_affinity_ok(
+        state.group_count, state.term_block, arrs.topo_onehot, arrs.has_key,
+        x["anti_group"], x["anti_key"], x["anti_valid"], x["hit_terms"],
+    )
+    spread_self = x["match_groups"][x["spread_group"]] & x["spread_valid"]
+    ok_spread = filters.topology_spread_ok(
+        state.group_count, arrs.topo_onehot, arrs.has_key,
+        active & cm_aff,
+        x["spread_group"], x["spread_key"], x["spread_skew"],
+        x["spread_hard"], x["spread_valid"], spread_self,
+    )
+    if cfg.enable_gpu:
+        ok_gpu = gpu_share.gpu_fit(
+            state.gpu_used, arrs.gpu_cap_mem, arrs.gpu_slot, x["gpu_mem"], x["gpu_cnt"]
+        )
+    else:
+        ok_gpu = jnp.ones((n_nodes,), dtype=bool)
+
+    op_masks = [ok_unsched, ok_aff, ok_taint, ok_ports]
+    op_masks += [fit[:, r] for r in range(cfg.n_resources)]
+    op_masks += [ok_pod_aff, ok_pod_anti, ok_spread, ok_gpu]
+    ops_ok = jnp.stack(op_masks)                     # [OPS, N]
+
+    mask = active & jnp.all(ops_ok, axis=0)          # [N]
+
+    # first failing op per node -> per-op failure counts (active nodes only)
+    fails = ~ops_ok                                   # [OPS, N]
+    first_fail = jnp.argmax(fails, axis=0)            # [N]
+    any_fail = jnp.any(fails, axis=0)
+    charged = active & any_fail
+    onehot_ops = (first_fail[None, :] == jnp.arange(cfg.n_ops)[:, None])  # [OPS, N]
+    fail_counts = jnp.sum(onehot_ops & charged[None, :], axis=1).astype(jnp.int32)
+
+    # ---- scores (feasible nodes only) ---------------------------------
+    score = jnp.zeros((n_nodes,), f32)
+    score += cfg.w_balanced * scores.balanced_allocation_score(
+        state.used, arrs.alloc, x["req"], cfg.cpu_mem_idx)
+    score += cfg.w_least * scores.least_allocated_score(
+        state.used, arrs.alloc, x["req"], cfg.cpu_mem_idx)
+    score += cfg.w_node_aff * scores.node_affinity_score(na_row, mask)
+    score += cfg.w_taint * scores.taint_toleration_score(tt_row, mask)
+    score += cfg.w_interpod * scores.interpod_preference_score(
+        state.group_count, arrs.topo_onehot, arrs.has_key,
+        x["pref_group"], x["pref_key"], x["pref_weight"], x["pref_valid"], mask)
+    score += cfg.w_spread * scores.topology_spread_score(
+        state.group_count, arrs.topo_onehot, arrs.has_key,
+        x["spread_group"], x["spread_key"], x["spread_valid"], mask)
+    score += cfg.w_simon * scores.simon_max_share_score(arrs.alloc, x["req"], mask)
+    if cfg.enable_gpu:
+        score += cfg.w_gpu * gpu_share.gpu_share_score(
+            state.gpu_used, arrs.gpu_cap_mem, arrs.gpu_slot, x["gpu_mem"], x["gpu_cnt"], mask)
+
+    neg_inf = jnp.float32(-3.4e38)
+    sel_node = jnp.argmax(jnp.where(mask, score, neg_inf)).astype(jnp.int32)
+    feasible_n = jnp.sum(mask.astype(jnp.int32))
+    any_feasible = feasible_n > 0
+
+    forced = x["forced_node"]
+    do_schedule = forced == -1
+    final_node = jnp.where(
+        forced >= 0, forced, jnp.where(do_schedule & any_feasible, sel_node, -1)
+    ).astype(jnp.int32)
+
+    # ---- bind: carry update (masked when final_node < 0) --------------
+    bound = final_node >= 0
+    safe_node = jnp.maximum(final_node, 0)
+    onehot_n = jax.nn.one_hot(final_node, n_nodes, dtype=f32)  # -1 -> zeros
+    used = state.used + onehot_n[:, None] * x["req"][None, :]
+    group_count = state.group_count + onehot_n[:, None] * x["match_groups"].astype(f32)[None, :]
+    ports_used = state.ports_used | ((onehot_n[:, None] > 0) & x["ports"][None, :])
+
+    # anti-affinity domain paint for this pod's own terms:
+    # sd_all [K, N] = same-domain masks of the bound node under every key
+    k1 = arrs.topo_onehot.shape[0]
+    sd_list = [jax.nn.one_hot(final_node, n_nodes, dtype=f32)]  # hostname
+    for kk in range(k1):
+        oh = arrs.topo_onehot[kk]
+        sd_list.append(oh @ oh[safe_node] * bound.astype(f32))
+    sd_all = jnp.stack(sd_list)                       # [K, N]
+    paint = sd_all[arrs.term_key].T * x["own_terms"].astype(f32)[None, :]  # [N, T]
+    term_block = state.term_block + paint
+
+    if cfg.enable_gpu:
+        pick = gpu_share.gpu_pick_devices(
+            state.gpu_used[safe_node], arrs.gpu_cap_mem[safe_node], arrs.gpu_slot[safe_node],
+            x["gpu_mem"], x["gpu_cnt"], x["gpu_forced"], x["gpu_has_forced"],
+        )
+        gpu_used = state.gpu_used + (
+            onehot_n[:, None] * pick.astype(f32)[None, :] * x["gpu_mem"]
+        )
+    else:
+        gpu_used = state.gpu_used
+
+    new_state = SimState(used, group_count, term_block, ports_used, gpu_used)
+    return new_state, (final_node, fail_counts, feasible_n)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def schedule_pods(
+    arrs: SnapshotArrays,
+    active: jnp.ndarray,
+    cfg: EngineConfig,
+    state: SimState | None = None,
+) -> ScheduleOutput:
+    """Scan the pod sequence, return assignments + reason counts + final state."""
+    if state is None:
+        state = init_state(arrs)
+    xs = _pod_xs(arrs)
+    step = functools.partial(_step, arrs, active, cfg)
+    final_state, (nodes, fail_counts, feasible) = jax.lax.scan(step, state, xs)
+    return ScheduleOutput(node=nodes, fail_counts=fail_counts, feasible=feasible, state=final_state)
+
+
+def make_config(snapshot: ClusterSnapshot, **overrides) -> EngineConfig:
+    """EngineConfig from a snapshot: resource indices + gpu autodetect."""
+    res = snapshot.resources
+    cpu_mem = (res.index("cpu"), res.index("memory"))
+    enable_gpu = bool(np.any(snapshot.arrays.gpu_count > 0))
+    kw: Dict[str, Any] = dict(
+        n_resources=len(res), cpu_mem_idx=cpu_mem, enable_gpu=enable_gpu
+    )
+    kw.update(overrides)
+    return EngineConfig(**kw)

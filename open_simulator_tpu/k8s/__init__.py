@@ -1,0 +1,39 @@
+"""Typed Kubernetes object model + helpers (host-side, pure Python).
+
+This is the rebuild's replacement for the reference's reliance on the
+vendored k8s API machinery: just enough of the k8s data model for a
+scheduling simulator — quantities, labels/selectors, taints/tolerations,
+affinity — with strict, small dataclasses instead of generated clients.
+"""
+
+from open_simulator_tpu.k8s.quantity import parse_quantity, format_quantity
+from open_simulator_tpu.k8s.objects import (
+    Container,
+    CronJob,
+    DaemonSet,
+    Deployment,
+    Job,
+    LabelSelector,
+    Node,
+    ObjectMeta,
+    Pod,
+    PodDisruptionBudget,
+    PersistentVolumeClaim,
+    ReplicaSet,
+    ResourceList,
+    Service,
+    StatefulSet,
+    StorageClass,
+    Taint,
+    Toleration,
+    ConfigMap,
+)
+from open_simulator_tpu.k8s.selectors import (
+    labels_match_selector,
+    match_expression,
+    node_selector_terms_match,
+    tolerates_taints,
+    required_node_affinity_match,
+    preferred_node_affinity_score,
+    intolerable_prefer_taints,
+)

@@ -1,0 +1,234 @@
+"""YAML loading + object demux + normalization.
+
+Re-expresses the reference's file-walking and object plumbing
+(/root/reference/pkg/utils/utils.go:40-127, GetObjectFromYamlContent at
+pkg/simulator/utils.go:232-274) on top of pyyaml, and the MakeValidPod /
+MakeValidNode normalizers (pkg/utils/utils.go:326-456,531-545).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import string
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from open_simulator_tpu.k8s import objects as k8s
+from open_simulator_tpu.k8s.objects import (
+    ANNO_NODE_LOCAL_STORAGE,
+    DEFAULT_SCHEDULER,
+    FAKE_NODE_PREFIX,
+    LABEL_NEW_NODE,
+    MAX_PODS_DEFAULT,
+)
+
+_KIND_MAP = {
+    "Node": k8s.Node,
+    "Pod": k8s.Pod,
+    "Deployment": k8s.Deployment,
+    "ReplicaSet": k8s.ReplicaSet,
+    "StatefulSet": k8s.StatefulSet,
+    "DaemonSet": k8s.DaemonSet,
+    "Job": k8s.Job,
+    "CronJob": k8s.CronJob,
+    "Service": k8s.Service,
+    "PodDisruptionBudget": k8s.PodDisruptionBudget,
+    "StorageClass": k8s.StorageClass,
+    "PersistentVolumeClaim": k8s.PersistentVolumeClaim,
+    "ConfigMap": k8s.ConfigMap,
+}
+
+
+@dataclass
+class ClusterResources:
+    """The 13-kind resource container (reference: pkg/simulator/core.go:46-60
+    ResourceTypes). Holds typed objects for one cluster or one app."""
+
+    nodes: List[k8s.Node] = field(default_factory=list)
+    pods: List[k8s.Pod] = field(default_factory=list)
+    deployments: List[k8s.Deployment] = field(default_factory=list)
+    replica_sets: List[k8s.ReplicaSet] = field(default_factory=list)
+    stateful_sets: List[k8s.StatefulSet] = field(default_factory=list)
+    daemon_sets: List[k8s.DaemonSet] = field(default_factory=list)
+    jobs: List[k8s.Job] = field(default_factory=list)
+    cron_jobs: List[k8s.CronJob] = field(default_factory=list)
+    services: List[k8s.Service] = field(default_factory=list)
+    pdbs: List[k8s.PodDisruptionBudget] = field(default_factory=list)
+    storage_classes: List[k8s.StorageClass] = field(default_factory=list)
+    pvcs: List[k8s.PersistentVolumeClaim] = field(default_factory=list)
+    config_maps: List[k8s.ConfigMap] = field(default_factory=list)
+
+    _FIELD_BY_KIND = {
+        "Node": "nodes",
+        "Pod": "pods",
+        "Deployment": "deployments",
+        "ReplicaSet": "replica_sets",
+        "StatefulSet": "stateful_sets",
+        "DaemonSet": "daemon_sets",
+        "Job": "jobs",
+        "CronJob": "cron_jobs",
+        "Service": "services",
+        "PodDisruptionBudget": "pdbs",
+        "StorageClass": "storage_classes",
+        "PersistentVolumeClaim": "pvcs",
+        "ConfigMap": "config_maps",
+    }
+
+    def add(self, obj: Any, kind: str) -> None:
+        getattr(self, self._FIELD_BY_KIND[kind]).append(obj)
+
+    def extend(self, other: "ClusterResources") -> None:
+        for attr in self._FIELD_BY_KIND.values():
+            getattr(self, attr).extend(getattr(other, attr))
+
+    def counts(self) -> Dict[str, int]:
+        return {k: len(getattr(self, v)) for k, v in self._FIELD_BY_KIND.items() if getattr(self, v)}
+
+
+class UnsupportedKindError(ValueError):
+    pass
+
+
+def yaml_files_in(directory: str) -> List[str]:
+    """Recursively list .yaml/.yml files, sorted for determinism
+    (reference walks with filepath.Walk: lexical order)."""
+    out: List[str] = []
+    for root, _dirs, files in os.walk(directory):
+        for f in sorted(files):
+            if f.endswith((".yaml", ".yml")) and not f.startswith("."):
+                out.append(os.path.join(root, f))
+    return sorted(out)
+
+
+def parse_yaml_documents(text: str) -> List[Dict[str, Any]]:
+    docs = []
+    for doc in yaml.safe_load_all(text):
+        if isinstance(doc, dict) and doc.get("kind"):
+            docs.append(doc)
+    return docs
+
+
+def demux_object(doc: Dict[str, Any], into: ClusterResources, strict: bool = False) -> bool:
+    """Route one parsed YAML doc to its typed list. Returns True if handled.
+
+    Unknown kinds: reference errors on unsupported kinds during cluster
+    load (pkg/simulator/utils.go:271-273) but app dirs in practice only
+    contain supported kinds; `strict` toggles that behavior.
+    """
+    kind = doc.get("kind", "")
+    cls = _KIND_MAP.get(kind)
+    if cls is None:
+        if strict:
+            raise UnsupportedKindError(f"unsupported object kind: {kind}")
+        return False
+    into.add(cls.from_dict(doc), kind)
+    return True
+
+
+def load_resources_from_directory(directory: str, strict: bool = False) -> ClusterResources:
+    res = ClusterResources()
+    for path in yaml_files_in(directory):
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+        for doc in parse_yaml_documents(text):
+            demux_object(doc, res, strict=strict)
+    _match_node_local_storage(directory, res)
+    return res
+
+
+def _match_node_local_storage(directory: str, res: ClusterResources) -> None:
+    """Attach `<nodename>.json` local-storage sidecars as node annotations
+    (reference: pkg/simulator/utils.go:358-376 MatchAndSetLocalStorageAnnotationOnNode)."""
+    import json
+
+    json_by_name: Dict[str, str] = {}
+    for root, _dirs, files in os.walk(directory):
+        for f in files:
+            if f.endswith(".json"):
+                with open(os.path.join(root, f), "r", encoding="utf-8") as fh:
+                    try:
+                        json_by_name[f[: -len(".json")]] = json.dumps(json.load(fh))
+                    except json.JSONDecodeError:
+                        continue
+    for node in res.nodes:
+        if node.name in json_by_name:
+            node.meta.annotations[ANNO_NODE_LOCAL_STORAGE] = json_by_name[node.name]
+
+
+class PodValidationError(ValueError):
+    pass
+
+
+def make_valid_pod(pod: k8s.Pod) -> k8s.Pod:
+    """Normalize a pod the way the fake apiserver would admit it.
+
+    Mirrors reference MakeValidPod (pkg/utils/utils.go:326-411): default
+    namespace/scheduler/phase, clear any stale status, and validate the
+    handful of invariants the engine depends on. Env/volumeMounts/probes
+    live only in `.raw` and are ignored by the engine (the reference
+    strips them; keeping them in raw is strictly more faithful).
+    """
+    p = pod.clone()
+    if not p.meta.namespace:
+        p.meta.namespace = "default"
+    if not p.scheduler_name:
+        p.scheduler_name = DEFAULT_SCHEDULER
+    p.phase = "Pending" if not p.node_name else "Running"
+    if not p.meta.name:
+        raise PodValidationError("pod has no name")
+    if not p.containers:
+        raise PodValidationError(f"pod {p.key} has no containers")
+    for c in p.containers:
+        for name, v in c.requests.items():
+            if v < 0:
+                raise PodValidationError(f"pod {p.key} negative request {name}")
+            if name in c.limits and c.limits[name] < v:
+                raise PodValidationError(f"pod {p.key} request {name} exceeds limit")
+    for tol in p.tolerations:
+        if tol.operator == "Exists" and tol.value:
+            raise PodValidationError(f"pod {p.key} toleration: value must be empty when operator is Exists")
+    return p
+
+
+def make_valid_node(node: k8s.Node) -> k8s.Node:
+    """Node normalization (reference MakeValidNodeByNode, utils.go:421-440):
+    ensure pods allocatable, status Ready, hostname label."""
+    n = node.clone()
+    if not n.name:
+        raise PodValidationError("node has no name")
+    if "pods" not in n.allocatable:
+        n.allocatable["pods"] = MAX_PODS_DEFAULT
+    n.meta.labels.setdefault("kubernetes.io/hostname", n.name)
+    return n
+
+
+_RAND = random.Random(20260729)
+
+
+def fake_node_name() -> str:
+    suffix = "".join(_RAND.choice(string.ascii_lowercase + string.digits) for _ in range(5))
+    return f"{FAKE_NODE_PREFIX}-{suffix}"
+
+
+def new_fake_nodes(template: k8s.Node, count: int) -> List[k8s.Node]:
+    """Clone the newNode template `count` times with simon-<rand5> names and
+    the new-node label (reference: pkg/utils/utils.go:790-820 NewFakeNodes)."""
+    out = []
+    for _ in range(count):
+        n = template.clone()
+        n.meta.name = fake_node_name()
+        n.meta.labels[LABEL_NEW_NODE] = "true"
+        n.meta.labels["kubernetes.io/hostname"] = n.meta.name
+        out.append(make_valid_node(n))
+    return out
+
+
+def sort_node_names(names: List[str]) -> List[str]:
+    """Real nodes first (alphabetical), simon- fake nodes last
+    (reference: pkg/utils/utils.go:574-622)."""
+    real = sorted(n for n in names if not n.startswith(f"{FAKE_NODE_PREFIX}-"))
+    fake = sorted(n for n in names if n.startswith(f"{FAKE_NODE_PREFIX}-"))
+    return real + fake

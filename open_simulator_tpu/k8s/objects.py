@@ -1,0 +1,491 @@
+"""Kubernetes object dataclasses (the subset a scheduling simulator needs).
+
+Replaces the reference's dependence on the full vendored k8s type system with
+small typed records parsed straight from YAML dicts. Every object keeps its
+raw dict in `.raw` so surfaces (reports, REST responses) can round-trip
+fields the simulator itself does not interpret.
+
+Canonical resource units: see k8s/quantity.py. A ResourceList is a plain
+``dict[str, int]`` in canonical units (cpu=milli, memory/storage=MiB,
+other=count).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from open_simulator_tpu.k8s.quantity import cpu_to_milli, mem_to_mib, count_value
+
+ResourceList = Dict[str, int]
+
+# Resource names handled with unit-aware parsing.
+_MEM_LIKE = {"memory", "ephemeral-storage", "storage"}
+
+# Annotation/label vocabulary (mirrors the reference's pkg/type/const.go and
+# the open-gpu-share annotation scheme, re-namespaced for this framework).
+ANNO_WORKLOAD_KIND = "simon.tpu/workload-kind"
+ANNO_WORKLOAD_NAME = "simon.tpu/workload-name"
+ANNO_WORKLOAD_NAMESPACE = "simon.tpu/workload-namespace"
+ANNO_NODE_LOCAL_STORAGE = "simon.tpu/node-local-storage"
+ANNO_POD_LOCAL_STORAGE = "simon.tpu/pod-local-storage"
+ANNO_NODE_GPU_SHARE = "simon.tpu/node-gpu-share"
+LABEL_NEW_NODE = "simon.tpu/new-node"
+LABEL_APP_NAME = "simon.tpu/app-name"
+ANNO_GPU_MEM = "alibabacloud.com/gpu-mem"          # per-GPU memory request (GiB units)
+ANNO_GPU_COUNT = "alibabacloud.com/gpu-count"      # number of GPUs wanted
+ANNO_GPU_INDEX = "alibabacloud.com/gpu-index"      # assigned device ids "2-3-4"
+ANNO_GPU_ASSUME_TIME = "alibabacloud.com/assume-time"
+LABEL_GPU_MODEL = "alibabacloud.com/gpu-card-model"
+RES_GPU_MEM = "alibabacloud.com/gpu-mem"
+RES_GPU_COUNT = "alibabacloud.com/gpu-count"
+DEFAULT_SCHEDULER = "default-scheduler"
+FAKE_NODE_PREFIX = "simon"
+MAX_PODS_DEFAULT = 110
+
+
+def parse_resource_list(d: Optional[Dict[str, Any]]) -> ResourceList:
+    """Parse a k8s resources map into canonical integer units."""
+    out: ResourceList = {}
+    for name, qty in (d or {}).items():
+        if name == "cpu":
+            out[name] = cpu_to_milli(qty)
+        elif name in _MEM_LIKE:
+            out[name] = mem_to_mib(qty)
+        else:
+            out[name] = count_value(qty)
+    return out
+
+
+def add_resource_lists(a: ResourceList, b: ResourceList) -> ResourceList:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, 0) + v
+    return out
+
+
+def max_resource_lists(a: ResourceList, b: ResourceList) -> ResourceList:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = max(out.get(k, 0), v)
+    return out
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    owner_kind: str = ""
+    owner_name: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ObjectMeta":
+        d = d or {}
+        owners = d.get("ownerReferences") or []
+        owner = owners[0] if owners else {}
+        return cls(
+            name=d.get("name", "") or d.get("generateName", ""),
+            namespace=d.get("namespace") or "default",
+            labels=dict(d.get("labels") or {}),
+            annotations=dict(d.get("annotations") or {}),
+            owner_kind=owner.get("kind", ""),
+            owner_name=owner.get("name", ""),
+        )
+
+
+@dataclass
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = ""  # NoSchedule | PreferNoSchedule | NoExecute
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Taint":
+        return cls(key=d.get("key", ""), value=d.get("value", "") or "", effect=d.get("effect", ""))
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # "" matches all effects
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Toleration":
+        # k8s defaults a missing operator to Equal (with empty value), NOT Exists.
+        return cls(
+            key=d.get("key", "") or "",
+            operator=d.get("operator") or "Equal",
+            value=d.get("value", "") or "",
+            effect=d.get("effect", "") or "",
+        )
+
+
+@dataclass
+class LabelSelector:
+    """matchLabels + matchExpressions; None means "select nothing"."""
+
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    match_expressions: List[Dict[str, Any]] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> Optional["LabelSelector"]:
+        if d is None:
+            return None
+        return cls(
+            match_labels=dict(d.get("matchLabels") or {}),
+            match_expressions=list(d.get("matchExpressions") or []),
+        )
+
+    def canonical_key(self, namespaces: tuple) -> tuple:
+        """Hashable identity used for selector-group vocab building."""
+        exprs = tuple(
+            (e.get("key", ""), e.get("operator", ""), tuple(sorted(e.get("values") or [])))
+            for e in self.match_expressions
+        )
+        return (tuple(sorted(self.match_labels.items())), exprs, tuple(sorted(namespaces)))
+
+
+@dataclass
+class ContainerPort:
+    host_port: int
+    protocol: str = "TCP"
+    host_ip: str = ""
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    requests: ResourceList = field(default_factory=dict)
+    limits: ResourceList = field(default_factory=dict)
+    ports: List[ContainerPort] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any], host_network: bool = False) -> "Container":
+        res = d.get("resources") or {}
+        ports = []
+        for p in d.get("ports") or []:
+            hp = p.get("hostPort") or (p.get("containerPort") if host_network else None)
+            if hp:
+                ports.append(
+                    ContainerPort(host_port=int(hp), protocol=p.get("protocol", "TCP"), host_ip=p.get("hostIP", ""))
+                )
+        return cls(
+            name=d.get("name", ""),
+            image=d.get("image", ""),
+            requests=parse_resource_list(res.get("requests")),
+            limits=parse_resource_list(res.get("limits")),
+            ports=ports,
+        )
+
+
+@dataclass
+class TopologySpreadConstraint:
+    max_skew: int
+    topology_key: str
+    when_unsatisfiable: str  # DoNotSchedule | ScheduleAnyway
+    label_selector: Optional[LabelSelector]
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TopologySpreadConstraint":
+        return cls(
+            max_skew=int(d.get("maxSkew", 1)),
+            topology_key=d.get("topologyKey", ""),
+            when_unsatisfiable=d.get("whenUnsatisfiable", "DoNotSchedule"),
+            label_selector=LabelSelector.from_dict(d.get("labelSelector")),
+        )
+
+
+@dataclass
+class PodAffinityTerm:
+    selector: Optional[LabelSelector]
+    topology_key: str
+    namespaces: List[str]  # resolved namespaces the selector applies to
+    weight: int = 0  # nonzero for preferred terms
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any], pod_namespace: str, weight: int = 0) -> "PodAffinityTerm":
+        namespaces = list(d.get("namespaces") or []) or [pod_namespace]
+        return cls(
+            selector=LabelSelector.from_dict(d.get("labelSelector")),
+            topology_key=d.get("topologyKey", ""),
+            namespaces=namespaces,
+            weight=weight,
+        )
+
+
+@dataclass
+class Pod:
+    """A normalized pod, ready for encoding.
+
+    Mirrors the subset of PodSpec the vendored scheduler reads (reference:
+    pkg/utils/utils.go MakeValidPod strips everything else anyway).
+    """
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    node_name: str = ""
+    scheduler_name: str = DEFAULT_SCHEDULER
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[Toleration] = field(default_factory=list)
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    # required/preferred node affinity, raw k8s shape
+    node_affinity_required: Optional[List[Dict[str, Any]]] = None  # nodeSelectorTerms
+    node_affinity_preferred: List[Dict[str, Any]] = field(default_factory=list)
+    pod_affinity_required: List[PodAffinityTerm] = field(default_factory=list)
+    pod_affinity_preferred: List[PodAffinityTerm] = field(default_factory=list)
+    pod_anti_affinity_required: List[PodAffinityTerm] = field(default_factory=list)
+    pod_anti_affinity_preferred: List[PodAffinityTerm] = field(default_factory=list)
+    topology_spread: List[TopologySpreadConstraint] = field(default_factory=list)
+    host_network: bool = False
+    phase: str = "Pending"
+    raw: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return f"{self.meta.namespace}/{self.meta.name}"
+
+    def requests(self) -> ResourceList:
+        """Effective pod resource requests per the vendored scheduler's
+        computePodResourceRequest (noderesources/fit.go): per-resource
+        max(sum over containers, max over init containers), plus the
+        implicit one-pod slot."""
+        total: ResourceList = {}
+        for c in self.containers:
+            total = add_resource_lists(total, c.requests)
+        for c in self.init_containers:
+            total = max_resource_lists(total, c.requests)
+        total["pods"] = 1
+        return total
+
+    def host_ports(self) -> List[ContainerPort]:
+        return [p for c in self.containers for p in c.ports]
+
+    def gpu_request(self) -> tuple:
+        """(mem_per_gpu, gpu_count) from the gpu-share annotations; (0, 0) if none.
+
+        Reference: pkg/type/open-gpu-share/utils/pod.go GetGpuMemoryAndCountFromPodAnnotation.
+        """
+        anns = self.meta.annotations
+        mem = int(anns.get(ANNO_GPU_MEM, 0) or 0)
+        cnt = int(anns.get(ANNO_GPU_COUNT, 1) or 1) if mem > 0 else 0
+        return (mem, cnt) if mem > 0 else (0, 0)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Pod":
+        meta = ObjectMeta.from_dict(d.get("metadata"))
+        spec = d.get("spec") or {}
+        host_network = bool(spec.get("hostNetwork", False))
+        containers = [Container.from_dict(c, host_network) for c in spec.get("containers") or []]
+        init_containers = [Container.from_dict(c, host_network) for c in spec.get("initContainers") or []]
+        aff = spec.get("affinity") or {}
+        node_aff = aff.get("nodeAffinity") or {}
+        req = node_aff.get("requiredDuringSchedulingIgnoredDuringExecution")
+        pod_aff = aff.get("podAffinity") or {}
+        pod_anti = aff.get("podAntiAffinity") or {}
+        ns = meta.namespace
+
+        def _terms(src, key):
+            return [PodAffinityTerm.from_dict(t, ns) for t in src.get(key) or []]
+
+        def _pref_terms(src, key):
+            return [
+                PodAffinityTerm.from_dict(t.get("podAffinityTerm") or {}, ns, weight=int(t.get("weight", 1)))
+                for t in src.get(key) or []
+            ]
+
+        return cls(
+            meta=meta,
+            node_name=spec.get("nodeName", "") or "",
+            scheduler_name=spec.get("schedulerName") or DEFAULT_SCHEDULER,
+            node_selector=dict(spec.get("nodeSelector") or {}),
+            tolerations=[Toleration.from_dict(t) for t in spec.get("tolerations") or []],
+            containers=containers,
+            init_containers=init_containers,
+            node_affinity_required=(req or {}).get("nodeSelectorTerms") if req else None,
+            node_affinity_preferred=list(
+                node_aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []
+            ),
+            pod_affinity_required=_terms(pod_aff, "requiredDuringSchedulingIgnoredDuringExecution"),
+            pod_affinity_preferred=_pref_terms(pod_aff, "preferredDuringSchedulingIgnoredDuringExecution"),
+            pod_anti_affinity_required=_terms(pod_anti, "requiredDuringSchedulingIgnoredDuringExecution"),
+            pod_anti_affinity_preferred=_pref_terms(pod_anti, "preferredDuringSchedulingIgnoredDuringExecution"),
+            topology_spread=[
+                TopologySpreadConstraint.from_dict(t) for t in spec.get("topologySpreadConstraints") or []
+            ],
+            host_network=host_network,
+            phase=(d.get("status") or {}).get("phase", "Pending"),
+            raw=d,
+        )
+
+    def clone(self) -> "Pod":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class Node:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    allocatable: ResourceList = field(default_factory=dict)
+    capacity: ResourceList = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    unschedulable: bool = False
+    raw: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+    def gpu_info(self) -> tuple:
+        """(gpu_count, mem_per_gpu) for gpu-share nodes, derived from
+        allocatable gpu-count/gpu-mem resources (reference:
+        pkg/type/open-gpu-share/utils/node.go)."""
+        cnt = self.allocatable.get(RES_GPU_COUNT, 0)
+        total_mem = self.allocatable.get(RES_GPU_MEM, 0)
+        return (cnt, total_mem // cnt if cnt else 0)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Node":
+        meta = ObjectMeta.from_dict(d.get("metadata"))
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        alloc = parse_resource_list(status.get("allocatable"))
+        cap = parse_resource_list(status.get("capacity")) or dict(alloc)
+        if "pods" not in alloc:
+            alloc["pods"] = cap.get("pods", MAX_PODS_DEFAULT)
+        return cls(
+            meta=meta,
+            allocatable=alloc,
+            capacity=cap,
+            taints=[Taint.from_dict(t) for t in spec.get("taints") or []],
+            unschedulable=bool(spec.get("unschedulable", False)),
+            raw=d,
+        )
+
+    def clone(self) -> "Node":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class _Workload:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    replicas: int = 1
+    selector: Optional[LabelSelector] = None
+    template: Dict[str, Any] = field(default_factory=dict)
+    raw: Dict[str, Any] = field(default_factory=dict)
+
+    KIND = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]):
+        meta = ObjectMeta.from_dict(d.get("metadata"))
+        spec = d.get("spec") or {}
+        return cls(
+            meta=meta,
+            replicas=int(spec.get("replicas", 1) if spec.get("replicas") is not None else 1),
+            selector=LabelSelector.from_dict(spec.get("selector")),
+            template=spec.get("template") or {},
+            raw=d,
+        )
+
+
+class Deployment(_Workload):
+    KIND = "Deployment"
+
+
+class ReplicaSet(_Workload):
+    KIND = "ReplicaSet"
+
+
+class StatefulSet(_Workload):
+    KIND = "StatefulSet"
+
+
+class DaemonSet(_Workload):
+    KIND = "DaemonSet"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]):
+        obj = super().from_dict(d)
+        obj.replicas = 0  # replica count comes from node predicates
+        return obj
+
+
+@dataclass
+class Job:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    completions: int = 1
+    parallelism: int = 1
+    template: Dict[str, Any] = field(default_factory=dict)
+    raw: Dict[str, Any] = field(default_factory=dict)
+
+    KIND = "Job"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Job":
+        spec = d.get("spec") or {}
+        completions = spec.get("completions")
+        parallelism = spec.get("parallelism")
+        return cls(
+            meta=ObjectMeta.from_dict(d.get("metadata")),
+            completions=int(completions) if completions is not None else 1,
+            parallelism=int(parallelism) if parallelism is not None else 1,
+            template=spec.get("template") or {},
+            raw=d,
+        )
+
+
+@dataclass
+class CronJob:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    job_template: Dict[str, Any] = field(default_factory=dict)
+    raw: Dict[str, Any] = field(default_factory=dict)
+
+    KIND = "CronJob"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "CronJob":
+        spec = d.get("spec") or {}
+        return cls(
+            meta=ObjectMeta.from_dict(d.get("metadata")),
+            job_template=(spec.get("jobTemplate") or {}),
+            raw=d,
+        )
+
+
+@dataclass
+class _Passthrough:
+    """Objects the simulator stores but does not interpret (parity surface)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    raw: Dict[str, Any] = field(default_factory=dict)
+
+    KIND = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]):
+        return cls(meta=ObjectMeta.from_dict(d.get("metadata")), raw=d)
+
+
+class Service(_Passthrough):
+    KIND = "Service"
+
+
+class PodDisruptionBudget(_Passthrough):
+    KIND = "PodDisruptionBudget"
+
+
+class StorageClass(_Passthrough):
+    KIND = "StorageClass"
+
+
+class PersistentVolumeClaim(_Passthrough):
+    KIND = "PersistentVolumeClaim"
+
+
+class ConfigMap(_Passthrough):
+    KIND = "ConfigMap"

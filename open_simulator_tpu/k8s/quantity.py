@@ -1,0 +1,88 @@
+"""Kubernetes resource.Quantity parsing.
+
+Behavioral parity with apimachinery's resource.Quantity for the subset a
+scheduler touches: suffixed decimal/binary quantities ("1500m", "2Gi",
+"100M", "0.5") canonicalized to integer base units.
+
+Canonical base units used across the framework (chosen so every value an
+array will hold stays an exact float32 integer, i.e. < 2**24 in common
+clusters — see encode/snapshot.py):
+
+  cpu                -> millicores  ("2" -> 2000, "1500m" -> 1500)
+  memory / storage   -> MiB, rounded up ("2Gi" -> 2048, "100M" -> 96)
+  everything else    -> plain count ("3" -> 3)
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from fractions import Fraction
+
+_BIN_SUFFIX = {
+    "Ki": 1024,
+    "Mi": 1024**2,
+    "Gi": 1024**3,
+    "Ti": 1024**4,
+    "Pi": 1024**5,
+    "Ei": 1024**6,
+}
+_DEC_SUFFIX = {
+    "n": Fraction(1, 10**9),
+    "u": Fraction(1, 10**6),
+    "m": Fraction(1, 10**3),
+    "": Fraction(1),
+    "k": Fraction(10**3),
+    "M": Fraction(10**6),
+    "G": Fraction(10**9),
+    "T": Fraction(10**12),
+    "P": Fraction(10**15),
+    "E": Fraction(10**18),
+}
+
+_QTY_RE = re.compile(r"^([+-]?[0-9.]+)\s*(Ki|Mi|Gi|Ti|Pi|Ei|[numkMGTPE]?)$")
+
+
+def parse_quantity(value) -> Fraction:
+    """Parse a k8s quantity into an exact Fraction of base units (cores, bytes, counts)."""
+    if isinstance(value, (int, float)):
+        return Fraction(value).limit_denominator(10**9)
+    s = str(value).strip()
+    m = _QTY_RE.match(s)
+    if not m:
+        # Scientific notation ("1e3") is legal in k8s quantities.
+        try:
+            return Fraction(float(s)).limit_denominator(10**9)
+        except ValueError:
+            raise ValueError(f"invalid quantity: {value!r}") from None
+    digits, suffix = m.groups()
+    base = Fraction(digits) if "." not in digits else Fraction(digits)
+    if suffix in _BIN_SUFFIX:
+        return base * _BIN_SUFFIX[suffix]
+    return base * _DEC_SUFFIX[suffix]
+
+
+def cpu_to_milli(value) -> int:
+    """cpu quantity -> integer millicores (ceil, matching k8s MilliValue)."""
+    return int(math.ceil(parse_quantity(value) * 1000))
+
+
+def mem_to_mib(value) -> int:
+    """memory/storage quantity (base bytes) -> integer MiB, rounded up."""
+    return int(math.ceil(parse_quantity(value) / (1024**2)))
+
+
+def count_value(value) -> int:
+    """opaque/extended resource -> integer count (ceil)."""
+    return int(math.ceil(parse_quantity(value)))
+
+
+def format_quantity(base_units: int, unit: str) -> str:
+    """Pretty-print a canonical value for reports ('1500m'->'1.50', MiB->'2.00Gi')."""
+    if unit == "cpu":
+        return f"{base_units / 1000:.2f}"
+    if unit in ("memory", "storage", "ephemeral-storage"):
+        if base_units >= 1024:
+            return f"{base_units / 1024:.2f}Gi"
+        return f"{base_units}Mi"
+    return str(base_units)

@@ -1,0 +1,158 @@
+"""Label selector / node affinity / taint evaluation (host-side).
+
+Pure-Python predicate evaluators with kube-scheduler parity semantics.
+They are used (a) by the snapshot encoder to fold all *static* pod-vs-node
+compatibility (nodeName, nodeSelector, required node affinity, taints,
+unschedulable) into per-compat-class boolean rows — the device then only
+evaluates *dynamic* predicates (resources, ports, pod affinity, spread,
+GPU) per scan step — and (b) by DaemonSet expansion.
+
+Reference behavior mirrored:
+  node affinity / selectors -> vendored nodeaffinity plugin semantics
+  taints                    -> vendored tainttoleration plugin semantics
+  daemonset placement       -> daemon_controller.Predicates
+    (/root/reference/pkg/utils/utils.go:272-314)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+from open_simulator_tpu.k8s.objects import LabelSelector, Taint, Toleration
+
+
+def match_expression(labels: Dict[str, str], expr: Dict[str, Any]) -> bool:
+    """Evaluate one LabelSelectorRequirement / NodeSelectorRequirement."""
+    key = expr.get("key", "")
+    op = expr.get("operator", "In")
+    values = expr.get("values") or []
+    present = key in labels
+    if op == "In":
+        return present and labels[key] in values
+    if op == "NotIn":
+        return not present or labels[key] not in values
+    if op == "Exists":
+        return present
+    if op == "DoesNotExist":
+        return not present
+    if op == "Gt":
+        try:
+            return present and int(labels[key]) > int(values[0])
+        except (ValueError, IndexError):
+            return False
+    if op == "Lt":
+        try:
+            return present and int(labels[key]) < int(values[0])
+        except (ValueError, IndexError):
+            return False
+    return False
+
+
+def labels_match_selector(labels: Dict[str, str], selector: Optional[LabelSelector]) -> bool:
+    """LabelSelector match (matchLabels AND matchExpressions). None selects nothing
+    (k8s semantics for pod-affinity terms); empty selector selects everything."""
+    if selector is None:
+        return False
+    for k, v in selector.match_labels.items():
+        if labels.get(k) != v:
+            return False
+    for expr in selector.match_expressions:
+        if not match_expression(labels, expr):
+            return False
+    return True
+
+
+def node_selector_terms_match(node_labels: Dict[str, str], terms: List[Dict[str, Any]]) -> bool:
+    """nodeSelectorTerms are ORed; matchExpressions within a term are ANDed.
+    Empty/missing terms list matches nothing (k8s NodeSelector semantics)."""
+    if not terms:
+        return False
+    for term in terms:
+        exprs = term.get("matchExpressions") or []
+        fields = term.get("matchFields") or []
+        if not exprs and not fields:
+            # upstream nodeaffinity.NewNodeSelector drops empty terms: they match nothing
+            continue
+        ok = all(match_expression(node_labels, e) for e in exprs)
+        # matchFields only supports metadata.name
+        for f in fields:
+            name = node_labels.get("__node_name__", "")
+            ok = ok and match_expression({"metadata.name": name}, {**f, "key": "metadata.name"})
+        if ok:
+            return True
+    return False
+
+
+def required_node_affinity_match(
+    node_labels: Dict[str, str],
+    node_name: str,
+    node_selector: Dict[str, str],
+    required_terms: Optional[List[Dict[str, Any]]],
+) -> bool:
+    """Combined nodeSelector + requiredDuringScheduling nodeAffinity check
+    (both must pass; matches vendored nodeaffinity.GetRequiredNodeAffinity)."""
+    for k, v in (node_selector or {}).items():
+        if node_labels.get(k) != v:
+            return False
+    if required_terms is not None:
+        labels = dict(node_labels)
+        labels["__node_name__"] = node_name
+        if not node_selector_terms_match(labels, required_terms):
+            return False
+    return True
+
+
+def preferred_node_affinity_score(
+    node_labels: Dict[str, str], preferred_terms: List[Dict[str, Any]]
+) -> float:
+    """Sum of weights of matching preferredDuringScheduling terms (raw, un-normalized).
+
+    The engine min-max normalizes to 0-100 like the vendored NodeAffinity
+    score plugin does via NormalizeScore.
+    """
+    total = 0.0
+    for pref in preferred_terms or []:
+        weight = float(pref.get("weight", 1))
+        term = pref.get("preference") or {}
+        exprs = term.get("matchExpressions") or []
+        if exprs and all(match_expression(node_labels, e) for e in exprs):
+            total += weight
+    return total
+
+
+def _tolerates(taint: Taint, tolerations: Iterable[Toleration]) -> bool:
+    for tol in tolerations:
+        if tol.effect and tol.effect != taint.effect:
+            continue
+        if tol.key == "":
+            if tol.operator == "Exists":
+                return True
+            continue
+        if tol.key != taint.key:
+            continue
+        if tol.operator == "Exists":
+            return True
+        if tol.value == taint.value:  # Equal
+            return True
+    return False
+
+
+def tolerates_taints(
+    taints: List[Taint], tolerations: List[Toleration], effects=("NoSchedule", "NoExecute")
+) -> bool:
+    """True if every taint with a filtering effect is tolerated
+    (PreferNoSchedule never filters — vendored tainttoleration.Filter)."""
+    for taint in taints:
+        if taint.effect in effects and not _tolerates(taint, tolerations):
+            return False
+    return True
+
+
+def intolerable_prefer_taints(taints: List[Taint], tolerations: List[Toleration]) -> int:
+    """Count of un-tolerated PreferNoSchedule taints (vendored
+    tainttoleration score: fewer is better)."""
+    return sum(
+        1
+        for t in taints
+        if t.effect == "PreferNoSchedule" and not _tolerates(t, tolerations)
+    )

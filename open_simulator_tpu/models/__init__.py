@@ -1,0 +1,15 @@
+"""Workload models: the fake controller-manager.
+
+Expands Deployments/ReplicaSets/StatefulSets/DaemonSets/Jobs/CronJobs into
+the Pods kube-controller-manager would create, entirely host-side (pure
+functions over the typed object model). TPU involvement starts after this
+layer, at the snapshot encoder.
+"""
+
+from open_simulator_tpu.models.expand import (
+    expand_app_resources,
+    expand_cluster_pods,
+    expand_daemonsets_for_nodes,
+    expand_workload,
+    daemonset_node_should_run,
+)

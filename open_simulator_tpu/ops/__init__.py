@@ -1,0 +1,30 @@
+"""Filter/Score tensor ops.
+
+Each vendored kube-scheduler plugin (SURVEY.md section 2b) becomes a pure
+function over the snapshot arrays: Filter plugins produce ``[N]`` boolean
+masks, Score plugins produce ``[N]`` float vectors. The engine composes
+them per scan step; XLA fuses the elementwise chains and maps the one-hot
+domain reductions onto the MXU.
+
+Plugin -> op map (reference file in parens):
+
+  NodeUnschedulable            -> static array (encode)
+  NodeName                     -> forced_node fast path (engine)
+  NodeAffinity + nodeSelector  -> compat-class row (encode) + node_affinity_score
+  TaintToleration              -> compat-class row (encode) + taint_toleration_score
+  NodePorts                    -> ports_free (filters.py)
+  NodeResourcesFit             -> fit_per_resource (filters.py; noderesources/fit.go)
+  InterPodAffinity             -> pod_affinity_ok / pod_anti_affinity_ok
+                                  (filters.py; interpodaffinity/filtering.go)
+  PodTopologySpread            -> topology_spread_ok (filters.py;
+                                  podtopologyspread/filtering.go)
+  NodeResourcesBalancedAlloc   -> balanced_allocation_score (scores.py)
+  NodeResourcesFit(LeastAlloc) -> least_allocated_score (scores.py)
+  InterPodAffinity score       -> interpod_preference_score (scores.py)
+  PodTopologySpread score      -> topology_spread_score (scores.py)
+  Simon max-share              -> simon_max_share_score (scores.py; plugin/simon.go:45-68)
+  Open-Gpu-Share               -> gpu_share.py (plugin/open-gpu-share.go)
+"""
+
+from open_simulator_tpu.ops import filters, scores, gpu_share
+from open_simulator_tpu.ops.domains import domain_count, domain_min, same_domain

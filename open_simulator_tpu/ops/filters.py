@@ -1,0 +1,109 @@
+"""Filter ops: each returns an [N] boolean feasibility mask for one pod.
+
+Per-step inputs are the scan carry (dynamic occupancy state) plus the
+current pod's rows gathered from the snapshot arrays. All control flow is
+branchless; padded term slots are neutralized with their `valid` flags.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from open_simulator_tpu.ops.domains import domain_count, domain_min
+
+
+def fit_per_resource(used: jnp.ndarray, alloc: jnp.ndarray, req_p: jnp.ndarray) -> jnp.ndarray:
+    """NodeResourcesFit (vendored noderesources/fit.go:221-283 fitsRequest):
+    [N, R] bool — per-resource feasibility, so reasons can say which
+    resource was insufficient. Zero-allocatable resources fail only if
+    requested (matches k8s: a node that doesn't expose a resource cannot
+    host a pod requesting it)."""
+    return used + req_p[None, :] <= alloc
+
+
+def ports_free(ports_used: jnp.ndarray, pod_ports: jnp.ndarray) -> jnp.ndarray:
+    """NodePorts: no requested (hostPort, protocol) already taken on the node."""
+    conflict = jnp.any(ports_used & pod_ports[None, :], axis=1)
+    return ~conflict
+
+
+def pod_affinity_ok(
+    group_count: jnp.ndarray,   # [N, S] carry
+    topo_onehot: jnp.ndarray,   # [K1, N, D]
+    has_key: jnp.ndarray,       # [K, N]
+    aff_group: jnp.ndarray,     # [A]
+    aff_key: jnp.ndarray,       # [A]
+    aff_valid: jnp.ndarray,     # [A]
+    aff_self: jnp.ndarray,      # [A]
+) -> jnp.ndarray:
+    """InterPodAffinity required terms (vendored interpodaffinity/filtering.go
+    satisfyPodAffinity): every term needs a matching pod in the node's
+    domain; if no pod matches anywhere and the incoming pod matches its own
+    selector, the term passes on nodes that have the topology key
+    (first-pod bootstrap, filtering.go:214-260)."""
+    n = group_count.shape[0]
+    ok = jnp.ones((n,), dtype=bool)
+    for a in range(aff_group.shape[0]):  # A is tiny and static -> unrolled
+        vec = group_count[:, aff_group[a]]
+        dc = domain_count(vec, aff_key[a], topo_onehot)
+        node_has = has_key[aff_key[a]] > 0
+        total = jnp.sum(vec)
+        term_ok = node_has & ((dc > 0) | ((total == 0) & aff_self[a]))
+        ok &= jnp.where(aff_valid[a], term_ok, True)
+    return ok
+
+
+def pod_anti_affinity_ok(
+    group_count: jnp.ndarray,
+    term_block: jnp.ndarray,    # [N, T] carry: anti-affinity domain paint
+    topo_onehot: jnp.ndarray,
+    has_key: jnp.ndarray,
+    anti_group: jnp.ndarray,    # [B]
+    anti_key: jnp.ndarray,      # [B]
+    anti_valid: jnp.ndarray,    # [B]
+    hit_terms_p: jnp.ndarray,   # [T] terms whose selector matches this pod
+) -> jnp.ndarray:
+    """InterPodAffinity required anti-affinity, both directions
+    (filtering.go satisfyPodAntiAffinity + satisfyExistingPodsAntiAffinity):
+      forward: no existing pod matching the incoming pod's term in the domain;
+      reverse: no existing pod whose own anti-affinity term matches the
+      incoming pod, within that term's domain (the [N, T] paint carry)."""
+    n = group_count.shape[0]
+    ok = jnp.ones((n,), dtype=bool)
+    for b in range(anti_group.shape[0]):
+        vec = group_count[:, anti_group[b]]
+        dc = domain_count(vec, anti_key[b], topo_onehot)
+        term_ok = dc == 0
+        ok &= jnp.where(anti_valid[b], term_ok, True)
+    blocked = (term_block @ hit_terms_p.astype(term_block.dtype)) > 0
+    return ok & ~blocked
+
+
+def topology_spread_ok(
+    group_count: jnp.ndarray,
+    topo_onehot: jnp.ndarray,
+    has_key: jnp.ndarray,
+    eligible: jnp.ndarray,      # [N] active & pod's node-affinity class mask
+    spread_group: jnp.ndarray,  # [Cs]
+    spread_key: jnp.ndarray,    # [Cs]
+    spread_skew: jnp.ndarray,   # [Cs]
+    spread_hard: jnp.ndarray,   # [Cs]
+    spread_valid: jnp.ndarray,  # [Cs]
+    self_match: jnp.ndarray,    # [Cs] bool: pod matches its own constraint selector
+) -> jnp.ndarray:
+    """PodTopologySpread DoNotSchedule constraints (vendored
+    podtopologyspread/filtering.go:285-340): for node n,
+    skew = matchNum(domain(n)) + selfMatch - minMatchNum  must be <= maxSkew;
+    nodes without the topology key fail the constraint."""
+    n = group_count.shape[0]
+    ok = jnp.ones((n,), dtype=bool)
+    for c in range(spread_group.shape[0]):
+        vec = group_count[:, spread_group[c]]
+        dc = domain_count(vec, spread_key[c], topo_onehot)
+        elig = eligible & (has_key[spread_key[c]] > 0)
+        min_val, _ = domain_min(vec, spread_key[c], topo_onehot, elig)
+        skew = dc + self_match[c].astype(dc.dtype) - min_val
+        term_ok = (has_key[spread_key[c]] > 0) & (skew <= spread_skew[c])
+        applies = spread_valid[c] & spread_hard[c]
+        ok &= jnp.where(applies, term_ok, True)
+    return ok

@@ -1,0 +1,87 @@
+"""Open-Gpu-Share as tensor ops.
+
+Re-expresses the reference's GPU-share plugin + cache
+(plugin/open-gpu-share.go, pkg/type/open-gpu-share/cache/gpunodeinfo.go)
+on a dense per-device memory array:
+
+  carry gpu_used [N, G]   memory used per device slot
+  node  gpu_cap  [N]      per-device memory capacity (uniform per node)
+        gpu_slot [N, G]   1.0 for real device slots
+
+Filter (open-gpu-share.go:51-81): a node fits a (mem, cnt) request iff it
+has >= cnt devices with free memory >= mem. This is exactly the
+feasibility of the reference's tightest-fit / two-pointer packing
+(gpunodeinfo.go:232-290), because every selected device just needs `mem`.
+
+Assignment on bind: the cnt feasible devices with the least free memory
+(tightest fit), matching the reference's preference for packing; realized
+with a branchless top-k over sort keys.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_BIG = jnp.float32(3.4e38)
+
+
+def gpu_fit(
+    gpu_used: jnp.ndarray,  # [N, G]
+    gpu_cap: jnp.ndarray,   # [N]
+    gpu_slot: jnp.ndarray,  # [N, G]
+    mem_p: jnp.ndarray,     # scalar: per-device memory request
+    cnt_p: jnp.ndarray,     # scalar: device count request
+) -> jnp.ndarray:
+    """[N] bool: node has >= cnt devices with free >= mem. Pods without a
+    GPU request pass everywhere."""
+    free = gpu_cap[:, None] - gpu_used                      # [N, G]
+    feasible_dev = (gpu_slot > 0) & (free >= mem_p)
+    n_feasible = jnp.sum(feasible_dev.astype(jnp.float32), axis=1)
+    ok = n_feasible >= cnt_p
+    return jnp.where(cnt_p > 0, ok, True)
+
+
+def gpu_share_score(
+    gpu_used: jnp.ndarray,
+    gpu_cap: jnp.ndarray,
+    gpu_slot: jnp.ndarray,
+    mem_p: jnp.ndarray,
+    cnt_p: jnp.ndarray,
+    feasible: jnp.ndarray,
+) -> jnp.ndarray:
+    """Score mirrors the plugin's max-share formula on the GPU dimension
+    (open-gpu-share.go:85-110): prefer nodes where the request consumes a
+    larger share of remaining GPU memory (defragmentation bias)."""
+    free_total = jnp.sum(jnp.where(gpu_slot > 0, gpu_cap[:, None] - gpu_used, 0.0), axis=1)
+    want = mem_p * cnt_p
+    avail = free_total - want
+    share = jnp.where(avail > 0, want / jnp.where(avail > 0, avail, 1.0), jnp.where(want > 0, 1.0, 0.0))
+    raw = jnp.clip(share, 0.0, 1.0) * 100.0
+    lo = jnp.min(jnp.where(feasible, raw, _BIG))
+    hi = jnp.max(jnp.where(feasible, raw, -_BIG))
+    rng = hi - lo
+    out = jnp.where(rng > 0, (raw - lo) * 100.0 / jnp.where(rng > 0, rng, 1.0), 0.0)
+    return jnp.where(cnt_p > 0, jnp.where(feasible, out, 0.0), 0.0)
+
+
+def gpu_pick_devices(
+    gpu_used_n: jnp.ndarray,  # [G] used on the chosen node
+    gpu_cap_n: jnp.ndarray,   # scalar per-device capacity
+    gpu_slot_n: jnp.ndarray,  # [G]
+    mem_p: jnp.ndarray,
+    cnt_p: jnp.ndarray,
+    forced_mask: jnp.ndarray,   # [G] pre-pinned device ids (gpu-index annotation)
+    has_forced: jnp.ndarray,    # scalar bool
+) -> jnp.ndarray:
+    """[G] bool: which devices receive `mem_p`. Tightest fit: among feasible
+    devices, pick the cnt with the least free memory (gpunodeinfo.go:232-290
+    single-GPU tightest-fit generalized; honors a pre-pinned gpu-index)."""
+    g = gpu_used_n.shape[0]
+    free = gpu_cap_n - gpu_used_n
+    feasible = (gpu_slot_n > 0) & (free >= mem_p)
+    key = jnp.where(feasible, free, _BIG)             # prefer least free
+    order = jnp.argsort(key)                           # ascending
+    rank = jnp.zeros((g,), dtype=jnp.int32).at[order].set(jnp.arange(g, dtype=jnp.int32))
+    pick = feasible & (rank < cnt_p.astype(jnp.int32))
+    pick = jnp.where(has_forced, forced_mask, pick)
+    return pick & (cnt_p > 0)

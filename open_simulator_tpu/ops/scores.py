@@ -1,0 +1,151 @@
+"""Score ops: each returns an [N] float32 vector, higher = better.
+
+Weights and normalization mirror the v1beta2 default Score plugin set plus
+the appended Simon plugin (reference: default_plugins.go:30-100,
+pkg/simulator/utils.go:332-343, plugin/simon.go:45-101). All scores are
+produced on the 0..100 scale of the scheduler framework before weighting.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from open_simulator_tpu.ops.domains import domain_count
+
+MAX_SCORE = jnp.float32(100.0)
+_EPS = jnp.float32(1e-9)
+
+
+def minmax_normalize(raw: jnp.ndarray, feasible: jnp.ndarray) -> jnp.ndarray:
+    """Framework NormalizeScore (min-max to 0..100) over feasible nodes
+    (plugin/simon.go:76-101, interpodaffinity NormalizeScore)."""
+    big = jnp.float32(3.4e38)
+    lo = jnp.min(jnp.where(feasible, raw, big))
+    hi = jnp.max(jnp.where(feasible, raw, -big))
+    rng = hi - lo
+    out = jnp.where(rng > 0, (raw - lo) * MAX_SCORE / jnp.where(rng > 0, rng, 1.0), 0.0)
+    return jnp.where(feasible, out, 0.0)
+
+
+def max_normalize(raw: jnp.ndarray, feasible: jnp.ndarray, reverse: bool = False) -> jnp.ndarray:
+    """helper.DefaultNormalizeScore: scale by max; reverse flips so that
+    smaller raw = higher score (used by TaintToleration)."""
+    hi = jnp.max(jnp.where(feasible, raw, 0.0))
+    scaled = jnp.where(hi > 0, raw * MAX_SCORE / jnp.where(hi > 0, hi, 1.0), 0.0)
+    out = MAX_SCORE - scaled if reverse else scaled
+    return jnp.where(feasible, out, 0.0)
+
+
+def least_allocated_score(
+    used: jnp.ndarray, alloc: jnp.ndarray, req_p: jnp.ndarray, cpu_mem_idx
+) -> jnp.ndarray:
+    """NodeResourcesFit default LeastAllocated strategy over cpu+memory
+    (vendored noderesources/least_allocated.go): mean of free fractions x100."""
+    total = jnp.float32(0.0)
+    for r in cpu_mem_idx:
+        cap = alloc[:, r]
+        free = cap - used[:, r] - req_p[r]
+        frac = jnp.where(cap > 0, jnp.clip(free, 0.0) / jnp.where(cap > 0, cap, 1.0), 0.0)
+        total = total + frac
+    return total * MAX_SCORE / len(cpu_mem_idx)
+
+
+def balanced_allocation_score(
+    used: jnp.ndarray, alloc: jnp.ndarray, req_p: jnp.ndarray, cpu_mem_idx
+) -> jnp.ndarray:
+    """NodeResourcesBalancedAllocation (balanced_allocation.go): score =
+    (1 - std(requested fractions)) x 100 over cpu+memory."""
+    fracs = []
+    for r in cpu_mem_idx:
+        cap = alloc[:, r]
+        want = used[:, r] + req_p[r]
+        fracs.append(jnp.where(cap > 0, want / jnp.where(cap > 0, cap, 1.0), 0.0))
+    stacked = jnp.stack(fracs)                      # [2, N]
+    mean = jnp.mean(stacked, axis=0)
+    var = jnp.mean((stacked - mean[None, :]) ** 2, axis=0)
+    std = jnp.sqrt(var)
+    return (1.0 - std) * MAX_SCORE
+
+
+def simon_max_share_score(alloc: jnp.ndarray, req_p: jnp.ndarray, feasible: jnp.ndarray) -> jnp.ndarray:
+    """Simon plugin Score (plugin/simon.go:45-68): bin-packing preference.
+    raw = max over resources of share(req_r, alloc_r - req_r), where
+    share(a, t) = a/t, with 0/0 = 0 and a/0 = 1 (pkg/algo/greed.go Share).
+    Note the reference reads *static* node allocatable (the fake apiserver
+    never decrements it), so this score is deliberately usage-independent.
+    Min-max normalized like the plugin's NormalizeScore."""
+    avail = alloc - req_p[None, :]
+    requested = jnp.broadcast_to(req_p[None, :], alloc.shape)
+    share = jnp.where(
+        avail != 0,
+        requested / jnp.where(avail != 0, avail, 1.0),
+        jnp.where(requested != 0, 1.0, 0.0),
+    )
+    share = jnp.where(requested > 0, jnp.clip(share, 0.0, 1.0), 0.0)
+    raw = jnp.max(share, axis=1) * MAX_SCORE
+    return minmax_normalize(raw, feasible)
+
+
+def node_affinity_score(class_na_row: jnp.ndarray, feasible: jnp.ndarray) -> jnp.ndarray:
+    """NodeAffinity score: preferred-term weight sum, max-normalized
+    (vendored nodeaffinity plugin + DefaultNormalizeScore)."""
+    return max_normalize(class_na_row, feasible)
+
+
+def taint_toleration_score(class_tt_row: jnp.ndarray, feasible: jnp.ndarray) -> jnp.ndarray:
+    """TaintToleration score: fewer intolerable PreferNoSchedule taints is
+    better (vendored tainttoleration.go CountIntolerableTaintsPreferNoSchedule
+    + reversed DefaultNormalizeScore)."""
+    return max_normalize(class_tt_row, feasible, reverse=True)
+
+
+def interpod_preference_score(
+    group_count: jnp.ndarray,
+    topo_onehot: jnp.ndarray,
+    has_key: jnp.ndarray,
+    pref_group: jnp.ndarray,   # [Ap]
+    pref_key: jnp.ndarray,     # [Ap]
+    pref_weight: jnp.ndarray,  # [Ap] (negative = anti)
+    pref_valid: jnp.ndarray,   # [Ap]
+    feasible: jnp.ndarray,
+) -> jnp.ndarray:
+    """InterPodAffinity score, incoming-pod direction (vendored
+    interpodaffinity/scoring.go): sum over preferred terms of
+    weight x (#matching pods in the node's domain), min-max normalized.
+    The existing-pods direction (their preferred terms toward this pod) is
+    not yet modeled; see ROADMAP."""
+    n = group_count.shape[0]
+    raw = jnp.zeros((n,), dtype=jnp.float32)
+    for a in range(pref_group.shape[0]):
+        vec = group_count[:, pref_group[a]]
+        dc = domain_count(vec, pref_key[a], topo_onehot)
+        contrib = pref_weight[a] * dc * (has_key[pref_key[a]] > 0)
+        raw = raw + jnp.where(pref_valid[a], contrib, 0.0)
+    return minmax_normalize(raw, feasible)
+
+
+def topology_spread_score(
+    group_count: jnp.ndarray,
+    topo_onehot: jnp.ndarray,
+    has_key: jnp.ndarray,
+    spread_group: jnp.ndarray,
+    spread_key: jnp.ndarray,
+    spread_valid: jnp.ndarray,
+    feasible: jnp.ndarray,
+) -> jnp.ndarray:
+    """PodTopologySpread score over the pod's constraints (soft + hard both
+    contribute to spreading preference): fewer matching pods in the node's
+    domain = higher score. Reverse-min-max normalized x100. This captures
+    the vendored scoring's spreading direction without its two-pass
+    per-topology normalization (scoring.go:180-260) — an intentional
+    simplification, flagged in ROADMAP."""
+    n = group_count.shape[0]
+    raw = jnp.zeros((n,), dtype=jnp.float32)
+    any_valid = jnp.zeros((), dtype=bool)
+    for c in range(spread_group.shape[0]):
+        vec = group_count[:, spread_group[c]]
+        dc = domain_count(vec, spread_key[c], topo_onehot)
+        raw = raw + jnp.where(spread_valid[c], dc, 0.0)
+        any_valid |= spread_valid[c]
+    score = minmax_normalize(-raw, feasible)
+    return jnp.where(any_valid, score, 0.0)

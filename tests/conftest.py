@@ -1,0 +1,66 @@
+"""Test environment: force an 8-device virtual CPU mesh before jax imports.
+
+Multi-chip hardware is not available in CI; sharding tests exercise the
+same pjit/GSPMD paths on XLA:CPU with 8 virtual devices (the driver's
+dryrun_multichip does the same for the multi-chip path).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import pytest  # noqa: E402
+
+from open_simulator_tpu.k8s.objects import Node, Pod  # noqa: E402
+
+
+def make_node(name, cpu_m=4000, mem_mib=8192, pods=110, labels=None, taints=None,
+              unschedulable=False, extra_alloc=None):
+    alloc = {"cpu": f"{cpu_m}m", "memory": f"{mem_mib}Mi", "pods": pods}
+    alloc.update(extra_alloc or {})
+    return Node.from_dict({
+        "metadata": {"name": name, "labels": labels or {}},
+        "status": {"allocatable": alloc},
+        "spec": {"taints": taints or [], "unschedulable": unschedulable},
+    })
+
+
+def make_pod(name, cpu="500m", mem="512Mi", ns="default", labels=None, annotations=None,
+             node_selector=None, tolerations=None, affinity=None, node_name="",
+             host_ports=None, spread=None, scheduler=None):
+    containers = [{
+        "name": "c", "image": "nginx",
+        "resources": {"requests": {"cpu": cpu, "memory": mem}},
+        "ports": [{"hostPort": p} for p in (host_ports or [])],
+    }]
+    spec = {"containers": containers}
+    if node_selector:
+        spec["nodeSelector"] = node_selector
+    if tolerations:
+        spec["tolerations"] = tolerations
+    if affinity:
+        spec["affinity"] = affinity
+    if node_name:
+        spec["nodeName"] = node_name
+    if spread:
+        spec["topologySpreadConstraints"] = spread
+    if scheduler:
+        spec["schedulerName"] = scheduler
+    return Pod.from_dict({
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {},
+                     "annotations": annotations or {}},
+        "spec": spec,
+    })
+
+
+@pytest.fixture
+def node_factory():
+    return make_node
+
+
+@pytest.fixture
+def pod_factory():
+    return make_pod

@@ -1,0 +1,109 @@
+"""Engine end-to-end basics: fit, selectors, taints, forced binds, reasons.
+
+The invariant-checking style follows the reference's single integration
+test (pkg/simulator/core_test.go): schedule, then independently recount
+what must be true of the placement.
+"""
+
+import numpy as np
+
+from open_simulator_tpu.core import AppResource, simulate
+from open_simulator_tpu.k8s.loader import ClusterResources
+from tests.conftest import make_node, make_pod
+
+
+def run(nodes, pods, cluster_pods=(), **kw):
+    cluster = ClusterResources()
+    cluster.nodes = list(nodes)
+    cluster.pods = list(cluster_pods)
+    app = ClusterResources()
+    app.pods = list(pods)
+    return simulate(cluster, [AppResource(name="app", resources=app)], **kw)
+
+
+def test_basic_fit_and_spread_across_nodes():
+    nodes = [make_node("n0"), make_node("n1")]
+    res = run(nodes, [make_pod(f"p{i}") for i in range(6)])
+    assert not res.unscheduled_pods
+    by_node = {ns.node.name: len(ns.pods) for ns in res.node_status}
+    # least-allocated + balanced scoring should spread 6 identical pods 3/3
+    assert by_node == {"n0": 3, "n1": 3}
+
+
+def test_capacity_exhaustion_reports_insufficient_cpu():
+    nodes = [make_node("n0", cpu_m=1000)]
+    res = run(nodes, [make_pod(f"p{i}", cpu="600m") for i in range(2)])
+    assert len(res.scheduled_pods) == 1
+    assert len(res.unscheduled_pods) == 1
+    assert "Insufficient cpu" in res.unscheduled_pods[0].reason
+    assert res.unscheduled_pods[0].reason.startswith("0/1 nodes are available")
+
+
+def test_node_selector_and_taints():
+    nodes = [
+        make_node("plain"),
+        make_node("ssd", labels={"disk": "ssd"}),
+        make_node("master", taints=[{"key": "node-role.kubernetes.io/master", "effect": "NoSchedule"}]),
+    ]
+    pods = [
+        make_pod("want-ssd", node_selector={"disk": "ssd"}),
+        make_pod("tolerant", tolerations=[{"key": "node-role.kubernetes.io/master", "operator": "Exists",
+                                           "effect": "NoSchedule"}],
+                 node_selector={"__none__": "x"}),
+    ]
+    res = run(nodes, pods)
+    placements = res.placements()
+    assert placements["default/want-ssd"] == "ssd"
+    # tolerant pod has an impossible selector -> unscheduled with affinity reason
+    assert len(res.unscheduled_pods) == 1
+    assert "node affinity" in res.unscheduled_pods[0].reason
+
+
+def test_forced_node_binds_and_consumes_capacity():
+    nodes = [make_node("n0", cpu_m=1000)]
+    pinned = make_pod("pinned", cpu="800m", node_name="n0")
+    free = make_pod("free", cpu="800m")
+    res = run(nodes, [free], cluster_pods=[pinned])
+    placements = res.placements()
+    assert placements["default/pinned"] == "n0"
+    # pinned consumed 800m of 1000m; free cannot fit
+    assert [u.pod.meta.name for u in res.unscheduled_pods] == ["free"]
+    assert "Insufficient cpu" in res.unscheduled_pods[0].reason
+
+
+def test_unschedulable_node_is_skipped():
+    nodes = [make_node("up"), make_node("down", unschedulable=True)]
+    res = run(nodes, [make_pod(f"p{i}") for i in range(4)])
+    assert not res.unscheduled_pods
+    assert all(sp.node_name == "up" for sp in res.scheduled_pods)
+
+
+def test_host_port_conflicts():
+    nodes = [make_node("n0"), make_node("n1")]
+    pods = [make_pod(f"web{i}", host_ports=[8080]) for i in range(3)]
+    res = run(nodes, pods)
+    assert len(res.scheduled_pods) == 2
+    assert len(res.unscheduled_pods) == 1
+    assert "free ports" in res.unscheduled_pods[0].reason
+    used = [sp.node_name for sp in res.scheduled_pods]
+    assert sorted(used) == ["n0", "n1"]
+
+
+def test_pods_allocatable_limit():
+    nodes = [make_node("n0", pods=2)]
+    res = run(nodes, [make_pod(f"p{i}", cpu="1m", mem="1Mi") for i in range(3)])
+    assert len(res.scheduled_pods) == 2
+    assert "Insufficient pods" in res.unscheduled_pods[0].reason
+
+
+def test_invariant_recount():
+    """Every scheduled pod's requests fit within its node's allocatable."""
+    nodes = [make_node(f"n{i}", cpu_m=2000, mem_mib=2048) for i in range(4)]
+    res = run(nodes, [make_pod(f"p{i}", cpu="700m", mem="700Mi") for i in range(10)])
+    per_node_cpu = {}
+    for sp in res.scheduled_pods:
+        per_node_cpu[sp.node_name] = per_node_cpu.get(sp.node_name, 0) + sp.pod.requests()["cpu"]
+    for name, used in per_node_cpu.items():
+        assert used <= 2000, f"{name} over-packed: {used}m"
+    assert len(res.scheduled_pods) == 8  # 2 per node fit
+    assert len(res.unscheduled_pods) == 2

@@ -1,0 +1,58 @@
+"""Regression tests for k8s-parity semantics found in review."""
+
+from open_simulator_tpu.k8s.objects import Pod, Taint, Toleration
+from open_simulator_tpu.k8s.selectors import node_selector_terms_match, tolerates_taints
+
+
+def test_toleration_missing_operator_defaults_to_equal():
+    # {key, effect} with no operator tolerates only `dedicated=` (empty value),
+    # NOT dedicated=gpu — k8s defaults operator to Equal.
+    tol = Toleration.from_dict({"key": "dedicated", "effect": "NoSchedule"})
+    assert tol.operator == "Equal" and tol.value == ""
+    gpu_taint = Taint(key="dedicated", value="gpu", effect="NoSchedule")
+    empty_taint = Taint(key="dedicated", value="", effect="NoSchedule")
+    assert not tolerates_taints([gpu_taint], [tol])
+    assert tolerates_taints([empty_taint], [tol])
+
+
+def test_init_containers_max_semantics():
+    pod = Pod.from_dict({
+        "metadata": {"name": "p"},
+        "spec": {
+            "containers": [{"name": "c", "resources": {"requests": {"cpu": "100m", "memory": "64Mi"}}}],
+            "initContainers": [
+                {"name": "i1", "resources": {"requests": {"cpu": "4", "memory": "8Gi"}}},
+                {"name": "i2", "resources": {"requests": {"cpu": "2"}}},
+            ],
+        },
+    })
+    req = pod.requests()
+    assert req["cpu"] == 4000      # max(100, 4000, 2000)
+    assert req["memory"] == 8192   # max(64, 8192)
+
+
+def test_empty_node_selector_term_matches_nothing():
+    assert not node_selector_terms_match({"zone": "a"}, [{}])
+    # but a valid sibling term still matches (OR semantics)
+    terms = [{}, {"matchExpressions": [{"key": "zone", "operator": "In", "values": ["a"]}]}]
+    assert node_selector_terms_match({"zone": "a"}, terms)
+
+
+def test_gpu_resource_form_participates_in_fit():
+    from open_simulator_tpu.core import AppResource, simulate
+    from open_simulator_tpu.k8s.loader import ClusterResources
+    from tests.conftest import make_node, make_pod
+
+    # node without GPUs; pod requests the gpu-mem *resource* form
+    cluster = ClusterResources()
+    cluster.nodes = [make_node("cpu-only")]
+    app = ClusterResources()
+    pod = Pod.from_dict({
+        "metadata": {"name": "gpu-pod", "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "resources": {
+            "requests": {"cpu": "100m", "alibabacloud.com/gpu-mem": "8"}}}]},
+    })
+    app.pods = [pod]
+    res = simulate(cluster, [AppResource(name="a", resources=app)])
+    assert len(res.unscheduled_pods) == 1
+    assert "Insufficient alibabacloud.com/gpu-mem" in res.unscheduled_pods[0].reason

@@ -1,0 +1,49 @@
+"""Quantity parsing parity with apimachinery resource.Quantity."""
+
+import pytest
+
+from open_simulator_tpu.k8s.quantity import cpu_to_milli, mem_to_mib, count_value, parse_quantity
+
+
+@pytest.mark.parametrize(
+    "raw,milli",
+    [
+        ("1500m", 1500),
+        ("2", 2000),
+        (2, 2000),
+        ("0.5", 500),
+        ("100m", 100),
+        ("3.5", 3500),
+        ("1", 1000),
+        (0.25, 250),
+    ],
+)
+def test_cpu(raw, milli):
+    assert cpu_to_milli(raw) == milli
+
+
+@pytest.mark.parametrize(
+    "raw,mib",
+    [
+        ("2Gi", 2048),
+        ("512Mi", 512),
+        ("1024Ki", 1),
+        ("100M", 96),  # 100e6 bytes -> ceil MiB
+        ("1G", 954),
+        ("1Ti", 1024 * 1024),
+        ("0", 0),
+    ],
+)
+def test_memory(raw, mib):
+    assert mem_to_mib(raw) == mib
+
+
+def test_counts_and_sci():
+    assert count_value("3") == 3
+    assert count_value("2k") == 2000
+    assert float(parse_quantity("1e3")) == 1000.0
+
+
+def test_invalid():
+    with pytest.raises(ValueError):
+        parse_quantity("abc")

@@ -1,0 +1,70 @@
+"""Selector / taint / affinity evaluator semantics."""
+
+from open_simulator_tpu.k8s.objects import LabelSelector, Taint, Toleration
+from open_simulator_tpu.k8s.selectors import (
+    intolerable_prefer_taints,
+    labels_match_selector,
+    match_expression,
+    node_selector_terms_match,
+    required_node_affinity_match,
+    tolerates_taints,
+)
+
+
+def test_match_expression_ops():
+    labels = {"env": "prod", "tier": "3"}
+    assert match_expression(labels, {"key": "env", "operator": "In", "values": ["prod", "dev"]})
+    assert not match_expression(labels, {"key": "env", "operator": "NotIn", "values": ["prod"]})
+    assert match_expression(labels, {"key": "missing", "operator": "NotIn", "values": ["x"]})
+    assert match_expression(labels, {"key": "env", "operator": "Exists"})
+    assert match_expression(labels, {"key": "nope", "operator": "DoesNotExist"})
+    assert match_expression(labels, {"key": "tier", "operator": "Gt", "values": ["2"]})
+    assert not match_expression(labels, {"key": "tier", "operator": "Lt", "values": ["2"]})
+
+
+def test_label_selector():
+    sel = LabelSelector(match_labels={"app": "db"},
+                        match_expressions=[{"key": "ver", "operator": "In", "values": ["2"]}])
+    assert labels_match_selector({"app": "db", "ver": "2"}, sel)
+    assert not labels_match_selector({"app": "db", "ver": "1"}, sel)
+    assert not labels_match_selector({"app": "db"}, sel)
+    # None selects nothing; empty selector selects everything
+    assert not labels_match_selector({"a": "b"}, None)
+    assert labels_match_selector({"a": "b"}, LabelSelector())
+
+
+def test_node_selector_terms_or_semantics():
+    terms = [
+        {"matchExpressions": [{"key": "zone", "operator": "In", "values": ["a"]}]},
+        {"matchExpressions": [{"key": "zone", "operator": "In", "values": ["b"]}]},
+    ]
+    assert node_selector_terms_match({"zone": "b"}, terms)
+    assert not node_selector_terms_match({"zone": "c"}, terms)
+    assert not node_selector_terms_match({"zone": "a"}, [])  # empty matches nothing
+
+
+def test_required_affinity_plus_selector():
+    terms = [{"matchExpressions": [{"key": "role", "operator": "DoesNotExist"}]}]
+    assert required_node_affinity_match({"disk": "ssd"}, "n1", {"disk": "ssd"}, terms)
+    assert not required_node_affinity_match({"disk": "ssd", "role": "x"}, "n1", {"disk": "ssd"}, terms)
+    assert not required_node_affinity_match({"disk": "hdd"}, "n1", {"disk": "ssd"}, None)
+
+
+def test_taints_tolerations():
+    master = Taint(key="node-role.kubernetes.io/master", effect="NoSchedule")
+    prefer = Taint(key="other", effect="PreferNoSchedule")
+    assert not tolerates_taints([master], [])
+    assert tolerates_taints([master], [Toleration(key="node-role.kubernetes.io/master", operator="Exists",
+                                                  effect="NoSchedule")])
+    # empty-key Exists tolerates everything
+    assert tolerates_taints([master], [Toleration(key="", operator="Exists")])
+    # effect "" matches all effects
+    assert tolerates_taints([master], [Toleration(key="node-role.kubernetes.io/master", operator="Exists")])
+    # PreferNoSchedule does not hard-filter
+    assert tolerates_taints([prefer], [])
+    assert intolerable_prefer_taints([prefer], []) == 1
+    assert intolerable_prefer_taints([prefer], [Toleration(key="other", operator="Exists")]) == 0
+    # Equal operator matches value
+    t = Taint(key="k", value="v", effect="NoSchedule")
+    assert tolerates_taints([t], [Toleration(key="k", operator="Equal", value="v")])
+    assert not tolerates_taints([t], [Toleration(key="k", operator="Equal", value="w")])
