@@ -1,0 +1,117 @@
+"""Benchmark: batched capacity-planning throughput on the local accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Workload (BASELINE.md config #2/#4 shape): synthetic cluster of --nodes
+nodes, --pods pods with mixed requests + a zone spread constraint, and a
+--scenarios-lane batched sweep (what-if node counts) vmapped on device.
+
+`vs_baseline` compares against the stand-in for the reference's CPU
+engine: the same scan run single-scenario on one XLA:CPU thread-pool
+(measured in a subprocess, smaller pod count, rate extrapolated per pod).
+The reference publishes no numbers (BASELINE.md), so the CPU rate is the
+baseline this repo tracks round over round.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def build(n_nodes: int, n_pods: int, max_new: int):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import __graft_entry__ as ge
+
+    return ge._synthetic_snapshot(n_nodes=n_nodes, n_pods=n_pods, max_new=max_new)
+
+
+def run_batched(snapshot, n_scenarios: int):
+    import jax
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
+    from open_simulator_tpu.parallel.sweep import active_masks_for_counts
+
+    cfg = make_config(snapshot)
+    arrs = device_arrays(snapshot)
+    max_new = snapshot.n_nodes - snapshot.n_real_nodes
+    counts = [min(i % (max_new + 1), max_new) for i in range(n_scenarios)]
+    masks = jnp.asarray(active_masks_for_counts(snapshot, counts))
+
+    fn = jax.jit(jax.vmap(lambda a: schedule_pods(arrs, a, cfg)))
+    out = fn(masks)  # compile + warm
+    jax.block_until_ready(out.node)
+
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = fn(masks)
+        jax.block_until_ready(out.node)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def cpu_baseline_rate(n_nodes: int) -> float:
+    """Single-scenario pods/sec on XLA:CPU (subprocess; own jax init)."""
+    code = f"""
+import json, time, os, sys
+sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import __graft_entry__ as ge
+from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
+snap = ge._synthetic_snapshot(n_nodes={n_nodes}, n_pods=512, max_new=0)
+cfg = make_config(snap)
+arrs = device_arrays(snap)
+out = schedule_pods(arrs, arrs.active, cfg); jax.block_until_ready(out.node)
+t0 = time.perf_counter()
+out = schedule_pods(arrs, arrs.active, cfg); jax.block_until_ready(out.node)
+dt = time.perf_counter() - t0
+print(json.dumps({{"rate": 512 / dt}}))
+"""
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True, timeout=900
+        )
+        for line in res.stdout.strip().splitlines():
+            try:
+                return float(json.loads(line)["rate"])
+            except (json.JSONDecodeError, KeyError):
+                continue
+    except subprocess.TimeoutExpired:
+        pass
+    return 0.0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=1024)
+    ap.add_argument("--pods", type=int, default=2048)
+    ap.add_argument("--scenarios", type=int, default=8)
+    ap.add_argument("--max-new", type=int, default=64)
+    ap.add_argument("--skip-baseline", action="store_true")
+    args = ap.parse_args()
+
+    snapshot = build(args.nodes, args.pods, args.max_new)
+    dt = run_batched(snapshot, args.scenarios)
+    pods_per_sec = args.pods * args.scenarios / dt
+
+    base_rate = 0.0 if args.skip_baseline else cpu_baseline_rate(args.nodes)
+    vs = pods_per_sec / base_rate if base_rate > 0 else 0.0
+
+    print(json.dumps({
+        "metric": f"pods_scheduled_per_sec@{args.nodes}n_x{args.pods}p_x{args.scenarios}s",
+        "value": round(pods_per_sec, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(vs, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""Scenario parallelism: vmap + GSPMD sharding over a device mesh.
+
+The reference's capacity planner re-runs the entire simulation from
+scratch for every candidate node count, with a human in the loop
+(pkg/apply/apply.go:202-258). Here the node-count axis and arbitrary
+what-if scenarios are a *batch dimension*: `vmap` over per-scenario
+active-node masks, sharded across devices with `jax.sharding`
+NamedSharding so XLA GSPMD handles all communication (SURVEY.md
+section 2c: the rebuild's communication backend is GSPMD over ICI/DCN,
+not hand-written collectives).
+
+Mesh axes:
+  "scenario" — data-parallel over what-if scenarios (the throughput axis)
+  "node"     — model-parallel over the cluster's node axis, for clusters
+               too large for one chip's HBM (reduction collectives over
+               argmax/min are inserted by GSPMD)
+"""
+
+from open_simulator_tpu.parallel.sweep import (
+    CapacityPlan,
+    SweepThresholds,
+    batched_schedule,
+    capacity_sweep,
+    make_mesh,
+)

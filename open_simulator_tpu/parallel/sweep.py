@@ -1,0 +1,193 @@
+"""The batched capacity sweep.
+
+"How many nodes of spec X must I add so the app list schedules fully?"
+— the reference answers by interactive bisection, one full sequential
+re-simulation per guess (apply.go:202-258). Here every candidate count is
+one lane of a vmapped batch: encode once with the node axis padded to
+N_real + max_new, give each lane its own active-node mask, and run the
+scan for all lanes simultaneously. The answer is an argmin over lanes
+that satisfy (all pods scheduled) AND (occupancy thresholds).
+
+Thresholds mirror the reference's satisfyResourceSetting
+(apply.go:614-681): cluster-average CPU/memory occupancy percentages
+must stay under MaxCPU/MaxMemory.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from open_simulator_tpu.encode.snapshot import ClusterSnapshot
+from open_simulator_tpu.engine.scheduler import (
+    EngineConfig,
+    ScheduleOutput,
+    device_arrays,
+    schedule_pods,
+)
+
+
+class SweepThresholds(NamedTuple):
+    max_cpu_pct: float = 100.0
+    max_memory_pct: float = 100.0
+
+
+@dataclass
+class CapacityPlan:
+    """The sweep verdict."""
+
+    counts: List[int]                  # candidate new-node counts, as swept
+    all_scheduled: List[bool]          # per candidate
+    cpu_occupancy_pct: List[float]
+    mem_occupancy_pct: List[float]
+    satisfied: List[bool]
+    best_count: Optional[int]          # min satisfying count, None if none
+    nodes_per_scenario: np.ndarray = field(repr=False, default=None)  # [S, P]
+    fail_counts: np.ndarray = field(repr=False, default=None)         # [S, P, OPS]
+
+
+def make_mesh(n_scenario: Optional[int] = None, n_node: int = 1) -> Mesh:
+    """Build a ("scenario", "node") mesh over the available devices.
+    Defaults to all devices on the scenario axis (pure data parallel)."""
+    devs = np.array(jax.devices())
+    if n_scenario is None:
+        n_scenario = len(devs) // n_node
+    devs = devs[: n_scenario * n_node].reshape(n_scenario, n_node)
+    return Mesh(devs, axis_names=("scenario", "node"))
+
+
+def batched_schedule(
+    arrs,
+    active_batch: jnp.ndarray,  # [S, N]
+    cfg: EngineConfig,
+    mesh: Optional[Mesh] = None,
+) -> ScheduleOutput:
+    """vmap the scan over scenario lanes; shard lanes over the mesh.
+
+    The snapshot arrays are broadcast (replicated) across the scenario
+    axis; only the active mask differs per lane. With a mesh, GSPMD
+    shards the lane axis; without, it is a single-device vmap.
+    """
+    fn = jax.vmap(lambda a: schedule_pods(arrs, a, cfg))
+    if mesh is not None and not mesh.empty:
+        lane = NamedSharding(mesh, P("scenario"))
+        fn = jax.jit(
+            fn,
+            in_shardings=(NamedSharding(mesh, P("scenario", None)),),
+            out_shardings=ScheduleOutput(
+                node=lane, fail_counts=lane, feasible=lane,
+                state=jax.tree_util.tree_map(lambda _: lane, _state_proto(arrs)),
+            ),
+        )
+        active_batch = jax.device_put(active_batch, NamedSharding(mesh, P("scenario", None)))
+    else:
+        fn = jax.jit(fn)
+    return fn(active_batch)
+
+
+def _state_proto(arrs):
+    from open_simulator_tpu.engine.scheduler import init_state
+
+    return init_state(arrs)
+
+
+def shard_arrays(arrs, mesh: Mesh):
+    """Place the snapshot arrays on the mesh with the node axis sharded
+    over the "node" mesh axis (model parallelism for clusters whose state
+    exceeds one chip's HBM). Pod-axis and vocab arrays are replicated;
+    GSPMD inserts the all-gathers/argmax reductions the scan step needs.
+
+    The node-axis position is declared explicitly per array (shape
+    heuristics would misfire when P happens to equal N).
+    """
+    node_first = {"alloc", "active", "is_new_node", "gpu_cap_mem", "gpu_count", "gpu_slot",
+                  "unschedulable"}
+    node_second = {"topo_onehot", "has_key", "class_affinity", "class_taint",
+                   "class_node_aff_score", "class_taint_prefer"}
+
+    def spec_for(name: str, x) -> P:
+        if name in node_first:
+            return P("node", *([None] * (x.ndim - 1)))
+        if name in node_second:
+            return P(None, "node", *([None] * (x.ndim - 2)))
+        return P(*([None] * x.ndim))
+
+    import dataclasses
+
+    placed = {}
+    for f in dataclasses.fields(arrs):
+        x = getattr(arrs, f.name)
+        placed[f.name] = jax.device_put(x, NamedSharding(mesh, spec_for(f.name, x)))
+    return type(arrs)(**placed)
+
+
+def active_masks_for_counts(snapshot: ClusterSnapshot, counts: Sequence[int]) -> np.ndarray:
+    """[S, N] lane masks: all real nodes + the first c padded new-node slots."""
+    n = snapshot.n_nodes
+    n_real = snapshot.n_real_nodes
+    max_new = n - n_real
+    masks = np.zeros((len(counts), n), dtype=bool)
+    for si, c in enumerate(counts):
+        if c > max_new:
+            raise ValueError(f"count {c} exceeds padded new-node slots ({max_new})")
+        masks[si, :n_real] = True
+        masks[si, n_real : n_real + c] = True
+    return masks
+
+
+def capacity_sweep(
+    snapshot: ClusterSnapshot,
+    cfg: EngineConfig,
+    counts: Sequence[int],
+    thresholds: SweepThresholds = SweepThresholds(),
+    mesh: Optional[Mesh] = None,
+) -> CapacityPlan:
+    """Run the full sweep and pick the smallest satisfying node count."""
+    arrs = device_arrays(snapshot)
+    masks = active_masks_for_counts(snapshot, counts)
+    out = batched_schedule(arrs, jnp.asarray(masks), cfg, mesh=mesh)
+
+    nodes = np.asarray(out.node)               # [S, P]
+    fail = np.asarray(out.fail_counts)         # [S, P, OPS]
+    used = np.asarray(out.state.used)          # [S, N, R]
+    alloc = np.asarray(arrs.alloc)             # [N, R]
+
+    cpu_i = snapshot.resources.index("cpu")
+    mem_i = snapshot.resources.index("memory")
+    all_scheduled, cpu_occ, mem_occ, satisfied = [], [], [], []
+    for si in range(len(counts)):
+        lane_active = masks[si]
+        ok = bool(np.all(nodes[si] >= 0))
+        tot_cpu = float(np.sum(alloc[lane_active, cpu_i]))
+        tot_mem = float(np.sum(alloc[lane_active, mem_i]))
+        u_cpu = float(np.sum(used[si][lane_active, cpu_i]))
+        u_mem = float(np.sum(used[si][lane_active, mem_i]))
+        c_pct = 100.0 * u_cpu / tot_cpu if tot_cpu else 0.0
+        m_pct = 100.0 * u_mem / tot_mem if tot_mem else 0.0
+        sat = ok and c_pct <= thresholds.max_cpu_pct and m_pct <= thresholds.max_memory_pct
+        all_scheduled.append(ok)
+        cpu_occ.append(c_pct)
+        mem_occ.append(m_pct)
+        satisfied.append(sat)
+
+    best = None
+    for si in sorted(range(len(counts)), key=lambda i: counts[i]):
+        if satisfied[si]:
+            best = counts[si]
+            break
+    return CapacityPlan(
+        counts=list(counts),
+        all_scheduled=all_scheduled,
+        cpu_occupancy_pct=cpu_occ,
+        mem_occupancy_pct=mem_occ,
+        satisfied=satisfied,
+        best_count=best,
+        nodes_per_scenario=nodes,
+        fail_counts=fail,
+    )

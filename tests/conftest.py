@@ -1,16 +1,23 @@
-"""Test environment: force an 8-device virtual CPU mesh before jax imports.
+"""Test environment: force an 8-device virtual CPU mesh.
 
 Multi-chip hardware is not available in CI; sharding tests exercise the
 same pjit/GSPMD paths on XLA:CPU with 8 virtual devices (the driver's
 dryrun_multichip does the same for the multi-chip path).
+
+NOTE: this jax build's axon TPU plugin ignores JAX_PLATFORMS/
+JAX_PLATFORM_NAME env vars — `jax.config.update` after import is the only
+reliable way to select the CPU backend.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

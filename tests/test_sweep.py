@@ -1,0 +1,62 @@
+"""Capacity sweep + sharded scenario batch on the 8-device virtual mesh."""
+
+import jax
+import numpy as np
+
+from open_simulator_tpu.core import build_pod_sequence, AppResource
+from open_simulator_tpu.encode.snapshot import EncodeOptions, encode_cluster
+from open_simulator_tpu.engine.scheduler import make_config
+from open_simulator_tpu.k8s.loader import ClusterResources, make_valid_node
+from open_simulator_tpu.parallel import capacity_sweep, make_mesh, SweepThresholds
+from tests.conftest import make_node, make_pod
+
+
+def _snapshot(n_pods=12, pod_cpu="1500m", max_new=8):
+    cluster = ClusterResources()
+    cluster.nodes = [make_node("real-0", cpu_m=4000, mem_mib=8192)]
+    app = ClusterResources()
+    app.pods = [make_pod(f"p{i}", cpu=pod_cpu, mem="512Mi") for i in range(n_pods)]
+    pods = build_pod_sequence(cluster, [AppResource(name="a", resources=app)])
+    template = make_node("template", cpu_m=4000, mem_mib=8192)
+    snap = encode_cluster(
+        [make_valid_node(n) for n in cluster.nodes],
+        pods,
+        EncodeOptions(max_new_nodes=max_new, new_node_template=template),
+    )
+    return snap
+
+
+def test_capacity_sweep_finds_min_count():
+    snap = _snapshot()
+    cfg = make_config(snap)
+    plan = capacity_sweep(snap, cfg, counts=list(range(9)))
+    # 12 pods x 1500m = 18000m; each node fits floor(4000/1500)=2 pods.
+    # 12 pods need 6 nodes total -> 5 new nodes.
+    assert plan.best_count == 5
+    assert plan.all_scheduled == [c >= 5 for c in range(9)]
+    # monotone: more nodes never decreases scheduled pods
+    scheduled_counts = [(plan.nodes_per_scenario[s] >= 0).sum() for s in range(9)]
+    assert scheduled_counts == sorted(scheduled_counts)
+
+
+def test_capacity_sweep_occupancy_threshold():
+    snap = _snapshot()
+    cfg = make_config(snap)
+    # Tight CPU occupancy cap forces more headroom than bare fit.
+    plan = capacity_sweep(
+        snap, cfg, counts=list(range(9)), thresholds=SweepThresholds(max_cpu_pct=60.0)
+    )
+    # 18000m total request; need total alloc >= 30000m -> 8 nodes -> 7 new.
+    assert plan.best_count == 7
+
+
+def test_sweep_on_device_mesh_matches_single_device():
+    snap = _snapshot()
+    cfg = make_config(snap)
+    counts = list(range(8))
+    mesh = make_mesh()  # 8 virtual CPU devices on the scenario axis
+    assert mesh.devices.size == len(jax.devices())
+    plan_mesh = capacity_sweep(snap, cfg, counts=counts, mesh=mesh)
+    plan_single = capacity_sweep(snap, cfg, counts=counts)
+    assert plan_mesh.best_count == plan_single.best_count
+    np.testing.assert_array_equal(plan_mesh.nodes_per_scenario, plan_single.nodes_per_scenario)
