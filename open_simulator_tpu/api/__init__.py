@@ -1,0 +1,3 @@
+"""Config file schema (simon/v1alpha1 Config parity)."""
+
+from open_simulator_tpu.api.v1alpha1 import AppListEntry, ClusterConfig, SimonConfig, load_config

@@ -1,0 +1,1 @@
+from open_simulator_tpu.apply.applier import Applier, ApplyOptions

@@ -1,0 +1,254 @@
+"""The capacity planner ("simon apply").
+
+Reference behavior (pkg/apply/apply.go:60-258): load Simon config, build
+cluster + app list + newNode template, then loop { simulate; if
+unscheduled pods remain, ask the user to add N nodes and re-simulate from
+scratch }. Finally check occupancy thresholds and print reports.
+
+TPU-first inversion: by default the add-node loop IS the batch axis — a
+vmapped sweep over candidate counts answers "minimum nodes to add" in one
+device program (parallel/sweep.py). Interactive mode is kept for parity
+(--interactive), and even there each human guess is answered from the
+already-computed sweep when possible.
+
+Env knobs (reference: satisfyResourceSetting, apply.go:614-681):
+  MaxCPU     max average cluster CPU occupancy %, default 100
+  MaxMemory  max average cluster memory occupancy %, default 100
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from open_simulator_tpu.api.v1alpha1 import ConfigError, SimonConfig, load_config
+from open_simulator_tpu.core import (
+    AppResource,
+    SimulateResult,
+    build_pod_sequence,
+    decode_result,
+)
+from open_simulator_tpu.encode.snapshot import EncodeOptions, encode_cluster
+from open_simulator_tpu.engine.scheduler import make_config
+from open_simulator_tpu.k8s.loader import (
+    ClusterResources,
+    load_resources_from_directory,
+    make_valid_node,
+)
+from open_simulator_tpu.k8s.objects import Node
+from open_simulator_tpu.parallel.sweep import SweepThresholds, capacity_sweep
+from open_simulator_tpu.report.tables import full_report
+
+
+@dataclass
+class ApplyOptions:
+    """CLI surface parity (cmd/apply/apply.go:27-36)."""
+
+    config_path: str = ""
+    default_scheduler_config: str = ""   # accepted, engine profile knobs TBD
+    output_file: str = ""
+    use_greed: bool = False
+    interactive: bool = False
+    extended_resources: List[str] = field(default_factory=list)
+    max_new_nodes: int = 128             # sweep upper bound
+
+
+class ApplyError(RuntimeError):
+    pass
+
+
+def _load_new_node_template(path: str) -> Optional[Node]:
+    if not path:
+        return None
+    res = (
+        load_resources_from_directory(path)
+        if os.path.isdir(path)
+        else _load_resources_file(path)
+    )
+    if not res.nodes:
+        raise ApplyError(f"newNode path {path} contains no Node object")
+    if len(res.nodes) > 1:
+        raise ApplyError(f"newNode path {path}: only one node template is supported")
+    return make_valid_node(res.nodes[0])
+
+
+def _load_resources_file(path: str) -> ClusterResources:
+    from open_simulator_tpu.k8s.loader import demux_object, parse_yaml_documents
+
+    res = ClusterResources()
+    with open(path, "r", encoding="utf-8") as f:
+        for doc in parse_yaml_documents(f.read()):
+            demux_object(doc, res)
+    return res
+
+
+class Applier:
+    def __init__(self, options: ApplyOptions):
+        self.opts = options
+        if not options.config_path:
+            raise ApplyError("--simon-config is required")
+        self.config: SimonConfig = load_config(options.config_path)
+        self.base_dir = os.path.dirname(os.path.abspath(options.config_path))
+        self.config.validate(self.base_dir)
+        self._out = sys.stdout
+
+    # ---- inputs --------------------------------------------------------
+
+    def _build_cluster(self) -> ClusterResources:
+        cc = self.config.cluster
+        if cc.kube_config:
+            raise ApplyError(
+                "cluster.kubeConfig requires a live Kubernetes API; this "
+                "environment has no cluster access — use cluster.customConfig "
+                "(or the REST server's snapshot request body)"
+            )
+        path = os.path.join(self.base_dir, cc.custom_config)
+        cluster = load_resources_from_directory(path, strict=False)
+        if not cluster.nodes:
+            raise ApplyError(f"cluster customConfig {path} contains no nodes")
+        cluster.nodes = [make_valid_node(n) for n in cluster.nodes]
+        return cluster
+
+    def _build_apps(self) -> List[AppResource]:
+        apps: List[AppResource] = []
+        for entry in self.config.app_list:
+            path = os.path.join(self.base_dir, entry.path)
+            if entry.chart:
+                from open_simulator_tpu.chart.renderer import process_chart
+
+                docs = process_chart(path)
+                res = ClusterResources()
+                from open_simulator_tpu.k8s.loader import demux_object
+
+                for doc in docs:
+                    demux_object(doc, res)
+                apps.append(AppResource(name=entry.name, resources=res))
+            else:
+                apps.append(
+                    AppResource(name=entry.name, resources=load_resources_from_directory(path))
+                )
+        return apps
+
+    def _thresholds(self) -> SweepThresholds:
+        def env_pct(name: str) -> float:
+            v = os.environ.get(name, "")
+            try:
+                return float(v) if v else 100.0
+            except ValueError:
+                return 100.0
+
+        return SweepThresholds(
+            max_cpu_pct=env_pct("MaxCPU"), max_memory_pct=env_pct("MaxMemory")
+        )
+
+    # ---- run -----------------------------------------------------------
+
+    def run(self) -> int:
+        out_f = None
+        if self.opts.output_file:
+            out_f = open(self.opts.output_file, "w", encoding="utf-8")
+            self._out = out_f
+        try:
+            return self._run_inner()
+        finally:
+            if out_f:
+                out_f.close()
+
+    def _say(self, msg: str = "") -> None:
+        print(msg, file=self._out)
+
+    def _run_inner(self) -> int:
+        cluster = self._build_cluster()
+        apps = self._build_apps()
+        template = _load_new_node_template(
+            os.path.join(self.base_dir, self.config.new_node) if self.config.new_node else ""
+        )
+
+        pods = build_pod_sequence(cluster, apps, use_greed=self.opts.use_greed)
+        max_new = self.opts.max_new_nodes if template is not None else 0
+        snapshot = encode_cluster(
+            cluster.nodes,
+            pods,
+            EncodeOptions(max_new_nodes=max_new, new_node_template=template),
+        )
+        cfg = make_config(snapshot)
+        thresholds = self._thresholds()
+
+        if self.opts.interactive:
+            return self._run_interactive(snapshot, cfg, thresholds, max_new)
+
+        # Batched sweep: candidate counts 0..max_new in one device program.
+        counts = list(range(max_new + 1))
+        plan = capacity_sweep(snapshot, cfg, counts, thresholds)
+        if plan.best_count is None:
+            self._say(
+                f"FAILED: apps do not fit even with {max_new} new node(s) "
+                f"(raise --max-new-nodes or adjust the newNode spec)"
+            )
+            worst = self._result_for(snapshot, plan, len(counts) - 1)
+            self._say(full_report(worst, self.opts.extended_resources))
+            return 1
+
+        best_idx = plan.counts.index(plan.best_count)
+        result = self._result_for(snapshot, plan, best_idx)
+        if plan.best_count > 0:
+            self._say(
+                f"cluster requires {plan.best_count} new node(s) of the given spec "
+                f"to satisfy all apps (swept {len(counts)} candidates in one batch)"
+            )
+        else:
+            self._say("all apps fit on the existing cluster; no new nodes needed")
+        self._say(
+            f"occupancy at chosen size: cpu {plan.cpu_occupancy_pct[best_idx]:.1f}% "
+            f"mem {plan.mem_occupancy_pct[best_idx]:.1f}% "
+            f"(limits: cpu {thresholds.max_cpu_pct:.0f}% mem {thresholds.max_memory_pct:.0f}%)"
+        )
+        self._say()
+        self._say(full_report(result, self.opts.extended_resources))
+        return 0
+
+    def _result_for(self, snapshot, plan, idx: int) -> SimulateResult:
+        from open_simulator_tpu.parallel.sweep import active_masks_for_counts
+
+        masks = active_masks_for_counts(snapshot, plan.counts)
+        return decode_result(
+            snapshot,
+            plan.nodes_per_scenario[idx],
+            plan.fail_counts[idx],
+            masks[idx],
+        )
+
+    def _run_interactive(self, snapshot, cfg, thresholds, max_new: int) -> int:
+        """Parity mode: the reference's prompt loop (apply.go:202-258),
+        answered from one precomputed sweep."""
+        counts = list(range(max_new + 1))
+        plan = capacity_sweep(snapshot, cfg, counts, thresholds)
+        current = 0
+        while True:
+            idx = plan.counts.index(current)
+            result = self._result_for(snapshot, plan, idx)
+            n_failed = len(result.unscheduled_pods)
+            if n_failed == 0:
+                self._say(f"all pods scheduled with {current} new node(s)")
+                self._say(full_report(result, self.opts.extended_resources))
+                return 0
+            self._say(f"{n_failed} pod(s) unschedulable with {current} new node(s)")
+            try:
+                ans = input("[a]dd N nodes / [r]easons / [q]uit > ").strip()
+            except EOFError:
+                return 1
+            if ans.startswith("r"):
+                for up in result.unscheduled_pods:
+                    self._say(f"  {up.pod.key}: {up.reason}")
+            elif ans.startswith("a"):
+                try:
+                    n = int(ans.split()[1]) if len(ans.split()) > 1 else 1
+                except ValueError:
+                    n = 1
+                current = min(current + n, max_new)
+            elif ans.startswith("q"):
+                return 1

@@ -1,0 +1,1 @@
+from open_simulator_tpu.chart.renderer import ChartError, process_chart

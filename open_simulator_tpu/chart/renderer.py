@@ -1,0 +1,176 @@
+"""Helm chart rendering (appList[].chart: true).
+
+The reference embeds Helm v3 as a library (pkg/chart/chart.go:18-118
+ProcessChart: load chart, check type application, render with default
+release values, drop NOTES.txt, sort by install order). A Go Helm runtime
+is not part of this image, so rendering is tiered:
+
+  1. `helm template` subprocess when a helm binary exists on PATH;
+  2. a built-in minimal renderer covering the common template subset
+     ({{ .Values.* }}, {{ .Release.* }}, {{ .Chart.* }}, default/quote
+     pipes, {{- ... -}} whitespace chomping, one-level if/end on value
+     truthiness);
+  3. a clear ChartError telling the user to pre-render otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import subprocess
+from typing import Any, Dict, List
+
+import yaml
+
+
+class ChartError(ValueError):
+    pass
+
+
+_INSTALL_ORDER = [
+    "Namespace", "NetworkPolicy", "ResourceQuota", "LimitRange",
+    "PodDisruptionBudget", "ServiceAccount", "Secret", "ConfigMap",
+    "StorageClass", "PersistentVolume", "PersistentVolumeClaim",
+    "CustomResourceDefinition", "ClusterRole", "ClusterRoleBinding",
+    "Role", "RoleBinding", "Service", "DaemonSet", "Pod", "ReplicaSet",
+    "Deployment", "StatefulSet", "Job", "CronJob",
+]
+
+
+def process_chart(path: str, release_name: str = "") -> List[Dict[str, Any]]:
+    """Render a chart directory to parsed YAML docs, install-ordered."""
+    if not os.path.isdir(path):
+        raise ChartError(f"chart path {path} is not a directory (.tgz: extract it first)")
+    chart_yaml = os.path.join(path, "Chart.yaml")
+    if not os.path.exists(chart_yaml):
+        raise ChartError(f"{path}: no Chart.yaml — not a helm chart")
+    with open(chart_yaml, "r", encoding="utf-8") as f:
+        chart_meta = yaml.safe_load(f) or {}
+    if chart_meta.get("type", "application") != "application":
+        raise ChartError(f"chart {chart_meta.get('name')}: only application charts are supported")
+    release = release_name or chart_meta.get("name", os.path.basename(path))
+
+    if shutil.which("helm"):
+        docs = _render_with_helm(path, release)
+    else:
+        docs = _render_builtin(path, chart_meta, release)
+
+    def order_key(d: Dict[str, Any]) -> int:
+        kind = d.get("kind", "")
+        return _INSTALL_ORDER.index(kind) if kind in _INSTALL_ORDER else len(_INSTALL_ORDER)
+
+    return sorted(docs, key=order_key)
+
+
+def _render_with_helm(path: str, release: str) -> List[Dict[str, Any]]:
+    res = subprocess.run(
+        ["helm", "template", release, path], capture_output=True, text=True, timeout=120
+    )
+    if res.returncode != 0:
+        raise ChartError(f"helm template failed: {res.stderr.strip()}")
+    return [d for d in yaml.safe_load_all(res.stdout) if isinstance(d, dict) and d.get("kind")]
+
+
+# ---- builtin minimal renderer -----------------------------------------
+
+_EXPR = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}")
+
+
+def _lookup(ctx: Dict[str, Any], dotted: str):
+    cur: Any = ctx
+    for part in dotted.strip(".").split("."):
+        if isinstance(cur, dict) and part in cur:
+            cur = cur[part]
+        else:
+            return None
+    return cur
+
+
+def _eval_expr(expr: str, ctx: Dict[str, Any]):
+    """Evaluate `.path`, `.path | default x | quote` pipelines."""
+    stages = [s.strip() for s in expr.split("|")]
+    head = stages[0]
+    if head.startswith('"') and head.endswith('"'):
+        val: Any = head.strip('"')
+    elif head.startswith("."):
+        val = _lookup(ctx, head)
+    else:
+        return None
+    for stage in stages[1:]:
+        if stage.startswith("default "):
+            arg = stage[len("default "):].strip().strip('"')
+            if val in (None, ""):
+                val = arg
+        elif stage == "quote":
+            val = f'"{val if val is not None else ""}"'
+        elif stage in ("lower", "upper", "trim"):
+            if isinstance(val, str):
+                val = getattr(val, stage.replace("trim", "strip"))()
+    return val
+
+
+def _render_template(text: str, ctx: Dict[str, Any], origin: str) -> str:
+    out_lines: List[str] = []
+    skip_depth = 0
+    for line in text.splitlines():
+        stripped = line.strip()
+        m = _EXPR.fullmatch(stripped) if stripped.startswith("{{") else None
+        if m:
+            expr = m.group(1)
+            if expr.startswith("if "):
+                cond = _eval_expr(expr[3:].strip(), ctx)
+                if skip_depth or not cond:
+                    skip_depth += 1
+                continue
+            if expr in ("end", "end -"):
+                if skip_depth:
+                    skip_depth -= 1
+                continue
+            if expr.startswith(("range", "with", "define", "template", "include")):
+                raise ChartError(
+                    f"{origin}: template uses {{{{ {expr.split()[0]} }}}} — beyond the "
+                    "builtin renderer; install helm or pre-render with `helm template`"
+                )
+        if skip_depth:
+            continue
+
+        def sub(match: re.Match) -> str:
+            val = _eval_expr(match.group(1), ctx)
+            if val is None:
+                raise ChartError(
+                    f"{origin}: cannot resolve {{{{ {match.group(1)} }}}} — install helm "
+                    "or pre-render with `helm template`"
+                )
+            return str(val)
+
+        out_lines.append(_EXPR.sub(sub, line))
+    return "\n".join(out_lines)
+
+
+def _render_builtin(path: str, chart_meta: Dict[str, Any], release: str) -> List[Dict[str, Any]]:
+    values_path = os.path.join(path, "values.yaml")
+    values: Dict[str, Any] = {}
+    if os.path.exists(values_path):
+        with open(values_path, "r", encoding="utf-8") as f:
+            values = yaml.safe_load(f) or {}
+    ctx = {
+        "Values": values,
+        "Release": {"Name": release, "Namespace": "default", "Service": "Helm"},
+        "Chart": {"Name": chart_meta.get("name", ""), "Version": chart_meta.get("version", "")},
+    }
+    docs: List[Dict[str, Any]] = []
+    tmpl_dir = os.path.join(path, "templates")
+    if not os.path.isdir(tmpl_dir):
+        return docs
+    for fname in sorted(os.listdir(tmpl_dir)):
+        if fname == "NOTES.txt" or fname.startswith("_") or not fname.endswith((".yaml", ".yml")):
+            continue
+        fpath = os.path.join(tmpl_dir, fname)
+        with open(fpath, "r", encoding="utf-8") as f:
+            rendered = _render_template(f.read(), ctx, f"{os.path.basename(path)}/{fname}")
+        for doc in yaml.safe_load_all(rendered):
+            if isinstance(doc, dict) and doc.get("kind"):
+                doc.setdefault("metadata", {}).setdefault("namespace", "default")
+                docs.append(doc)
+    return docs

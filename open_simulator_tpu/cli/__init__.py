@@ -1,0 +1,1 @@
+from open_simulator_tpu.cli.main import build_parser, main

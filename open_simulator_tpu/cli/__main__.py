@@ -1,0 +1,3 @@
+from open_simulator_tpu.cli.main import main
+
+raise SystemExit(main())

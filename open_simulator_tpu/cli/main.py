@@ -1,0 +1,105 @@
+"""CLI: simon-tpu {apply, server, version, gen-doc}.
+
+Command/flag parity with the reference's cobra tree (cmd/simon/simon.go:27-44,
+cmd/apply/apply.go:27-36, cmd/server/server.go). LogLevel env knob kept.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+from open_simulator_tpu import __version__
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="simon-tpu",
+        description="TPU-native Kubernetes cluster-capacity simulator",
+    )
+    sub = p.add_subparsers(dest="command")
+
+    ap = sub.add_parser("apply", help="run a capacity-planning simulation")
+    ap.add_argument("-f", "--simon-config", required=True, help="simon/v1alpha1 Config file")
+    ap.add_argument("--default-scheduler-config", default="", help="scheduler config file (profile knobs)")
+    ap.add_argument("--output-file", default="", help="redirect the report to a file")
+    ap.add_argument("--use-greed", action="store_true", help="sort app pods by dominant share (big rocks first)")
+    ap.add_argument("-i", "--interactive", action="store_true", help="interactive add-node prompt loop")
+    ap.add_argument("--extended-resources", default="", help="comma list, e.g. gpu")
+    ap.add_argument("--max-new-nodes", type=int, default=128, help="sweep upper bound for added nodes")
+
+    sp = sub.add_parser("server", help="REST simulation server")
+    sp.add_argument("--port", type=int, default=8899)
+    sp.add_argument("--address", default="127.0.0.1")
+    sp.add_argument("--kubeconfig", default="", help="(unsupported here: no live cluster access)")
+    sp.add_argument("--master", default="", help="(unsupported here: no live cluster access)")
+    sp.add_argument("--cluster-config", default="", help="cluster YAML dir serving as the live-cluster stand-in")
+
+    sub.add_parser("version", help="print version")
+
+    gd = sub.add_parser("gen-doc", help="generate markdown docs for the CLI")
+    gd.add_argument("--dir", default="docs/commandline")
+    return p
+
+
+def _init_logging() -> None:
+    level = os.environ.get("LogLevel", "info").lower()
+    logging.basicConfig(
+        level={"debug": logging.DEBUG, "info": logging.INFO, "warn": logging.WARNING,
+               "error": logging.ERROR}.get(level, logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+
+def main(argv=None) -> int:
+    _init_logging()
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if args.command == "version":
+        print(f"simon-tpu version {__version__}")
+        return 0
+
+    if args.command == "apply":
+        from open_simulator_tpu.apply.applier import Applier, ApplyOptions
+
+        opts = ApplyOptions(
+            config_path=args.simon_config,
+            default_scheduler_config=args.default_scheduler_config,
+            output_file=args.output_file,
+            use_greed=args.use_greed,
+            interactive=args.interactive,
+            extended_resources=[s for s in args.extended_resources.split(",") if s],
+            max_new_nodes=args.max_new_nodes,
+        )
+        try:
+            return Applier(opts).run()
+        except Exception as e:  # surface config errors as exit-code-1 messages
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+
+    if args.command == "server":
+        from open_simulator_tpu.server.rest import serve
+
+        return serve(
+            address=args.address,
+            port=args.port,
+            cluster_config=args.cluster_config,
+            kubeconfig=args.kubeconfig,
+        )
+
+    if args.command == "gen-doc":
+        from open_simulator_tpu.cli.gendoc import generate_docs
+
+        generate_docs(build_parser(), args.dir)
+        print(f"docs written to {args.dir}")
+        return 0
+
+    parser.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,7 +18,7 @@ from open_simulator_tpu.encode.snapshot import ClusterSnapshot, EncodeOptions, e
 from open_simulator_tpu.engine.queue import sort_pods_greedy
 from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
 from open_simulator_tpu.k8s.loader import ClusterResources, make_valid_node
-from open_simulator_tpu.k8s.objects import Node, Pod
+from open_simulator_tpu.k8s.objects import ANNO_GPU_INDEX, Node, Pod
 from open_simulator_tpu.models.expand import expand_app_resources, expand_cluster_pods
 
 
@@ -79,6 +79,7 @@ def decode_result(
     fail_counts: np.ndarray,
     active: np.ndarray,
     elapsed_s: float = 0.0,
+    gpu_pick: Optional[np.ndarray] = None,
 ) -> SimulateResult:
     n_active = int(np.sum(active))
     scheduled: List[ScheduledPod] = []
@@ -88,6 +89,12 @@ def decode_result(
     for i, pod in enumerate(snapshot.pods):
         ni = int(node_assign[i])
         if ni >= 0:
+            if gpu_pick is not None and pod.gpu_request()[0] > 0:
+                devs = [str(d) for d in np.nonzero(gpu_pick[i])[0]]
+                if devs:
+                    # gpu-index assignment annotation, as the reference's
+                    # Reserve writes back (open-gpu-share.go:147-188)
+                    pod.meta.annotations[ANNO_GPU_INDEX] = "-".join(devs)
             scheduled.append(ScheduledPod(pod=pod, node_name=snapshot.node_names[ni]))
             pods_by_node.setdefault(ni, []).append(pod)
         else:
@@ -150,8 +157,11 @@ def simulate(
     out = schedule_pods(arrs, arrs.active, cfg)
     node_assign = np.asarray(out.node)
     fail_counts = np.asarray(out.fail_counts)
+    gpu_pick = np.asarray(out.gpu_pick) if cfg.enable_gpu else None
     elapsed = time.perf_counter() - t0
-    return decode_result(snapshot, node_assign, fail_counts, np.asarray(arrs.active), elapsed)
+    return decode_result(
+        snapshot, node_assign, fail_counts, np.asarray(arrs.active), elapsed, gpu_pick
+    )
 
 
 def _with_nodes(cluster: ClusterResources, nodes: List[Node]) -> ClusterResources:

@@ -69,6 +69,7 @@ class ScheduleOutput(NamedTuple):
     node: jnp.ndarray         # [P] i32, -1 = unscheduled
     fail_counts: jnp.ndarray  # [P, OPS] i32
     feasible: jnp.ndarray     # [P] i32 feasible-node count
+    gpu_pick: jnp.ndarray     # [P, G] bool devices assigned on the bound node
     state: SimState
 
 
@@ -214,14 +215,16 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
             state.gpu_used[safe_node], arrs.gpu_cap_mem[safe_node], arrs.gpu_slot[safe_node],
             x["gpu_mem"], x["gpu_cnt"], x["gpu_forced"], x["gpu_has_forced"],
         )
+        pick = pick & bound
         gpu_used = state.gpu_used + (
             onehot_n[:, None] * pick.astype(f32)[None, :] * x["gpu_mem"]
         )
     else:
+        pick = jnp.zeros_like(state.gpu_used[0], dtype=bool)
         gpu_used = state.gpu_used
 
     new_state = SimState(used, group_count, term_block, ports_used, gpu_used)
-    return new_state, (final_node, fail_counts, feasible_n)
+    return new_state, (final_node, fail_counts, feasible_n, pick)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -236,8 +239,11 @@ def schedule_pods(
         state = init_state(arrs)
     xs = _pod_xs(arrs)
     step = functools.partial(_step, arrs, active, cfg)
-    final_state, (nodes, fail_counts, feasible) = jax.lax.scan(step, state, xs)
-    return ScheduleOutput(node=nodes, fail_counts=fail_counts, feasible=feasible, state=final_state)
+    final_state, (nodes, fail_counts, feasible, gpu_pick) = jax.lax.scan(step, state, xs)
+    return ScheduleOutput(
+        node=nodes, fail_counts=fail_counts, feasible=feasible, gpu_pick=gpu_pick,
+        state=final_state,
+    )
 
 
 def make_config(snapshot: ClusterSnapshot, **overrides) -> EngineConfig:

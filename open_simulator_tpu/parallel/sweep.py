@@ -81,7 +81,7 @@ def batched_schedule(
             fn,
             in_shardings=(NamedSharding(mesh, P("scenario", None)),),
             out_shardings=ScheduleOutput(
-                node=lane, fail_counts=lane, feasible=lane,
+                node=lane, fail_counts=lane, feasible=lane, gpu_pick=lane,
                 state=jax.tree_util.tree_map(lambda _: lane, _state_proto(arrs)),
             ),
         )

@@ -1,0 +1,8 @@
+from open_simulator_tpu.report.tables import (
+    format_table,
+    report_cluster,
+    report_nodes,
+    report_pods,
+    report_gpu,
+    full_report,
+)

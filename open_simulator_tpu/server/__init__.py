@@ -1,0 +1,1 @@
+from open_simulator_tpu.server.rest import SimulationServer, serve

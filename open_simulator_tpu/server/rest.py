@@ -1,0 +1,227 @@
+"""REST simulation server.
+
+Endpoint parity with the reference (pkg/server/server.go:148-314):
+
+  GET  /healthz           -> {"status": "healthy"}
+  GET  /test              -> liveness echo
+  POST /api/deploy-apps   -> simulate deploying new apps (+ optional new nodes)
+  POST /api/scale-apps    -> simulate re-scaling existing workloads (their
+                             current pods are removed first — the re-rollout
+                             semantics of removePodsOfApp, server.go:404-444)
+
+Differences, by design of this environment: the reference watches a live
+cluster through a kubeconfig; here the "live cluster" is a YAML snapshot
+directory (--cluster-config) and/or an inline `cluster` field in the
+request body. Single-flight busy semantics are kept: concurrent
+simulations get 503 (TryLock analog, server.go:167,234).
+
+Request bodies (JSON):
+  deploy-apps: {"apps": [{"name": "a1", "yaml": "<multi-doc k8s yaml>"}],
+                "new_nodes": [<Node object json>, ...] | {"spec_yaml": "...", "count": N}}
+  scale-apps:  {"apps": [{"kind": "Deployment", "namespace": "shop",
+                          "name": "web-frontend", "replicas": 10}]}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from open_simulator_tpu.core import AppResource, SimulateResult, simulate
+from open_simulator_tpu.k8s.loader import (
+    ClusterResources,
+    demux_object,
+    load_resources_from_directory,
+    make_valid_node,
+    new_fake_nodes,
+    parse_yaml_documents,
+)
+from open_simulator_tpu.k8s.objects import LABEL_APP_NAME, Node
+
+
+class SimulationServer:
+    def __init__(self, cluster_config: str = ""):
+        self.cluster_config = cluster_config
+        self._lock = threading.Lock()
+
+    # ---- cluster snapshot ---------------------------------------------
+
+    def base_cluster(self, inline: Optional[Dict[str, Any]] = None) -> ClusterResources:
+        if inline and inline.get("yaml"):
+            res = ClusterResources()
+            for doc in parse_yaml_documents(inline["yaml"]):
+                demux_object(doc, res)
+            return res
+        if self.cluster_config:
+            return load_resources_from_directory(self.cluster_config)
+        raise ValueError("no cluster snapshot: start with --cluster-config or pass request.cluster.yaml")
+
+    # ---- handlers ------------------------------------------------------
+
+    def deploy_apps(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        cluster = self.base_cluster(body.get("cluster"))
+        cluster.nodes.extend(self._request_new_nodes(body.get("new_nodes")))
+        apps = self._request_apps(body)
+        result = simulate(cluster, apps)
+        return self._response(result, app_only=True)
+
+    def scale_apps(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        cluster = self.base_cluster(body.get("cluster"))
+        scaled: List[Dict[str, Any]] = body.get("apps") or []
+        apps: List[AppResource] = []
+        for entry in scaled:
+            kind = entry.get("kind", "Deployment")
+            ns = entry.get("namespace", "default")
+            name = entry.get("name", "")
+            replicas = entry.get("replicas")
+            workload = self._pop_workload(cluster, kind, ns, name)
+            if workload is None:
+                raise ValueError(f"workload {kind} {ns}/{name} not found in cluster snapshot")
+            # remove pods owned by the workload (re-rollout), then re-add it
+            # with the requested replica count as an app to schedule
+            self._remove_owned_pods(cluster, kind, ns, name)
+            if replicas is not None:
+                workload.replicas = int(replicas)
+            app_res = ClusterResources()
+            app_res.add(workload, kind)
+            apps.append(AppResource(name=f"scale-{name}", resources=app_res))
+        result = simulate(cluster, apps)
+        return self._response(result, app_only=True)
+
+    # ---- helpers -------------------------------------------------------
+
+    def _request_apps(self, body: Dict[str, Any]) -> List[AppResource]:
+        apps = []
+        for a in body.get("apps") or []:
+            res = ClusterResources()
+            for doc in parse_yaml_documents(a.get("yaml", "")):
+                demux_object(doc, res)
+            apps.append(AppResource(name=a.get("name", "app"), resources=res))
+        return apps
+
+    def _request_new_nodes(self, spec) -> List[Node]:
+        if not spec:
+            return []
+        if isinstance(spec, dict):
+            template = Node.from_dict(yaml.safe_load(spec["spec_yaml"]))
+            return new_fake_nodes(make_valid_node(template), int(spec.get("count", 1)))
+        return [make_valid_node(Node.from_dict(d)) for d in spec]
+
+    @staticmethod
+    def _pop_workload(cluster: ClusterResources, kind: str, ns: str, name: str):
+        attr = ClusterResources._FIELD_BY_KIND.get(kind)
+        if attr is None:
+            return None
+        group = getattr(cluster, attr)
+        for i, wl in enumerate(group):
+            if wl.meta.namespace == ns and wl.meta.name == name:
+                return group.pop(i)
+        return None
+
+    @staticmethod
+    def _remove_owned_pods(cluster: ClusterResources, kind: str, ns: str, name: str) -> None:
+        """Reference walks ReplicaSet ownership for Deployments
+        (removePodsOfApp, server.go:404-444); our expansion stamps direct
+        owner metadata, so matching (kind|via-RS, name) covers both."""
+        def owned(p) -> bool:
+            if p.meta.namespace != ns:
+                return False
+            if p.meta.owner_kind == kind and p.meta.owner_name == name:
+                return True
+            # Deployment -> ReplicaSet -> Pod chains: RS names are prefixed
+            return (
+                kind == "Deployment"
+                and p.meta.owner_kind == "ReplicaSet"
+                and p.meta.owner_name.startswith(name + "-")
+            )
+
+        cluster.pods = [p for p in cluster.pods if not owned(p)]
+
+    @staticmethod
+    def _response(result: SimulateResult, app_only: bool) -> Dict[str, Any]:
+        placements: Dict[str, List[str]] = {}
+        for sp in result.scheduled_pods:
+            if app_only and LABEL_APP_NAME not in sp.pod.meta.labels:
+                continue
+            placements.setdefault(sp.node_name, []).append(sp.pod.key)
+        return {
+            "unscheduled_pods": [
+                {"pod": up.pod.key, "reason": up.reason}
+                for up in result.unscheduled_pods
+                if not app_only or LABEL_APP_NAME in up.pod.meta.labels
+            ],
+            "placements": placements,
+            "elapsed_s": round(result.elapsed_s, 3),
+        }
+
+
+def _make_handler(server: SimulationServer):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def _send(self, code: int, payload: Dict[str, Any]) -> None:
+            data = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, {"status": "healthy"})
+            elif self.path == "/test":
+                self._send(200, {"message": "simon-tpu server is running"})
+            else:
+                self._send(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path not in ("/api/deploy-apps", "/api/scale-apps"):
+                self._send(404, {"error": "not found"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except json.JSONDecodeError as e:
+                self._send(400, {"error": f"bad json: {e}"})
+                return
+            if not server._lock.acquire(blocking=False):
+                self._send(503, {"error": "a simulation is already running"})
+                return
+            # Compute under the lock, send after release — otherwise a client
+            # that pipelines its next request on seeing the response races the
+            # lock release and gets a spurious 503.
+            try:
+                if self.path == "/api/deploy-apps":
+                    code, payload = 200, server.deploy_apps(body)
+                else:
+                    code, payload = 200, server.scale_apps(body)
+            except ValueError as e:
+                code, payload = 400, {"error": str(e)}
+            except Exception as e:  # noqa: BLE001 — 500 with message, like gin recovery
+                code, payload = 500, {"error": f"{type(e).__name__}: {e}"}
+            finally:
+                server._lock.release()
+            self._send(code, payload)
+
+    return Handler
+
+
+def serve(address: str = "127.0.0.1", port: int = 8899, cluster_config: str = "",
+          kubeconfig: str = "") -> int:
+    if kubeconfig:
+        print("warning: --kubeconfig is not supported in this environment "
+              "(no live cluster); using --cluster-config snapshot instead")
+    sim_server = SimulationServer(cluster_config=cluster_config)
+    httpd = ThreadingHTTPServer((address, port), _make_handler(sim_server))
+    print(f"simon-tpu server listening on http://{address}:{port}")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0

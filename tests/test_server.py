@@ -1,0 +1,136 @@
+"""REST server endpoint tests (in-process HTTP over a loopback socket)."""
+
+import json
+import textwrap
+import threading
+import urllib.request
+import urllib.error
+
+import pytest
+
+from http.server import ThreadingHTTPServer
+
+from open_simulator_tpu.server.rest import SimulationServer, _make_handler
+
+CLUSTER_YAML = textwrap.dedent("""
+    apiVersion: v1
+    kind: Node
+    metadata: {name: s0}
+    status:
+      allocatable: {cpu: "8", memory: 16Gi, pods: "110"}
+    ---
+    apiVersion: v1
+    kind: Node
+    metadata: {name: s1}
+    status:
+      allocatable: {cpu: "8", memory: 16Gi, pods: "110"}
+    ---
+    apiVersion: apps/v1
+    kind: Deployment
+    metadata: {name: existing, namespace: default}
+    spec:
+      replicas: 2
+      selector: {matchLabels: {app: existing}}
+      template:
+        metadata: {labels: {app: existing}}
+        spec:
+          containers:
+            - name: c
+              image: registry.local/e:1
+              resources: {requests: {cpu: "1", memory: 1Gi}}
+""")
+
+APP_YAML = textwrap.dedent("""
+    apiVersion: apps/v1
+    kind: Deployment
+    metadata: {name: newapp, namespace: default}
+    spec:
+      replicas: 3
+      selector: {matchLabels: {app: newapp}}
+      template:
+        metadata: {labels: {app: newapp}}
+        spec:
+          containers:
+            - name: c
+              image: registry.local/n:1
+              resources: {requests: {cpu: "2", memory: 2Gi}}
+""")
+
+
+@pytest.fixture(scope="module")
+def server_url():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _make_handler(SimulationServer()))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), headers={"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def test_healthz(server_url):
+    with urllib.request.urlopen(server_url + "/healthz") as resp:
+        assert json.loads(resp.read())["status"] == "healthy"
+
+
+def test_deploy_apps(server_url):
+    out = _post(server_url + "/api/deploy-apps", {
+        "cluster": {"yaml": CLUSTER_YAML},
+        "apps": [{"name": "newapp", "yaml": APP_YAML}],
+    })
+    placed = [p for pods in out["placements"].values() for p in pods]
+    assert len(placed) == 3 and not out["unscheduled_pods"]
+    # response is trimmed to app pods only (existing deployment not listed)
+    assert all("newapp" in p for p in placed)
+
+
+def test_deploy_apps_with_new_nodes(server_url):
+    big_app = APP_YAML.replace("replicas: 3", "replicas: 8")
+    out = _post(server_url + "/api/deploy-apps", {
+        "cluster": {"yaml": CLUSTER_YAML},
+        "apps": [{"name": "newapp", "yaml": big_app}],
+    })
+    assert out["unscheduled_pods"]  # 8x2cpu + existing 2 > 16 cpu
+    out2 = _post(server_url + "/api/deploy-apps", {
+        "cluster": {"yaml": CLUSTER_YAML},
+        "apps": [{"name": "newapp", "yaml": big_app}],
+        "new_nodes": {"spec_yaml": "kind: Node\nmetadata: {name: t}\nstatus: {allocatable: {cpu: '8', memory: 16Gi, pods: '110'}}", "count": 2},
+    })
+    assert not out2["unscheduled_pods"]
+
+
+def test_scale_apps(server_url):
+    out = _post(server_url + "/api/scale-apps", {
+        "cluster": {"yaml": CLUSTER_YAML},
+        "apps": [{"kind": "Deployment", "namespace": "default", "name": "existing", "replicas": 5}],
+    })
+    placed = [p for pods in out["placements"].values() for p in pods]
+    assert len(placed) == 5
+    assert not out["unscheduled_pods"]
+
+
+def test_scale_unknown_workload_400(server_url):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server_url + "/api/scale-apps", {
+            "cluster": {"yaml": CLUSTER_YAML},
+            "apps": [{"kind": "Deployment", "namespace": "default", "name": "ghost"}],
+        })
+    assert ei.value.code == 400
+
+
+def test_missing_cluster_400(server_url):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server_url + "/api/deploy-apps", {"apps": []})
+    assert ei.value.code == 400
+
+
+def test_404(server_url):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(server_url + "/nope")
+    assert ei.value.code == 404
