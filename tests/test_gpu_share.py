@@ -1,0 +1,101 @@
+"""GPU-share scheduling: per-device memory packing, annotations, reports.
+
+Mirrors the reference's open-gpu-share behavior (plugin/open-gpu-share.go +
+gpunodeinfo.go): pods request per-device GPU memory via annotations;
+placement picks nodes with enough free devices and stamps the chosen
+device ids into the gpu-index annotation.
+"""
+
+import numpy as np
+
+from open_simulator_tpu.core import AppResource, simulate
+from open_simulator_tpu.k8s.loader import ClusterResources
+from open_simulator_tpu.k8s.objects import (
+    ANNO_GPU_COUNT,
+    ANNO_GPU_INDEX,
+    ANNO_GPU_MEM,
+    RES_GPU_COUNT,
+    RES_GPU_MEM,
+)
+from tests.conftest import make_node, make_pod
+
+
+def gpu_node(name, gpus=2, mem_per_gpu=16):
+    return make_node(
+        name, cpu_m=16000, mem_mib=65536,
+        extra_alloc={RES_GPU_COUNT: gpus, RES_GPU_MEM: gpus * mem_per_gpu},
+        labels={"gpu": "true"},
+    )
+
+
+def gpu_pod(name, mem=8, count=1, cpu="500m"):
+    return make_pod(
+        name, cpu=cpu,
+        annotations={ANNO_GPU_MEM: str(mem), ANNO_GPU_COUNT: str(count)},
+    )
+
+
+def run(nodes, pods):
+    cluster = ClusterResources()
+    cluster.nodes = list(nodes)
+    app = ClusterResources()
+    app.pods = list(pods)
+    return simulate(cluster, [AppResource(name="gpu", resources=app)])
+
+
+def test_gpu_pods_fit_and_get_device_indices():
+    res = run([gpu_node("g0", gpus=2, mem_per_gpu=16)], [gpu_pod(f"p{i}", mem=8) for i in range(4)])
+    assert not res.unscheduled_pods
+    # 4 x 8GiB over 2 devices of 16GiB: exactly full, 2 pods per device
+    per_dev = {}
+    for sp in res.scheduled_pods:
+        idx = sp.pod.meta.annotations.get(ANNO_GPU_INDEX)
+        assert idx is not None and idx.isdigit()
+        per_dev[idx] = per_dev.get(idx, 0) + 1
+    assert per_dev == {"0": 2, "1": 2}
+
+
+def test_gpu_memory_exhaustion():
+    res = run([gpu_node("g0", gpus=1, mem_per_gpu=16)], [gpu_pod(f"p{i}", mem=12) for i in range(2)])
+    assert len(res.scheduled_pods) == 1
+    assert len(res.unscheduled_pods) == 1
+    assert "GPU memory" in res.unscheduled_pods[0].reason
+
+
+def test_tightest_fit_prefers_fuller_device():
+    # One device pre-loaded via a pinned pod; the next 8GiB pod should pack
+    # onto the fuller device that still fits (tightest fit), not the empty one.
+    pinned = gpu_pod("pinned", mem=4)
+    pinned.meta.annotations[ANNO_GPU_INDEX] = "1"
+    pinned.node_name = "g0"
+    res = run([gpu_node("g0", gpus=2, mem_per_gpu=16)], [pinned, gpu_pod("next", mem=8)])
+    assert not res.unscheduled_pods
+    nxt = next(sp for sp in res.scheduled_pods if sp.pod.meta.name == "next")
+    assert nxt.pod.meta.annotations[ANNO_GPU_INDEX] == "1"
+
+
+def test_multi_gpu_pod():
+    res = run(
+        [gpu_node("g0", gpus=1, mem_per_gpu=16), gpu_node("g1", gpus=4, mem_per_gpu=16)],
+        [gpu_pod("dist", mem=8, count=3)],
+    )
+    assert not res.unscheduled_pods
+    sp = res.scheduled_pods[0]
+    assert sp.node_name == "g1"
+    devs = sp.pod.meta.annotations[ANNO_GPU_INDEX].split("-")
+    assert len(devs) == 3 and len(set(devs)) == 3
+
+
+def test_non_gpu_pods_avoid_nothing_but_gpu_nodes_allowed():
+    # plain pods can land on gpu nodes (no repel rule in reference either)
+    res = run([gpu_node("g0")], [make_pod("plain")])
+    assert not res.unscheduled_pods
+
+
+def test_gpu_report():
+    from open_simulator_tpu.report.tables import report_gpu
+
+    res = run([gpu_node("g0", gpus=2, mem_per_gpu=16)], [gpu_pod("p0", mem=8)])
+    table = report_gpu(res)
+    assert "gpu-0" in table and "gpu-1" in table
+    assert "50.0%" in table  # 8/16 on the packed device

@@ -1,0 +1,165 @@
+"""Full-engine integration test with an invariant-checking oracle.
+
+Modeled on the reference's single integration test
+(pkg/simulator/core_test.go:31-319 TestSimulate + checkResult:321-548):
+build a 4-node cluster (3 workers + 1 tainted master) with kube-system
+workloads, deploy an app exercising every workload kind plus taints,
+selectors, affinity, anti-affinity and spread, then independently recount
+what must be true of the placement — including re-deriving DaemonSet
+eligibility per node — and require zero unscheduled pods.
+"""
+
+from collections import Counter
+
+from open_simulator_tpu.core import AppResource, simulate
+from open_simulator_tpu.k8s.loader import ClusterResources
+from open_simulator_tpu.k8s.objects import DaemonSet, Deployment, Job, StatefulSet
+from open_simulator_tpu.models.expand import daemonset_node_should_run
+from tests.conftest import make_node, make_pod
+
+
+MASTER_TAINT = {"key": "node-role.kubernetes.io/master", "effect": "NoSchedule"}
+MASTER_TOL = {"key": "node-role.kubernetes.io/master", "operator": "Exists", "effect": "NoSchedule"}
+
+
+def build_cluster():
+    cluster = ClusterResources()
+    cluster.nodes = [
+        make_node("master-0", cpu_m=8000, mem_mib=16384,
+                  labels={"node-role.kubernetes.io/master": "", "zone": "z0"},
+                  taints=[MASTER_TAINT]),
+        make_node("worker-0", cpu_m=8000, mem_mib=16384, labels={"zone": "z0", "disk": "ssd"}),
+        make_node("worker-1", cpu_m=8000, mem_mib=16384, labels={"zone": "z1"}),
+        make_node("worker-2", cpu_m=8000, mem_mib=16384, labels={"zone": "z1"}),
+    ]
+    # kube-system daemonset runs everywhere incl. master
+    cluster.daemon_sets = [DaemonSet.from_dict({
+        "metadata": {"name": "proxy", "namespace": "kube-system"},
+        "spec": {"selector": {"matchLabels": {"k": "proxy"}},
+                 "template": {"metadata": {"labels": {"k": "proxy"}},
+                              "spec": {"tolerations": [MASTER_TOL],
+                                       "containers": [{"name": "p", "image": "i",
+                                                       "resources": {"requests": {"cpu": "100m", "memory": "64Mi"}}}]}}},
+    })]
+    cluster.deployments = [Deployment.from_dict({
+        "metadata": {"name": "metrics", "namespace": "kube-system"},
+        "spec": {"replicas": 2, "selector": {"matchLabels": {"k": "metrics"}},
+                 "template": {"metadata": {"labels": {"k": "metrics"}},
+                              "spec": {"containers": [{"name": "m", "image": "i",
+                                                       "resources": {"requests": {"cpu": "200m", "memory": "256Mi"}}}]}}},
+    })]
+    return cluster
+
+
+def build_app():
+    app = ClusterResources()
+    app.deployments = [Deployment.from_dict({
+        "metadata": {"name": "api", "namespace": "prod"},
+        "spec": {"replicas": 4, "selector": {"matchLabels": {"app": "api"}},
+                 "template": {"metadata": {"labels": {"app": "api"}},
+                              "spec": {
+                                  "topologySpreadConstraints": [{
+                                      "maxSkew": 1, "topologyKey": "zone",
+                                      "whenUnsatisfiable": "DoNotSchedule",
+                                      "labelSelector": {"matchLabels": {"app": "api"}}}],
+                                  "containers": [{"name": "c", "image": "i",
+                                                  "resources": {"requests": {"cpu": "500m", "memory": "512Mi"}}}]}}},
+    })]
+    app.stateful_sets = [StatefulSet.from_dict({
+        "metadata": {"name": "kv", "namespace": "prod"},
+        "spec": {"replicas": 3, "selector": {"matchLabels": {"app": "kv"}},
+                 "template": {"metadata": {"labels": {"app": "kv"}},
+                              "spec": {
+                                  "affinity": {"podAntiAffinity": {
+                                      "requiredDuringSchedulingIgnoredDuringExecution": [{
+                                          "labelSelector": {"matchLabels": {"app": "kv"}},
+                                          "topologyKey": "kubernetes.io/hostname"}]}},
+                                  "containers": [{"name": "c", "image": "i",
+                                                  "resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}]}}},
+    })]
+    app.daemon_sets = [DaemonSet.from_dict({
+        # workers only (master not tolerated)
+        "metadata": {"name": "logship", "namespace": "prod"},
+        "spec": {"selector": {"matchLabels": {"app": "logship"}},
+                 "template": {"metadata": {"labels": {"app": "logship"}},
+                              "spec": {"containers": [{"name": "c", "image": "i",
+                                                       "resources": {"requests": {"cpu": "100m", "memory": "128Mi"}}}]}}},
+    })]
+    app.jobs = [Job.from_dict({
+        "metadata": {"name": "migrate", "namespace": "prod"},
+        "spec": {"completions": 2,
+                 "template": {"spec": {"containers": [{"name": "c", "image": "i",
+                                                       "resources": {"requests": {"cpu": "250m", "memory": "256Mi"}}}],
+                              "restartPolicy": "Never"}}},
+    })]
+    app.pods = [
+        make_pod("pinned-tool", ns="prod", cpu="100m", mem="128Mi",
+                 node_selector={"disk": "ssd"}),
+        make_pod("on-master", ns="prod", cpu="100m", mem="128Mi",
+                 tolerations=[MASTER_TOL],
+                 node_selector={"node-role.kubernetes.io/master": ""}),
+    ]
+    return app
+
+
+def test_full_integration_invariants():
+    cluster = build_cluster()
+    app = build_app()
+    result = simulate(cluster, [AppResource(name="prod-app", resources=app)])
+
+    # Oracle 0: nothing unscheduled (core_test.go expects failedPodsNum == 0)
+    assert not result.unscheduled_pods, [
+        (u.pod.key, u.reason) for u in result.unscheduled_pods
+    ]
+
+    placements = result.placements()
+    nodes_by_name = {n.name: n for n in cluster.nodes}
+
+    def pods_of(prefix, ns):
+        return {k: v for k, v in placements.items() if k.startswith(f"{ns}/{prefix}")}
+
+    # Oracle 1: DaemonSet eligibility independently re-derived per node
+    for ds, ns in ((cluster.daemon_sets[0], "kube-system"), (app.daemon_sets[0], "prod")):
+        expected_nodes = {
+            n.name for n in cluster.nodes if daemonset_node_should_run(ds, n)
+        }
+        actual_nodes = set(pods_of(ds.meta.name, ns).values())
+        assert actual_nodes == expected_nodes, (ds.meta.name, actual_nodes, expected_nodes)
+    # the prod daemonset must not land on the tainted master
+    assert "master-0" not in set(pods_of("logship", "prod").values())
+
+    # Oracle 2: replica counts
+    assert len(pods_of("api", "prod")) == 4
+    assert len(pods_of("kv", "prod")) == 3
+    assert len(pods_of("migrate", "prod")) == 2
+    assert len(pods_of("metrics", "kube-system")) == 2
+
+    # Oracle 3: anti-affinity — kv pods on 3 distinct nodes, never master
+    kv_nodes = list(pods_of("kv", "prod").values())
+    assert len(set(kv_nodes)) == 3 and "master-0" not in kv_nodes
+
+    # Oracle 4: hard spread maxSkew=1 on zone for api pods (z0 has 1
+    # schedulable worker, z1 has 2; master's zone counts only via its
+    # schedulability — it is tainted, so zones are z0:{worker-0}, z1:{worker-1,2})
+    zone_of = {n.name: n.meta.labels.get("zone") for n in cluster.nodes}
+    api_zones = Counter(zone_of[v] for v in pods_of("api", "prod").values())
+    assert abs(api_zones.get("z0", 0) - api_zones.get("z1", 0)) <= 1
+
+    # Oracle 5: selectors — pinned-tool on the ssd worker, on-master on master
+    assert placements["prod/pinned-tool"] == "worker-0"
+    assert placements["prod/on-master"] == "master-0"
+
+    # Oracle 6: no node over-packed on any resource
+    for ns_status in result.node_status:
+        alloc = ns_status.node.allocatable
+        totals = Counter()
+        for p in ns_status.pods:
+            for r, v in p.requests().items():
+                totals[r] += v
+        for r, used in totals.items():
+            assert used <= alloc.get(r, 0), (ns_status.node.name, r, used)
+
+    # Oracle 7: only master-tolerating pods on the master
+    for key, node in placements.items():
+        if node == "master-0":
+            assert key in ("prod/on-master",) or key.startswith("kube-system/proxy")
