@@ -220,6 +220,7 @@ class Applier:
             plan.nodes_per_scenario[idx],
             plan.fail_counts[idx],
             masks[idx],
+            gpu_pick=plan.gpu_pick[idx] if plan.gpu_pick is not None else None,
         )
 
     def _run_interactive(self, snapshot, cfg, thresholds, max_new: int) -> int:

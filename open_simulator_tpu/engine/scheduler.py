@@ -246,6 +246,20 @@ def schedule_pods(
     )
 
 
+def slice_pods(arrs: SnapshotArrays, start: int, stop: int) -> SnapshotArrays:
+    """A view of the snapshot covering pods [start:stop) — the unit of
+    checkpoint/resume: scan(pods[:k]) then scan(pods[k:], state=carry)
+    is exactly scan(pods) (the carry is the whole world)."""
+    import dataclasses
+
+    pod_axis = set(_pod_xs(arrs).keys())
+    out = {}
+    for f in dataclasses.fields(arrs):
+        x = getattr(arrs, f.name)
+        out[f.name] = x[start:stop] if f.name in pod_axis else x
+    return type(arrs)(**out)
+
+
 def make_config(snapshot: ClusterSnapshot, **overrides) -> EngineConfig:
     """EngineConfig from a snapshot: resource indices + gpu autodetect."""
     res = snapshot.resources

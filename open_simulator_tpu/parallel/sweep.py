@@ -50,6 +50,7 @@ class CapacityPlan:
     best_count: Optional[int]          # min satisfying count, None if none
     nodes_per_scenario: np.ndarray = field(repr=False, default=None)  # [S, P]
     fail_counts: np.ndarray = field(repr=False, default=None)         # [S, P, OPS]
+    gpu_pick: Optional[np.ndarray] = field(repr=False, default=None)  # [S, P, G]
 
 
 def make_mesh(n_scenario: Optional[int] = None, n_node: int = 1) -> Mesh:
@@ -190,4 +191,5 @@ def capacity_sweep(
         best_count=best,
         nodes_per_scenario=nodes,
         fail_counts=fail,
+        gpu_pick=np.asarray(out.gpu_pick) if cfg.enable_gpu else None,
     )

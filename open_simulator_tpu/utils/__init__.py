@@ -1,0 +1,2 @@
+from open_simulator_tpu.utils.trace import Trace, profile_to
+from open_simulator_tpu.utils.checkpoint import save_simulation, load_simulation

@@ -1,0 +1,43 @@
+"""Checkpoint/resume for simulations.
+
+The reference has none — every capacity iteration restarts from zero
+(SURVEY.md section 5). Functional state makes this trivial here: a
+simulation is (snapshot arrays, carry state, assignments), all dense
+arrays; a checkpoint is one .npz.
+
+Intended uses: resuming an incremental what-if session (schedule app A,
+checkpoint, later try apps B/C against the same occupied cluster without
+re-scanning A), and shipping reproducible placement states between hosts.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Tuple
+
+import numpy as np
+
+from open_simulator_tpu.engine.scheduler import SimState
+
+
+def save_simulation(
+    path: str,
+    state: SimState,
+    node_assign: Optional[np.ndarray] = None,
+    meta: Optional[dict] = None,
+) -> None:
+    arrays = {f"state_{k}": np.asarray(v) for k, v in state._asdict().items()}
+    if node_assign is not None:
+        arrays["node_assign"] = np.asarray(node_assign)
+    arrays["meta_json"] = np.frombuffer(
+        json.dumps(meta or {}).encode(), dtype=np.uint8
+    )
+    np.savez_compressed(path, **arrays)
+
+
+def load_simulation(path: str) -> Tuple[SimState, Optional[np.ndarray], dict]:
+    with np.load(path) as z:
+        state = SimState(**{k[len("state_"):]: z[k] for k in z.files if k.startswith("state_")})
+        node_assign = z["node_assign"] if "node_assign" in z.files else None
+        meta = json.loads(bytes(z["meta_json"]).decode()) if "meta_json" in z.files else {}
+    return state, node_assign, meta

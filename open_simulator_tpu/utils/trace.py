@@ -1,0 +1,63 @@
+"""Phase tracing + device profiling.
+
+The reference wraps simulate phases in utiltrace with slow-threshold
+logging (pkg/simulator/core.go:80-128 'Trace Simulate' steps, 1s alarm;
+simulator.go:522-532, 100ms snapshot alarm). Same idea here, plus an
+optional `jax.profiler` trace context for real device timelines.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import List, Optional, Tuple
+
+log = logging.getLogger("simon-tpu.trace")
+
+
+class Trace:
+    """Nested step timing with log-if-long semantics.
+
+    >>> t = Trace("Simulate", warn_after_s=1.0)
+    >>> with t.step("encode"): ...
+    >>> t.finish()   # logs breakdown if total exceeded the threshold
+    """
+
+    def __init__(self, name: str, warn_after_s: float = 1.0):
+        self.name = name
+        self.warn_after_s = warn_after_s
+        self.t0 = time.perf_counter()
+        self.steps: List[Tuple[str, float]] = []
+
+    @contextlib.contextmanager
+    def step(self, label: str):
+        s = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.steps.append((label, time.perf_counter() - s))
+
+    def total(self) -> float:
+        return time.perf_counter() - self.t0
+
+    def finish(self) -> float:
+        total = self.total()
+        if total >= self.warn_after_s:
+            detail = "; ".join(f"{lbl}: {dt * 1000:.0f}ms" for lbl, dt in self.steps)
+            log.warning("%s took %.2fs (%s)", self.name, total, detail)
+        else:
+            log.debug("%s took %.2fs", self.name, total)
+        return total
+
+
+@contextlib.contextmanager
+def profile_to(log_dir: Optional[str]):
+    """jax.profiler trace context; no-op when log_dir is falsy."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
