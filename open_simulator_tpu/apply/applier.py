@@ -142,7 +142,9 @@ class Applier:
                 return 100.0
 
         return SweepThresholds(
-            max_cpu_pct=env_pct("MaxCPU"), max_memory_pct=env_pct("MaxMemory")
+            max_cpu_pct=env_pct("MaxCPU"),
+            max_memory_pct=env_pct("MaxMemory"),
+            max_vg_pct=env_pct("MaxVG"),
         )
 
     # ---- run -----------------------------------------------------------
@@ -175,7 +177,12 @@ class Applier:
             pods,
             EncodeOptions(max_new_nodes=max_new, new_node_template=template),
         )
-        cfg = make_config(snapshot)
+        overrides = {}
+        if self.opts.default_scheduler_config:
+            from open_simulator_tpu.engine.profile import weight_overrides_from_file
+
+            overrides = weight_overrides_from_file(self.opts.default_scheduler_config)
+        cfg = make_config(snapshot, **overrides)
         thresholds = self._thresholds()
 
         if self.opts.interactive:

@@ -1,0 +1,62 @@
+"""KubeSchedulerConfiguration -> engine weight overrides.
+
+The reference accepts a scheduler config file via --default-scheduler-config
+and merges it over the v1beta2 defaults (GetAndSetSchedulerConfig,
+pkg/simulator/utils.go:325-356). Here the file's Score plugin
+enable/disable/weight lists map onto EngineConfig weight fields; Filter
+plugins are always-on tensor ops (disabling filters would change parity,
+and the reference never disables them either).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import yaml
+
+# plugin name -> EngineConfig weight field
+_SCORE_PLUGIN_FIELDS = {
+    "NodeResourcesBalancedAllocation": "w_balanced",
+    "NodeResourcesFit": "w_least",
+    "NodeResourcesLeastAllocated": "w_least",
+    "NodeAffinity": "w_node_aff",
+    "TaintToleration": "w_taint",
+    "InterPodAffinity": "w_interpod",
+    "PodTopologySpread": "w_spread",
+    "Simon": "w_simon",
+    "Open-Gpu-Share": "w_gpu",
+}
+
+
+class SchedulerConfigError(ValueError):
+    pass
+
+
+def weight_overrides_from_file(path: str) -> Dict[str, float]:
+    """Parse a KubeSchedulerConfiguration file into EngineConfig kwargs."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = yaml.safe_load(f) or {}
+    kind = doc.get("kind", "")
+    if kind and kind != "KubeSchedulerConfiguration":
+        raise SchedulerConfigError(f"{path}: expected KubeSchedulerConfiguration, got {kind}")
+    profiles = doc.get("profiles") or []
+    if not profiles:
+        return {}
+    plugins = (profiles[0] or {}).get("plugins") or {}
+    score = plugins.get("score") or {}
+    overrides: Dict[str, float] = {}
+    for entry in score.get("enabled") or []:
+        name = entry.get("name", "")
+        field = _SCORE_PLUGIN_FIELDS.get(name)
+        if field is None:
+            continue  # unknown plugin names are ignored, like out-of-tree ones
+        overrides[field] = float(entry.get("weight", 1))
+    for entry in score.get("disabled") or []:
+        name = entry.get("name", "")
+        if name == "*":
+            overrides = {f: 0.0 for f in set(_SCORE_PLUGIN_FIELDS.values())} | overrides
+            continue
+        field = _SCORE_PLUGIN_FIELDS.get(name)
+        if field is not None and field not in overrides:
+            overrides[field] = 0.0
+    return overrides

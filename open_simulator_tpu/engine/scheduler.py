@@ -47,6 +47,10 @@ class EngineConfig(NamedTuple):
     w_spread: float = 2.0
     w_simon: float = 1.0
     w_gpu: float = 1.0
+    # selectHost parity: the vendored scheduler picks randomly among equal top
+    # scores (generic_scheduler.go:144-168). 0 = deterministic lowest index;
+    # nonzero seeds a stateless per-pod jitter that only breaks exact ties.
+    tie_break_seed: int = 0
 
     @property
     def n_ops(self) -> int:
@@ -106,7 +110,9 @@ def _pod_xs(arrs: SnapshotArrays) -> Dict[str, jnp.ndarray]:
         "pref_group", "pref_key", "pref_weight", "pref_valid",
         "gpu_mem", "gpu_cnt", "gpu_forced", "gpu_has_forced",
     ]
-    return {k: getattr(arrs, k) for k in names}
+    xs = {k: getattr(arrs, k) for k in names}
+    xs["_pod_index"] = jnp.arange(arrs.req.shape[0], dtype=jnp.int32)
+    return xs
 
 
 def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: SimState, x):
@@ -181,6 +187,12 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
             state.gpu_used, arrs.gpu_cap_mem, arrs.gpu_slot, x["gpu_mem"], x["gpu_cnt"], mask)
 
     neg_inf = jnp.float32(-3.4e38)
+    if cfg.tie_break_seed:
+        # quantize to the framework's integer score scale first, so jitter can
+        # only reorder exact ties, then add per-(seed, pod, node) noise
+        key = jax.random.fold_in(jax.random.PRNGKey(cfg.tie_break_seed), x["_pod_index"])
+        jitter = jax.random.uniform(key, (n_nodes,), minval=0.0, maxval=0.5)
+        score = jnp.round(score) + jitter
     sel_node = jnp.argmax(jnp.where(mask, score, neg_inf)).astype(jnp.int32)
     feasible_n = jnp.sum(mask.astype(jnp.int32))
     any_feasible = feasible_n > 0

@@ -195,13 +195,18 @@ def make_valid_pod(pod: k8s.Pod) -> k8s.Pod:
 
 def make_valid_node(node: k8s.Node) -> k8s.Node:
     """Node normalization (reference MakeValidNodeByNode, utils.go:421-440):
-    ensure pods allocatable, status Ready, hostname label."""
+    ensure pods allocatable, hostname label, and fold the local-storage
+    annotation into allocatable resource columns."""
+    from open_simulator_tpu.k8s.local_storage import node_storage_resources
+
     n = node.clone()
     if not n.name:
         raise PodValidationError("node has no name")
     if "pods" not in n.allocatable:
         n.allocatable["pods"] = MAX_PODS_DEFAULT
     n.meta.labels.setdefault("kubernetes.io/hostname", n.name)
+    for res, v in node_storage_resources(n).items():
+        n.allocatable.setdefault(res, v)
     return n
 
 

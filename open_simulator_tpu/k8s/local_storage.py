@@ -1,0 +1,88 @@
+"""open-local / yoda local-storage model.
+
+Reference schema (pkg/utils/utils.go:458-528, pkg/type/const.go):
+
+  node annotation simon.tpu/node-local-storage:
+      {"vgs": [{"name": ..., "capacity": "<bytes>"}],
+       "devices": [{"name": ..., "capacity": "<bytes>", "mediaType": "hdd|ssd",
+                    "isAllocated": "false"}]}
+  pod annotation simon.tpu/pod-local-storage:
+      {"volumes": [{"size": "<bytes>", "kind": "LVM|HDD|SSD", "scName": ...}]}
+
+TPU-first mapping: local storage becomes ordinary resource columns, so VG
+fit rides the same NodeResourcesFit tensor op as cpu/memory:
+
+  open-local/vg          aggregate VG capacity / LVM volume sizes (MiB)
+  open-local/device-hdd  count of free exclusive HDD devices / HDD volumes
+  open-local/device-ssd  likewise for SSD
+
+Granularity caveat (ROADMAP): per-VG and per-device-size packing is
+aggregated; exclusive devices are counted, not size-matched.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Dict
+
+from open_simulator_tpu.k8s.objects import (
+    ANNO_NODE_LOCAL_STORAGE,
+    ANNO_POD_LOCAL_STORAGE,
+    Node,
+    Pod,
+    ResourceList,
+)
+
+log = logging.getLogger("simon-tpu.local-storage")
+
+RES_VG = "open-local/vg"
+RES_DEVICE_HDD = "open-local/device-hdd"
+RES_DEVICE_SSD = "open-local/device-ssd"
+
+_MIB = 1024 * 1024
+
+
+def node_storage_resources(node: Node) -> ResourceList:
+    raw = node.meta.annotations.get(ANNO_NODE_LOCAL_STORAGE)
+    if not raw:
+        return {}
+    try:
+        info = json.loads(raw)
+    except json.JSONDecodeError:
+        log.warning("node %s: bad local-storage annotation", node.name)
+        return {}
+    out: ResourceList = {}
+    vg_bytes = sum(int(vg.get("capacity", 0)) for vg in info.get("vgs") or [])
+    if vg_bytes:
+        out[RES_VG] = vg_bytes // _MIB
+    for dev in info.get("devices") or []:
+        if str(dev.get("isAllocated", "false")).lower() == "true":
+            continue
+        res = RES_DEVICE_SSD if str(dev.get("mediaType", "")).lower() == "ssd" else RES_DEVICE_HDD
+        out[res] = out.get(res, 0) + 1
+    return out
+
+
+def pod_storage_resources(pod: Pod) -> ResourceList:
+    raw = pod.meta.annotations.get(ANNO_POD_LOCAL_STORAGE)
+    if not raw:
+        return {}
+    try:
+        req = json.loads(raw)
+    except json.JSONDecodeError:
+        log.warning("pod %s: bad local-storage annotation", pod.key)
+        return {}
+    out: ResourceList = {}
+    for vol in req.get("volumes") or []:
+        kind = str(vol.get("kind", "")).upper()
+        size = int(vol.get("size", 0))
+        if kind == "LVM":
+            out[RES_VG] = out.get(RES_VG, 0) + max(size // _MIB, 1)
+        elif kind == "HDD":
+            out[RES_DEVICE_HDD] = out.get(RES_DEVICE_HDD, 0) + 1
+        elif kind == "SSD":
+            out[RES_DEVICE_SSD] = out.get(RES_DEVICE_SSD, 0) + 1
+        else:
+            log.warning("pod %s: unsupported volume kind %s", pod.key, kind)
+    return out

@@ -255,11 +255,14 @@ class Pod:
         computePodResourceRequest (noderesources/fit.go): per-resource
         max(sum over containers, max over init containers), plus the
         implicit one-pod slot."""
+        from open_simulator_tpu.k8s.local_storage import pod_storage_resources
+
         total: ResourceList = {}
         for c in self.containers:
             total = add_resource_lists(total, c.requests)
         for c in self.init_containers:
             total = max_resource_lists(total, c.requests)
+        total = add_resource_lists(total, pod_storage_resources(self))
         total["pods"] = 1
         return total
 

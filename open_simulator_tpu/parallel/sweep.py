@@ -36,6 +36,7 @@ from open_simulator_tpu.engine.scheduler import (
 class SweepThresholds(NamedTuple):
     max_cpu_pct: float = 100.0
     max_memory_pct: float = 100.0
+    max_vg_pct: float = 100.0  # open-local VG occupancy (MaxVG env, apply.go:614-681)
 
 
 @dataclass
@@ -159,19 +160,30 @@ def capacity_sweep(
     used = np.asarray(out.state.used)          # [S, N, R]
     alloc = np.asarray(arrs.alloc)             # [N, R]
 
+    from open_simulator_tpu.k8s.local_storage import RES_VG
+
     cpu_i = snapshot.resources.index("cpu")
     mem_i = snapshot.resources.index("memory")
+    vg_i = snapshot.resources.index(RES_VG) if RES_VG in snapshot.resources else None
+
+    def occupancy(si, lane_active, ri) -> float:
+        tot = float(np.sum(alloc[lane_active, ri]))
+        u = float(np.sum(used[si][lane_active, ri]))
+        return 100.0 * u / tot if tot else 0.0
+
     all_scheduled, cpu_occ, mem_occ, satisfied = [], [], [], []
     for si in range(len(counts)):
         lane_active = masks[si]
         ok = bool(np.all(nodes[si] >= 0))
-        tot_cpu = float(np.sum(alloc[lane_active, cpu_i]))
-        tot_mem = float(np.sum(alloc[lane_active, mem_i]))
-        u_cpu = float(np.sum(used[si][lane_active, cpu_i]))
-        u_mem = float(np.sum(used[si][lane_active, mem_i]))
-        c_pct = 100.0 * u_cpu / tot_cpu if tot_cpu else 0.0
-        m_pct = 100.0 * u_mem / tot_mem if tot_mem else 0.0
-        sat = ok and c_pct <= thresholds.max_cpu_pct and m_pct <= thresholds.max_memory_pct
+        c_pct = occupancy(si, lane_active, cpu_i)
+        m_pct = occupancy(si, lane_active, mem_i)
+        v_pct = occupancy(si, lane_active, vg_i) if vg_i is not None else 0.0
+        sat = (
+            ok
+            and c_pct <= thresholds.max_cpu_pct
+            and m_pct <= thresholds.max_memory_pct
+            and v_pct <= thresholds.max_vg_pct
+        )
         all_scheduled.append(ok)
         cpu_occ.append(c_pct)
         mem_occ.append(m_pct)

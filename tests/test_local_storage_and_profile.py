@@ -1,0 +1,108 @@
+"""open-local storage columns, MaxVG, scheduler-config weight overrides,
+random tie-break."""
+
+import json
+import textwrap
+
+import numpy as np
+
+from open_simulator_tpu.core import AppResource, simulate
+from open_simulator_tpu.engine.profile import weight_overrides_from_file
+from open_simulator_tpu.k8s.loader import ClusterResources, make_valid_node
+from open_simulator_tpu.k8s.local_storage import RES_DEVICE_HDD, RES_VG
+from open_simulator_tpu.k8s.objects import ANNO_NODE_LOCAL_STORAGE, ANNO_POD_LOCAL_STORAGE
+from tests.conftest import make_node, make_pod
+
+GIB = 1024 ** 3
+
+
+def storage_node(name, vg_gib=100, hdd=1):
+    n = make_node(name)
+    n.meta.annotations[ANNO_NODE_LOCAL_STORAGE] = json.dumps({
+        "vgs": [{"name": "pool", "capacity": str(vg_gib * GIB)}],
+        "devices": [{"name": f"/dev/sd{i}", "capacity": str(100 * GIB),
+                     "mediaType": "hdd", "isAllocated": "false"} for i in range(hdd)],
+    })
+    return n
+
+
+def lvm_pod(name, size_gib):
+    p = make_pod(name, cpu="100m", mem="128Mi")
+    p.meta.annotations[ANNO_POD_LOCAL_STORAGE] = json.dumps({
+        "volumes": [{"size": str(size_gib * GIB), "kind": "LVM", "scName": "open-local-lvm"}]
+    })
+    return p
+
+
+def test_node_storage_columns():
+    n = make_valid_node(storage_node("s0", vg_gib=100, hdd=2))
+    assert n.allocatable[RES_VG] == 100 * 1024
+    assert n.allocatable[RES_DEVICE_HDD] == 2
+
+
+def test_vg_fit_enforced():
+    cluster = ClusterResources()
+    cluster.nodes = [storage_node("s0", vg_gib=100)]
+    app = ClusterResources()
+    app.pods = [lvm_pod("v0", 60), lvm_pod("v1", 60)]
+    res = simulate(cluster, [AppResource(name="a", resources=app)])
+    assert len(res.scheduled_pods) == 1
+    assert len(res.unscheduled_pods) == 1
+    assert f"Insufficient {RES_VG}" in res.unscheduled_pods[0].reason
+
+
+def test_device_volume_counts():
+    cluster = ClusterResources()
+    cluster.nodes = [storage_node("s0", hdd=1)]
+    app = ClusterResources()
+    p = make_pod("d0", cpu="100m")
+    p.meta.annotations[ANNO_POD_LOCAL_STORAGE] = json.dumps({
+        "volumes": [{"size": str(10 * GIB), "kind": "HDD", "scName": "open-local-device-hdd"}]
+    })
+    p2 = make_pod("d1", cpu="100m")
+    p2.meta.annotations[ANNO_POD_LOCAL_STORAGE] = p.meta.annotations[ANNO_POD_LOCAL_STORAGE]
+    app.pods = [p, p2]
+    res = simulate(cluster, [AppResource(name="a", resources=app)])
+    assert len(res.scheduled_pods) == 1  # only one exclusive HDD device
+
+
+def test_weight_overrides(tmp_path):
+    cfg = tmp_path / "sched.yaml"
+    cfg.write_text(textwrap.dedent("""
+        apiVersion: kubescheduler.config.k8s.io/v1beta2
+        kind: KubeSchedulerConfiguration
+        profiles:
+          - plugins:
+              score:
+                enabled:
+                  - name: NodeResourcesFit
+                    weight: 5
+                  - name: Simon
+                    weight: 3
+                disabled:
+                  - name: PodTopologySpread
+    """))
+    ov = weight_overrides_from_file(str(cfg))
+    assert ov == {"w_least": 5.0, "w_simon": 3.0, "w_spread": 0.0}
+
+
+def test_tie_break_seed_changes_only_ties():
+    from open_simulator_tpu.core import build_pod_sequence
+    from open_simulator_tpu.encode.snapshot import encode_cluster
+    from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
+
+    cluster = ClusterResources()
+    cluster.nodes = [make_node(f"n{i}") for i in range(4)]  # identical nodes -> ties
+    app = ClusterResources()
+    app.pods = [make_pod(f"p{i}") for i in range(8)]
+    pods = build_pod_sequence(cluster, [AppResource(name="a", resources=app)])
+    snap = encode_cluster(cluster.nodes, pods)
+    arrs = device_arrays(snap)
+
+    a = np.asarray(schedule_pods(arrs, arrs.active, make_config(snap, tie_break_seed=7)).node)
+    b = np.asarray(schedule_pods(arrs, arrs.active, make_config(snap, tie_break_seed=8)).node)
+    det = np.asarray(schedule_pods(arrs, arrs.active, make_config(snap)).node)
+    # all variants schedule everything...
+    assert (a >= 0).all() and (b >= 0).all() and (det >= 0).all()
+    # ...and different seeds produce different tie resolution on identical nodes
+    assert not np.array_equal(a, b) or not np.array_equal(a, det)
