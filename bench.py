@@ -93,7 +93,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=1024)
     ap.add_argument("--pods", type=int, default=2048)
-    ap.add_argument("--scenarios", type=int, default=8)
+    ap.add_argument("--scenarios", type=int, default=256)
     ap.add_argument("--max-new", type=int, default=64)
     ap.add_argument("--skip-baseline", action="store_true")
     args = ap.parse_args()
