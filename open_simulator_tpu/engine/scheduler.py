@@ -51,6 +51,9 @@ class EngineConfig(NamedTuple):
     # scores (generic_scheduler.go:144-168). 0 = deterministic lowest index;
     # nonzero seeds a stateless per-pod jitter that only breaks exact ties.
     tie_break_seed: int = 0
+    # lax.scan unroll: 2 measured ~1.8x faster than 1 on v5e (amortizes loop
+    # bookkeeping without blowing up compile time; see ROADMAP perf notes).
+    scan_unroll: int = 2
 
     @property
     def n_ops(self) -> int:
@@ -255,7 +258,9 @@ def schedule_pods(
         state = init_state(arrs)
     xs = _pod_xs(arrs)
     step = functools.partial(_step, arrs, active, cfg)
-    final_state, (nodes, fail_counts, feasible, gpu_pick) = jax.lax.scan(step, state, xs)
+    final_state, (nodes, fail_counts, feasible, gpu_pick) = jax.lax.scan(
+        step, state, xs, unroll=cfg.scan_unroll
+    )
     return ScheduleOutput(
         node=nodes, fail_counts=fail_counts, feasible=feasible, gpu_pick=gpu_pick,
         state=final_state,
