@@ -163,9 +163,32 @@ class Applier:
     def _say(self, msg: str = "") -> None:
         print(msg, file=self._out)
 
+    def _select_apps(self, apps: List[AppResource]) -> List[AppResource]:
+        """Interactive app multi-select (reference: apply.go:172-194 survey
+        MultiSelect): comma-separated indices, empty = all."""
+        if not apps:
+            return apps
+        self._say("select apps to deploy (deployment order = config order):")
+        for i, app in enumerate(apps):
+            self._say(f"  [{i}] {app.name}")
+        try:
+            ans = input("indices (comma-separated, empty = all) > ").strip()
+        except EOFError:
+            return apps
+        if not ans or ans.lower() == "all":
+            return apps
+        picked = []
+        for tok in ans.split(","):
+            tok = tok.strip()
+            if tok.isdigit() and int(tok) < len(apps):
+                picked.append(apps[int(tok)])
+        return picked or apps
+
     def _run_inner(self) -> int:
         cluster = self._build_cluster()
         apps = self._build_apps()
+        if self.opts.interactive:
+            apps = self._select_apps(apps)
         template = _load_new_node_template(
             os.path.join(self.base_dir, self.config.new_node) if self.config.new_node else ""
         )

@@ -1,0 +1,19 @@
+"""Public test-fixture builders (the analog of the reference's pkg/test).
+
+The reference ships MakeFakeNode/Pod/Deployment/... functional-option
+builders as a first-class library used by both its tests and production
+code (SURVEY.md section 2a "Test fixture builders"). Same here: these are
+importable by downstream users writing their own scenario tests, and the
+repo's own test suite builds on them.
+"""
+
+from open_simulator_tpu.testing.builders import (
+    make_fake_cronjob,
+    make_fake_daemonset,
+    make_fake_deployment,
+    make_fake_job,
+    make_fake_node,
+    make_fake_pod,
+    make_fake_replicaset,
+    make_fake_statefulset,
+)

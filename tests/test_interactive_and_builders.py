@@ -1,0 +1,86 @@
+"""Interactive apply mode + public fixture builders."""
+
+import io
+import os
+
+from open_simulator_tpu.cli.main import main
+from open_simulator_tpu.core import AppResource, simulate
+from open_simulator_tpu.k8s.loader import ClusterResources
+from open_simulator_tpu.testing import (
+    make_fake_daemonset,
+    make_fake_deployment,
+    make_fake_job,
+    make_fake_node,
+    make_fake_pod,
+    make_fake_statefulset,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_interactive_flow(monkeypatch, capsys):
+    # select all apps, then quit is never needed (everything fits)
+    answers = iter(["", ""])
+    monkeypatch.setattr("builtins.input", lambda *a: next(answers))
+    rc = main(["apply", "-f", os.path.join(REPO, "examples/config.yaml"),
+               "--max-new-nodes", "2", "-i"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "select apps to deploy" in out
+    assert "all pods scheduled with 0 new node(s)" in out
+
+
+def test_interactive_add_nodes(monkeypatch, capsys, tmp_path):
+    import textwrap
+
+    (tmp_path / "cluster").mkdir()
+    (tmp_path / "cluster" / "n.yaml").write_text(textwrap.dedent("""
+        kind: Node
+        metadata: {name: small}
+        status: {allocatable: {cpu: "1", memory: 2Gi, pods: "110"}}
+    """))
+    (tmp_path / "apps").mkdir()
+    (tmp_path / "apps" / "a.yaml").write_text(textwrap.dedent("""
+        kind: Pod
+        metadata: {name: fat, namespace: default}
+        spec:
+          containers:
+            - name: c
+              resources: {requests: {cpu: "2"}}
+    """))
+    (tmp_path / "newnode.yaml").write_text(textwrap.dedent("""
+        kind: Node
+        metadata: {name: tpl}
+        status: {allocatable: {cpu: "4", memory: 8Gi, pods: "110"}}
+    """))
+    (tmp_path / "cfg.yaml").write_text(textwrap.dedent("""
+        apiVersion: simon/v1alpha1
+        kind: Config
+        metadata: {name: t}
+        spec:
+          cluster: {customConfig: cluster}
+          appList: [{name: a, path: apps}]
+          newNode: newnode.yaml
+    """))
+    answers = iter(["", "r", "a 1", ""])
+    monkeypatch.setattr("builtins.input", lambda *a: next(answers))
+    rc = main(["apply", "-f", str(tmp_path / "cfg.yaml"), "--max-new-nodes", "4", "-i"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "1 pod(s) unschedulable with 0 new node(s)" in out
+    assert "Insufficient cpu" in out           # from [r]easons
+    assert "all pods scheduled with 1 new node(s)" in out
+
+
+def test_builders_end_to_end():
+    cluster = ClusterResources()
+    cluster.nodes = [make_fake_node(f"n{i}", cpu="8", memory="16Gi") for i in range(3)]
+    app = ClusterResources()
+    app.deployments = [make_fake_deployment("web", replicas=4, cpu="500m")]
+    app.stateful_sets = [make_fake_statefulset("db", replicas=2, cpu="1")]
+    app.daemon_sets = [make_fake_daemonset("agent")]
+    app.jobs = [make_fake_job("batch", completions=2)]
+    app.pods = [make_fake_pod("one-off")]
+    res = simulate(cluster, [AppResource(name="t", resources=app)])
+    assert not res.unscheduled_pods
+    assert len(res.scheduled_pods) == 4 + 2 + 3 + 2 + 1
