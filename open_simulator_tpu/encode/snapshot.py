@@ -116,6 +116,9 @@ class SnapshotArrays:
     pref_key: np.ndarray       # [P, Ap] i32
     pref_weight: np.ndarray    # [P, Ap] f32 (negative = anti-affinity preference)
     pref_valid: np.ndarray     # [P, Ap] bool
+    pref_tid: np.ndarray       # [P, Ap] i32 registry id of each preferred term
+    pref_term_key: np.ndarray  # [T2] i32 topo key per preferred term
+    hit_pref: np.ndarray       # [P, T2] pod matches preferred term t2's selector
     gpu_mem: np.ndarray        # [P] f32 per-device gpu memory request
     gpu_cnt: np.ndarray        # [P] f32 number of devices wanted
     gpu_forced: np.ndarray     # [P, G] bool pre-pinned device ids (gpu-index anno)
@@ -244,7 +247,10 @@ def encode_cluster(
             group_sel.append((sel, tuple(namespaces)))
         return gid
 
-    term_vocab = _Vocab()  # (gid, kid) -> tid, for required anti-affinity
+    term_vocab = _Vocab()       # (gid, kid) -> tid, for required anti-affinity
+    pref_term_vocab = _Vocab()  # (gid, kid) -> t2id, for preferred terms
+                                # (the existing-pods scoring direction,
+                                # interpodaffinity/scoring.go)
 
     pod_aff_terms: List[List[Tuple[int, int, bool]]] = []
     pod_anti_terms: List[List[Tuple[int, int]]] = []
@@ -281,10 +287,12 @@ def encode_cluster(
         for t in p.pod_affinity_preferred:
             gid = _register_group(t.selector, t.namespaces)
             kid = _register_topo(t.topology_key)
+            pref_term_vocab.add((gid, kid))
             prefs.append((gid, kid, float(t.weight or 1)))
         for t in p.pod_anti_affinity_preferred:
             gid = _register_group(t.selector, t.namespaces)
             kid = _register_topo(t.topology_key)
+            pref_term_vocab.add((gid, kid))
             prefs.append((gid, kid, -float(t.weight or 1)))
         pod_pref.append(prefs)
 
@@ -338,6 +346,17 @@ def encode_cluster(
         for (gid, kid), tid in term_vocab.index.items():
             if match_groups[pi, gid]:
                 hit_terms[pi, tid] = True
+
+    # ---- preferred-term registry (existing-pods scoring direction) ----
+    T2 = max(len(pref_term_vocab), 1)
+    pref_term_key_arr = np.zeros(T2, dtype=np.int64)
+    for (gid, kid), tid in pref_term_vocab.index.items():
+        pref_term_key_arr[tid] = kid
+    hit_pref_terms = np.zeros((len(pods), T2), dtype=bool)
+    for pi in range(len(pods)):
+        for (gid, kid), tid in pref_term_vocab.index.items():
+            if match_groups[pi, gid]:
+                hit_pref_terms[pi, tid] = True
 
     # ---- compat classes ------------------------------------------------
     class_vocab = _Vocab()
@@ -441,6 +460,10 @@ def encode_cluster(
     pref_key = _pad2([[t[1] for t in row] for row in pod_pref], Ap, np.int64(0))
     pref_weight = _pad2([[t[2] for t in row] for row in pod_pref], Ap, np.float32(0.0))
     pref_valid = _pad2([[True for _ in row] for row in pod_pref], Ap, np.bool_(False))
+    pref_tid = _pad2(
+        [[pref_term_vocab.index[(t[0], t[1])] for t in row] for row in pod_pref],
+        Ap, np.int64(0),
+    )
 
     arrays = SnapshotArrays(
         alloc=alloc,
@@ -480,6 +503,9 @@ def encode_cluster(
         pref_key=pref_key.astype(np.int32),
         pref_weight=pref_weight.astype(np.float32),
         pref_valid=pref_valid,
+        pref_tid=pref_tid.astype(np.int32),
+        pref_term_key=pref_term_key_arr.astype(np.int32),
+        hit_pref=hit_pref_terms,
         gpu_mem=gpu_mem,
         gpu_cnt=gpu_cnt,
         gpu_forced=gpu_forced,

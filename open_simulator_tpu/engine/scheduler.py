@@ -68,6 +68,7 @@ class SimState(NamedTuple):
     used: jnp.ndarray         # [N, R]
     group_count: jnp.ndarray  # [N, S]
     term_block: jnp.ndarray   # [N, T]
+    pref_paint: jnp.ndarray   # [N, T2] weighted preferred-term domains
     ports_used: jnp.ndarray   # [N, Pt] bool
     gpu_used: jnp.ndarray     # [N, G]
 
@@ -90,6 +91,7 @@ def init_state(arrs: SnapshotArrays) -> SimState:
     n, r = arrs.alloc.shape
     s = arrs.match_groups.shape[1]
     t = arrs.own_terms.shape[1]
+    t2 = arrs.hit_pref.shape[1]
     pt = arrs.ports.shape[1]
     g = arrs.gpu_slot.shape[1]
     f32 = jnp.float32
@@ -97,6 +99,7 @@ def init_state(arrs: SnapshotArrays) -> SimState:
         used=jnp.zeros((n, r), f32),
         group_count=jnp.zeros((n, s), f32),
         term_block=jnp.zeros((n, t), f32),
+        pref_paint=jnp.zeros((n, t2), f32),
         ports_used=jnp.zeros((n, pt), dtype=bool),
         gpu_used=jnp.zeros((n, g), f32),
     )
@@ -110,7 +113,7 @@ def _pod_xs(arrs: SnapshotArrays) -> Dict[str, jnp.ndarray]:
         "anti_group", "anti_key", "anti_valid",
         "own_terms", "hit_terms",
         "spread_group", "spread_key", "spread_skew", "spread_hard", "spread_valid",
-        "pref_group", "pref_key", "pref_weight", "pref_valid",
+        "pref_group", "pref_key", "pref_weight", "pref_valid", "pref_tid", "hit_pref",
         "gpu_mem", "gpu_cnt", "gpu_forced", "gpu_has_forced",
     ]
     xs = {k: getattr(arrs, k) for k in names}
@@ -178,9 +181,14 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
         state.used, arrs.alloc, x["req"], cfg.cpu_mem_idx)
     score += cfg.w_node_aff * scores.node_affinity_score(na_row, mask)
     score += cfg.w_taint * scores.taint_toleration_score(tt_row, mask)
+    # existing pods' preferred (anti-)affinity toward this pod: one mat-vec
+    # against the weighted domain paint (interpodaffinity/scoring.go's
+    # "existing pod" direction)
+    existing_pref_raw = state.pref_paint @ x["hit_pref"].astype(f32)
     score += cfg.w_interpod * scores.interpod_preference_score(
         state.group_count, arrs.topo_onehot, arrs.has_key,
-        x["pref_group"], x["pref_key"], x["pref_weight"], x["pref_valid"], mask)
+        x["pref_group"], x["pref_key"], x["pref_weight"], x["pref_valid"], mask,
+        extra_raw=existing_pref_raw)
     score += cfg.w_spread * scores.topology_spread_score(
         state.group_count, arrs.topo_onehot, arrs.has_key,
         x["spread_group"], x["spread_key"], x["spread_valid"], mask)
@@ -229,6 +237,15 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
     paint = sd_all[arrs.term_key].T * x["own_terms"].astype(f32)[None, :]  # [N, T]
     term_block = state.term_block + paint
 
+    # weighted paint of this pod's own preferred terms (for future pods'
+    # existing-direction score); Ap is tiny and static -> unrolled
+    t2_n = state.pref_paint.shape[1]
+    pref_paint = state.pref_paint
+    for a in range(x["pref_tid"].shape[0]):
+        col = jax.nn.one_hot(x["pref_tid"][a], t2_n, dtype=f32)        # [T2]
+        w = x["pref_weight"][a] * x["pref_valid"][a].astype(f32)
+        pref_paint = pref_paint + sd_all[x["pref_key"][a]][:, None] * col[None, :] * w
+
     if cfg.enable_gpu:
         pick = gpu_share.gpu_pick_devices(
             state.gpu_used[safe_node], arrs.gpu_cap_mem[safe_node], arrs.gpu_slot[safe_node],
@@ -242,7 +259,7 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
         pick = jnp.zeros_like(state.gpu_used[0], dtype=bool)
         gpu_used = state.gpu_used
 
-    new_state = SimState(used, group_count, term_block, ports_used, gpu_used)
+    new_state = SimState(used, group_count, term_block, pref_paint, ports_used, gpu_used)
     return new_state, (final_node, fail_counts, feasible_n, pick)
 
 

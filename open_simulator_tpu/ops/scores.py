@@ -108,14 +108,15 @@ def interpod_preference_score(
     pref_weight: jnp.ndarray,  # [Ap] (negative = anti)
     pref_valid: jnp.ndarray,   # [Ap]
     feasible: jnp.ndarray,
+    extra_raw: jnp.ndarray = None,
 ) -> jnp.ndarray:
-    """InterPodAffinity score, incoming-pod direction (vendored
-    interpodaffinity/scoring.go): sum over preferred terms of
-    weight x (#matching pods in the node's domain), min-max normalized.
-    The existing-pods direction (their preferred terms toward this pod) is
-    not yet modeled; see ROADMAP."""
+    """InterPodAffinity score, both directions (vendored
+    interpodaffinity/scoring.go): incoming pod's preferred terms sum
+    weight x (#matching pods in the node's domain); `extra_raw` carries the
+    existing-pods direction (their weighted preferred-term domain paint
+    matched against this pod). Min-max normalized over the sum."""
     n = group_count.shape[0]
-    raw = jnp.zeros((n,), dtype=jnp.float32)
+    raw = jnp.zeros((n,), dtype=jnp.float32) if extra_raw is None else extra_raw
     for a in range(pref_group.shape[0]):
         vec = group_count[:, pref_group[a]]
         dc = domain_count(vec, pref_key[a], topo_onehot)

@@ -1,0 +1,53 @@
+"""InterPodAffinity preferred scoring: existing-pods direction.
+
+An already-placed pod with preferredDuringScheduling pod-affinity toward
+label app=web should pull later web pods onto (or near) its node, even
+though the web pods themselves declare no affinity — the direction the
+vendored scoring computes from existing pods' terms.
+"""
+
+from open_simulator_tpu.core import AppResource, simulate
+from open_simulator_tpu.k8s.loader import ClusterResources
+from tests.conftest import make_node, make_pod
+
+
+def test_existing_pod_preferred_affinity_attracts():
+    magnet_aff = {"podAffinity": {"preferredDuringSchedulingIgnoredDuringExecution": [{
+        "weight": 100,
+        "podAffinityTerm": {
+            "labelSelector": {"matchLabels": {"app": "web"}},
+            "topologyKey": "kubernetes.io/hostname",
+        },
+    }]}}
+    nodes = [make_node(f"n{i}", cpu_m=32000, mem_mib=65536) for i in range(4)]
+    magnet = make_pod("magnet", cpu="100m", labels={"app": "magnet"}, affinity=magnet_aff,
+                      node_name="n2")
+    web = make_pod("web-0", cpu="100m", labels={"app": "web"})
+    cluster = ClusterResources()
+    cluster.nodes = nodes
+    cluster.pods = [magnet]
+    app = ClusterResources()
+    app.pods = [web]
+    res = simulate(cluster, [AppResource(name="a", resources=app)])
+    assert res.placements()["default/web-0"] == "n2"
+
+
+def test_existing_pod_preferred_anti_affinity_repels():
+    repel_aff = {"podAntiAffinity": {"preferredDuringSchedulingIgnoredDuringExecution": [{
+        "weight": 100,
+        "podAffinityTerm": {
+            "labelSelector": {"matchLabels": {"app": "web"}},
+            "topologyKey": "kubernetes.io/hostname",
+        },
+    }]}}
+    nodes = [make_node("n0", cpu_m=32000), make_node("n1", cpu_m=32000)]
+    repeller = make_pod("repeller", cpu="100m", labels={"app": "x"}, affinity=repel_aff,
+                        node_name="n0")
+    web = make_pod("web-0", cpu="100m", labels={"app": "web"})
+    cluster = ClusterResources()
+    cluster.nodes = nodes
+    cluster.pods = [repeller]
+    app = ClusterResources()
+    app.pods = [web]
+    res = simulate(cluster, [AppResource(name="a", resources=app)])
+    assert res.placements()["default/web-0"] == "n1"
