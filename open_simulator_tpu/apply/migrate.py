@@ -1,0 +1,135 @@
+"""Defragmentation / pods-migration planning.
+
+The reference README lists "Pods migration" as a use case but ships no
+implementation (no first-party migration code exists in the repo). Here it
+is a first-class planner: re-schedule every *movable* pod of a running
+cluster from a clean slate in big-rocks-first order, then diff the two
+placements.
+
+  movable   = owned by a rescheduling-tolerant controller (not a DaemonSet,
+              not a bare unowned pod, no exclusive local-storage device)
+  outcome   = move list (pod: old -> new), nodes left empty (scale-in
+              candidates), occupancy + fragmentation before/after
+
+GPU defragmentation falls out of the same pass: the gpu-share scoring
+prefers filling partially-used devices, so re-placement consolidates
+fragmented GPU memory (BASELINE.md config #5).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from open_simulator_tpu.core import AppResource, SimulateResult, simulate
+from open_simulator_tpu.k8s.loader import ClusterResources
+from open_simulator_tpu.k8s.local_storage import RES_DEVICE_HDD, RES_DEVICE_SSD
+from open_simulator_tpu.k8s.objects import Pod
+
+
+@dataclass
+class MigrationPlan:
+    moves: List[Tuple[str, str, str]]          # (pod key, from node, to node)
+    unmoved: List[str]                         # movable pods that stayed put
+    immovable: List[str]                       # pods excluded from migration
+    unschedulable: List[Tuple[str, str]]       # (pod key, reason) — should be rare
+    empty_nodes_before: List[str]
+    empty_nodes_after: List[str]               # scale-in candidates
+    result: SimulateResult = field(repr=False, default=None)
+
+    @property
+    def nodes_freed(self) -> List[str]:
+        before = set(self.empty_nodes_before)
+        return [n for n in self.empty_nodes_after if n not in before]
+
+
+def is_movable(pod: Pod) -> bool:
+    if pod.meta.owner_kind in ("", "DaemonSet"):
+        return False
+    req = pod.requests()
+    if req.get(RES_DEVICE_HDD, 0) or req.get(RES_DEVICE_SSD, 0):
+        return False  # exclusive local devices pin the pod
+    return True
+
+
+def plan_migration(cluster: ClusterResources) -> MigrationPlan:
+    """Compute a defragmentation plan for a cluster of placed pods."""
+    old_node: Dict[str, Optional[str]] = {}
+    movable: List[Pod] = []
+    fixed: List[Pod] = []
+    for pod in cluster.pods:
+        old_node[f"{pod.meta.namespace}/{pod.meta.name}"] = pod.node_name or None
+        if pod.node_name and is_movable(pod):
+            p = pod.clone()
+            p.node_name = ""  # release the binding; scheduler decides anew
+            movable.append(p)
+        else:
+            fixed.append(pod)
+
+    base = ClusterResources()
+    base.nodes = cluster.nodes
+    base.pods = fixed
+    base.daemon_sets = cluster.daemon_sets
+    app = ClusterResources()
+    app.pods = movable
+    # Bin-packing profile: MostAllocated replaces LeastAllocated/Balanced so
+    # re-placement consolidates instead of spreading (defrag is the point).
+    result = simulate(
+        base,
+        [AppResource(name="migration", resources=app)],
+        use_greed=True,
+        config_overrides={"w_least": 0.0, "w_balanced": 0.0, "w_most": 1.0, "w_spread": 0.0},
+    )
+
+    placements = result.placements()
+    moves, unmoved = [], []
+    for pod in movable:
+        key = f"{pod.meta.namespace}/{pod.meta.name}"
+        new = placements.get(key)
+        if new is None:
+            continue
+        if new != old_node[key]:
+            moves.append((key, old_node[key] or "?", new))
+        else:
+            unmoved.append(key)
+
+    def empty_nodes(pods_by_node: Dict[str, int]) -> List[str]:
+        return sorted(n.name for n in cluster.nodes if pods_by_node.get(n.name, 0) == 0)
+
+    before_counts: Dict[str, int] = {}
+    for key, node in old_node.items():
+        if node:
+            before_counts[node] = before_counts.get(node, 0) + 1
+    after_counts: Dict[str, int] = {}
+    for ns_status in result.node_status:
+        after_counts[ns_status.node.name] = len(ns_status.pods)
+
+    return MigrationPlan(
+        moves=moves,
+        unmoved=unmoved,
+        immovable=[f"{p.meta.namespace}/{p.meta.name}" for p in fixed],
+        unschedulable=[(u.pod.key, u.reason) for u in result.unscheduled_pods],
+        empty_nodes_before=empty_nodes(before_counts),
+        empty_nodes_after=empty_nodes(after_counts),
+        result=result,
+    )
+
+
+def report_migration(plan: MigrationPlan) -> str:
+    from open_simulator_tpu.report.tables import format_table
+
+    lines = []
+    rows = [[k, a, b] for k, a, b in plan.moves]
+    lines.append(format_table(["Pod", "From", "To"], rows, "Migration moves"))
+    lines.append(
+        f"\n{len(plan.moves)} move(s), {len(plan.unmoved)} already optimal, "
+        f"{len(plan.immovable)} immovable, {len(plan.unschedulable)} unschedulable"
+    )
+    if plan.nodes_freed:
+        lines.append("nodes freed for scale-in: " + ", ".join(plan.nodes_freed))
+    if plan.unschedulable:
+        for key, reason in plan.unschedulable:
+            lines.append(f"  ! {key}: {reason}")
+    return "\n".join(lines)

@@ -37,6 +37,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--master", default="", help="(unsupported here: no live cluster access)")
     sp.add_argument("--cluster-config", default="", help="cluster YAML dir serving as the live-cluster stand-in")
 
+    mg = sub.add_parser("migrate", help="plan a defragmentation migration of placed pods")
+    mg.add_argument("--cluster-config", required=True, help="cluster YAML dir (with placed pods)")
+    mg.add_argument("--output-file", default="")
+
     sub.add_parser("version", help="print version")
 
     gd = sub.add_parser("gen-doc", help="generate markdown docs for the CLI")
@@ -79,6 +83,24 @@ def main(argv=None) -> int:
         except Exception as e:  # surface config errors as exit-code-1 messages
             print(f"error: {e}", file=sys.stderr)
             return 1
+
+    if args.command == "migrate":
+        from open_simulator_tpu.apply.migrate import plan_migration, report_migration
+        from open_simulator_tpu.k8s.loader import load_resources_from_directory, make_valid_node
+
+        cluster = load_resources_from_directory(args.cluster_config)
+        if not cluster.nodes:
+            print(f"error: no nodes in {args.cluster_config}", file=sys.stderr)
+            return 1
+        cluster.nodes = [make_valid_node(n) for n in cluster.nodes]
+        plan = plan_migration(cluster)
+        text = report_migration(plan)
+        if args.output_file:
+            with open(args.output_file, "w", encoding="utf-8") as f:
+                f.write(text + "\n")
+        else:
+            print(text)
+        return 0
 
     if args.command == "server":
         from open_simulator_tpu.server.rest import serve

@@ -145,6 +145,7 @@ def simulate(
     apps: List[AppResource],
     use_greed: bool = False,
     encode_options: Optional[EncodeOptions] = None,
+    config_overrides: Optional[Dict] = None,
 ) -> SimulateResult:
     """Run one full simulation on the default device (TPU when present)."""
     t0 = time.perf_counter()
@@ -152,7 +153,7 @@ def simulate(
     cluster = _with_nodes(cluster, nodes)
     pods = build_pod_sequence(cluster, apps, use_greed=use_greed)
     snapshot = encode_cluster(nodes, pods, encode_options)
-    cfg = make_config(snapshot)
+    cfg = make_config(snapshot, **(config_overrides or {}))
     arrs = device_arrays(snapshot)
     out = schedule_pods(arrs, arrs.active, cfg)
     node_assign = np.asarray(out.node)

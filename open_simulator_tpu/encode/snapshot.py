@@ -247,6 +247,18 @@ def encode_cluster(
             group_sel.append((sel, tuple(namespaces)))
         return gid
 
+    def _register_owner_group(ns: str, kind: str, name: str) -> int:
+        """Selector group keyed on workload identity — the stand-in for the
+        default-spread selector the vendored plugin derives from the pod's
+        owning service/ReplicaSet/StatefulSet (default_plugins.go system
+        defaults)."""
+        gk = ("__owner__", ns, kind, name)
+        before = len(group_vocab)
+        gid = group_vocab.add(gk)
+        if len(group_vocab) > before:
+            group_sel.append(("__owner__", (ns, kind, name)))
+        return gid
+
     term_vocab = _Vocab()       # (gid, kid) -> tid, for required anti-affinity
     pref_term_vocab = _Vocab()  # (gid, kid) -> t2id, for preferred terms
                                 # (the existing-pods scoring direction,
@@ -281,6 +293,12 @@ def encode_cluster(
             gid = _register_group(c.label_selector, (p.meta.namespace,))
             kid = _register_topo(c.topology_key)
             spreads.append((gid, kid, float(c.max_skew), c.when_unsatisfiable == "DoNotSchedule"))
+        if not spreads and p.meta.owner_name and not p.node_name:
+            # v1beta2 system-default soft constraints for workload pods:
+            # zone maxSkew=3 + hostname maxSkew=5, ScheduleAnyway
+            gid = _register_owner_group(p.meta.namespace, p.meta.owner_kind, p.meta.owner_name)
+            spreads.append((gid, _register_topo("topology.kubernetes.io/zone"), 3.0, False))
+            spreads.append((gid, 0, 5.0, False))
         pod_spread.append(spreads)
 
         prefs = []
@@ -331,7 +349,14 @@ def encode_cluster(
     match_groups = np.zeros((len(pods), S), dtype=bool)
     for pi, p in enumerate(pods):
         for gid, (sel, namespaces) in enumerate(group_sel):
-            if p.meta.namespace in namespaces and labels_match_selector(p.meta.labels, sel):
+            if sel == "__owner__":
+                ns, kind, name = namespaces
+                match_groups[pi, gid] = (
+                    p.meta.namespace == ns
+                    and p.meta.owner_kind == kind
+                    and p.meta.owner_name == name
+                )
+            elif p.meta.namespace in namespaces and labels_match_selector(p.meta.labels, sel):
                 match_groups[pi, gid] = True
 
     # ---- anti-affinity term registry ----------------------------------

@@ -41,6 +41,7 @@ class EngineConfig(NamedTuple):
     # score weights (v1beta2 defaults + Simon appended with weight 1)
     w_balanced: float = 1.0
     w_least: float = 1.0
+    w_most: float = 0.0  # MostAllocated (bin-packing); used by migration planning
     w_node_aff: float = 1.0
     w_taint: float = 1.0
     w_interpod: float = 1.0
@@ -179,6 +180,9 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
         state.used, arrs.alloc, x["req"], cfg.cpu_mem_idx)
     score += cfg.w_least * scores.least_allocated_score(
         state.used, arrs.alloc, x["req"], cfg.cpu_mem_idx)
+    if cfg.w_most:
+        score += cfg.w_most * scores.most_allocated_score(
+            state.used, arrs.alloc, x["req"], cfg.cpu_mem_idx)
     score += cfg.w_node_aff * scores.node_affinity_score(na_row, mask)
     score += cfg.w_taint * scores.taint_toleration_score(tt_row, mask)
     # existing pods' preferred (anti-)affinity toward this pod: one mat-vec

@@ -50,6 +50,21 @@ def least_allocated_score(
     return total * MAX_SCORE / len(cpu_mem_idx)
 
 
+def most_allocated_score(
+    used: jnp.ndarray, alloc: jnp.ndarray, req_p: jnp.ndarray, cpu_mem_idx
+) -> jnp.ndarray:
+    """NodeResourcesMostAllocated strategy (vendored
+    noderesources/most_allocated.go): mean of post-bind utilization
+    fractions x100 — the bin-packing preference used for defragmentation."""
+    total = jnp.float32(0.0)
+    for r in cpu_mem_idx:
+        cap = alloc[:, r]
+        want = used[:, r] + req_p[r]
+        frac = jnp.where(cap > 0, jnp.clip(want / jnp.where(cap > 0, cap, 1.0), 0.0, 1.0), 0.0)
+        total = total + frac
+    return total * MAX_SCORE / len(cpu_mem_idx)
+
+
 def balanced_allocation_score(
     used: jnp.ndarray, alloc: jnp.ndarray, req_p: jnp.ndarray, cpu_mem_idx
 ) -> jnp.ndarray:

@@ -1,0 +1,32 @@
+"""v1beta2 system-default topology spread (soft) for workload pods."""
+
+from collections import Counter
+
+from open_simulator_tpu.core import AppResource, simulate
+from open_simulator_tpu.k8s.loader import ClusterResources
+from open_simulator_tpu.testing import make_fake_deployment, make_fake_node
+
+
+def test_workload_pods_default_spread_across_zones():
+    # Two zones with unequal node counts; without the default soft spread,
+    # bin-packing scores would favor piling into one zone.
+    nodes = [
+        make_fake_node("a0", cpu="16", memory="32Gi",
+                       labels={"topology.kubernetes.io/zone": "za"}),
+        make_fake_node("a1", cpu="16", memory="32Gi",
+                       labels={"topology.kubernetes.io/zone": "za"}),
+        make_fake_node("b0", cpu="16", memory="32Gi",
+                       labels={"topology.kubernetes.io/zone": "zb"}),
+    ]
+    cluster = ClusterResources()
+    cluster.nodes = nodes
+    app = ClusterResources()
+    app.deployments = [make_fake_deployment("web", replicas=6, cpu="100m", memory="128Mi")]
+    res = simulate(cluster, [AppResource(name="a", resources=app)])
+    assert not res.unscheduled_pods
+    zones = Counter("z" + sp.node_name[0] for sp in res.scheduled_pods)
+    # soft default (zone maxSkew 3): both zones must be used
+    assert zones["za"] >= 2 and zones["zb"] >= 2
+    # hostname default (maxSkew 5): all nodes used
+    hosts = {sp.node_name for sp in res.scheduled_pods}
+    assert hosts == {"a0", "a1", "b0"}

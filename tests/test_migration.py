@@ -1,0 +1,80 @@
+"""Defragmentation migration planner."""
+
+from open_simulator_tpu.apply.migrate import plan_migration, report_migration
+from open_simulator_tpu.k8s.loader import ClusterResources
+from open_simulator_tpu.k8s.objects import ANNO_WORKLOAD_KIND, ANNO_WORKLOAD_NAME
+from tests.conftest import make_node, make_pod
+
+
+def owned(pod, kind="Deployment", name="app"):
+    pod.meta.owner_kind = kind
+    pod.meta.owner_name = name
+    pod.meta.annotations[ANNO_WORKLOAD_KIND] = kind
+    pod.meta.annotations[ANNO_WORKLOAD_NAME] = name
+    return pod
+
+
+def test_defrag_consolidates_and_frees_nodes():
+    # 4 nodes each holding one small pod: defrag should pack them onto
+    # fewer nodes and report the freed ones.
+    nodes = [make_node(f"n{i}", cpu_m=4000, mem_mib=8192) for i in range(4)]
+    pods = [
+        owned(make_pod(f"p{i}", cpu="500m", mem="512Mi", node_name=f"n{i}"), name=f"w{i}")
+        for i in range(4)
+    ]
+    cluster = ClusterResources()
+    cluster.nodes = nodes
+    cluster.pods = pods
+    plan = plan_migration(cluster)
+    assert not plan.unschedulable
+    assert len(plan.nodes_freed) >= 2  # 4x500m packs onto 1 node (4000m)
+    assert len(plan.moves) >= 2
+    text = report_migration(plan)
+    assert "nodes freed for scale-in" in text
+
+
+def test_daemonset_and_bare_pods_immovable():
+    nodes = [make_node("n0"), make_node("n1")]
+    ds_pod = make_pod("agent", cpu="100m", node_name="n1")
+    ds_pod.meta.owner_kind = "DaemonSet"
+    ds_pod.meta.owner_name = "agent"
+    bare = make_pod("bare", cpu="100m", node_name="n1")
+    cluster = ClusterResources()
+    cluster.nodes = nodes
+    cluster.pods = [ds_pod, bare]
+    plan = plan_migration(cluster)
+    assert set(plan.immovable) == {"default/agent", "default/bare"}
+    assert not plan.moves
+
+
+def test_migrate_cli(tmp_path, capsys):
+    import textwrap
+
+    d = tmp_path / "cluster"
+    d.mkdir()
+    (d / "c.yaml").write_text(textwrap.dedent("""
+        kind: Node
+        metadata: {name: n0}
+        status: {allocatable: {cpu: "4", memory: 8Gi, pods: "110"}}
+        ---
+        kind: Node
+        metadata: {name: n1}
+        status: {allocatable: {cpu: "4", memory: 8Gi, pods: "110"}}
+        ---
+        kind: Pod
+        metadata:
+          name: lonely
+          namespace: default
+          ownerReferences: [{kind: ReplicaSet, name: web-abc}]
+        spec:
+          nodeName: n1
+          containers:
+            - name: c
+              resources: {requests: {cpu: 500m}}
+    """))
+    from open_simulator_tpu.cli.main import main
+
+    rc = main(["migrate", "--cluster-config", str(d)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Migration moves" in out
