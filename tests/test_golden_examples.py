@@ -1,0 +1,58 @@
+"""Golden regression tests over the examples corpus.
+
+Placements are deterministic (lowest-index tie-break, fixed pod order), so
+any engine/encoder change that shifts a placement fails here loudly. If a
+change is *intended* (e.g. a scoring-parity fix), regenerate with:
+
+    python -m tests.test_golden_examples   # rewrites tests/golden/*.json
+"""
+
+import json
+import os
+
+from open_simulator_tpu.api.v1alpha1 import load_config
+from open_simulator_tpu.core import AppResource, simulate
+from open_simulator_tpu.k8s.loader import load_resources_from_directory
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN_DIR = os.path.join(REPO, "tests", "golden")
+
+
+def _run_config(config_name):
+    cfg = load_config(os.path.join(REPO, "examples", config_name))
+    base = os.path.join(REPO, "examples")
+    cluster = load_resources_from_directory(os.path.join(base, cfg.cluster.custom_config))
+    apps = [
+        AppResource(name=a.name, resources=load_resources_from_directory(os.path.join(base, a.path)))
+        for a in cfg.app_list
+    ]
+    result = simulate(cluster, apps)
+    return {
+        "placements": dict(sorted(result.placements().items())),
+        "unscheduled": sorted(u.pod.key for u in result.unscheduled_pods),
+    }
+
+
+CONFIGS = ["config.yaml", "gpushare-config.yaml"]
+
+
+def _golden_path(name):
+    return os.path.join(GOLDEN_DIR, name.replace(".yaml", ".json"))
+
+
+def test_golden_placements():
+    for name in CONFIGS:
+        got = _run_config(name)
+        path = _golden_path(name)
+        assert os.path.exists(path), f"golden file missing — regenerate: python -m tests.test_golden_examples"
+        with open(path) as f:
+            want = json.load(f)
+        assert got == want, f"placements changed for {name} (regenerate if intended)"
+
+
+if __name__ == "__main__":
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    for name in CONFIGS:
+        with open(_golden_path(name), "w") as f:
+            json.dump(_run_config(name), f, indent=1, sort_keys=True)
+        print("wrote", _golden_path(name))
