@@ -86,6 +86,43 @@ def _load_resources_file(path: str) -> ClusterResources:
     return res
 
 
+def build_cluster_from_config(config: SimonConfig, base_dir: str) -> ClusterResources:
+    """Cluster inputs for a Simon config (shared by the CLI applier and the
+    golden regression tests so both exercise the same assembly path)."""
+    cc = config.cluster
+    if cc.kube_config:
+        raise ApplyError(
+            "cluster.kubeConfig requires a live Kubernetes API; this "
+            "environment has no cluster access — use cluster.customConfig "
+            "(or the REST server's snapshot request body)"
+        )
+    path = os.path.join(base_dir, cc.custom_config)
+    cluster = load_resources_from_directory(path, strict=False)
+    if not cluster.nodes:
+        raise ApplyError(f"cluster customConfig {path} contains no nodes")
+    cluster.nodes = [make_valid_node(n) for n in cluster.nodes]
+    return cluster
+
+
+def build_apps_from_config(config: SimonConfig, base_dir: str) -> List[AppResource]:
+    apps: List[AppResource] = []
+    for entry in config.app_list:
+        path = os.path.join(base_dir, entry.path)
+        if entry.chart:
+            from open_simulator_tpu.chart.renderer import process_chart
+            from open_simulator_tpu.k8s.loader import demux_object
+
+            res = ClusterResources()
+            for doc in process_chart(path):
+                demux_object(doc, res)
+            apps.append(AppResource(name=entry.name, resources=res))
+        else:
+            apps.append(
+                AppResource(name=entry.name, resources=load_resources_from_directory(path))
+            )
+    return apps
+
+
 class Applier:
     def __init__(self, options: ApplyOptions):
         self.opts = options
@@ -99,39 +136,10 @@ class Applier:
     # ---- inputs --------------------------------------------------------
 
     def _build_cluster(self) -> ClusterResources:
-        cc = self.config.cluster
-        if cc.kube_config:
-            raise ApplyError(
-                "cluster.kubeConfig requires a live Kubernetes API; this "
-                "environment has no cluster access — use cluster.customConfig "
-                "(or the REST server's snapshot request body)"
-            )
-        path = os.path.join(self.base_dir, cc.custom_config)
-        cluster = load_resources_from_directory(path, strict=False)
-        if not cluster.nodes:
-            raise ApplyError(f"cluster customConfig {path} contains no nodes")
-        cluster.nodes = [make_valid_node(n) for n in cluster.nodes]
-        return cluster
+        return build_cluster_from_config(self.config, self.base_dir)
 
     def _build_apps(self) -> List[AppResource]:
-        apps: List[AppResource] = []
-        for entry in self.config.app_list:
-            path = os.path.join(self.base_dir, entry.path)
-            if entry.chart:
-                from open_simulator_tpu.chart.renderer import process_chart
-
-                docs = process_chart(path)
-                res = ClusterResources()
-                from open_simulator_tpu.k8s.loader import demux_object
-
-                for doc in docs:
-                    demux_object(doc, res)
-                apps.append(AppResource(name=entry.name, resources=res))
-            else:
-                apps.append(
-                    AppResource(name=entry.name, resources=load_resources_from_directory(path))
-                )
-        return apps
+        return build_apps_from_config(self.config, self.base_dir)
 
     def _thresholds(self) -> SweepThresholds:
         def env_pct(name: str) -> float:

@@ -43,7 +43,8 @@ def initialize_multihost(
 
 def global_scenario_mesh(n_node_axis: int = 1):
     """A mesh over every device in the job (all hosts), scenario-major.
-    Feed lane batches via jax.make_array_from_process_local_data so each
-    host materializes only its shard."""
-    n_total = len(jax.devices())
-    return make_mesh(n_scenario=n_total // n_node_axis, n_node=n_node_axis)
+    Raises if n_node_axis does not divide the device count — a host whose
+    devices fell out of the mesh would hang, not error. Feed lane batches
+    via jax.make_array_from_process_local_data so each host materializes
+    only its shard."""
+    return make_mesh(n_node=n_node_axis, require_all=True)

@@ -54,14 +54,26 @@ class CapacityPlan:
     gpu_pick: Optional[np.ndarray] = field(repr=False, default=None)  # [S, P, G]
 
 
-def make_mesh(n_scenario: Optional[int] = None, n_node: int = 1) -> Mesh:
+def make_mesh(
+    n_scenario: Optional[int] = None, n_node: int = 1, require_all: bool = False
+) -> Mesh:
     """Build a ("scenario", "node") mesh over the available devices.
-    Defaults to all devices on the scenario axis (pure data parallel)."""
+    Defaults to all devices on the scenario axis (pure data parallel).
+    Unused trailing devices are dropped unless require_all — multi-host
+    callers must not silently exclude a host's devices (a host with no
+    addressable shard hangs instead of erroring)."""
     devs = np.array(jax.devices())
     if n_scenario is None:
         n_scenario = len(devs) // n_node
-    devs = devs[: n_scenario * n_node].reshape(n_scenario, n_node)
-    return Mesh(devs, axis_names=("scenario", "node"))
+    used = n_scenario * n_node
+    if used > len(devs):
+        raise ValueError(f"mesh {n_scenario}x{n_node} needs {used} devices, have {len(devs)}")
+    if require_all and used != len(devs):
+        raise ValueError(
+            f"mesh {n_scenario}x{n_node} uses {used} of {len(devs)} devices; "
+            f"pick a node axis that divides the device count"
+        )
+    return Mesh(devs[:used].reshape(n_scenario, n_node), axis_names=("scenario", "node"))
 
 
 def batched_schedule(

@@ -11,8 +11,8 @@ import json
 import os
 
 from open_simulator_tpu.api.v1alpha1 import load_config
-from open_simulator_tpu.core import AppResource, simulate
-from open_simulator_tpu.k8s.loader import load_resources_from_directory
+from open_simulator_tpu.apply.applier import build_apps_from_config, build_cluster_from_config
+from open_simulator_tpu.core import simulate
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 GOLDEN_DIR = os.path.join(REPO, "tests", "golden")
@@ -21,11 +21,8 @@ GOLDEN_DIR = os.path.join(REPO, "tests", "golden")
 def _run_config(config_name):
     cfg = load_config(os.path.join(REPO, "examples", config_name))
     base = os.path.join(REPO, "examples")
-    cluster = load_resources_from_directory(os.path.join(base, cfg.cluster.custom_config))
-    apps = [
-        AppResource(name=a.name, resources=load_resources_from_directory(os.path.join(base, a.path)))
-        for a in cfg.app_list
-    ]
+    cluster = build_cluster_from_config(cfg, base)
+    apps = build_apps_from_config(cfg, base)
     result = simulate(cluster, apps)
     return {
         "placements": dict(sorted(result.placements().items())),
