@@ -117,13 +117,40 @@ def decode_result(
     )
 
 
+def _resolve_priorities(pods: List[Pod], cluster: ClusterResources, apps: List[AppResource]) -> None:
+    """Stamp pod.priority from PriorityClass objects (name -> value, plus a
+    globalDefault class), mirroring the admission defaulting the reference
+    gets for free from its typed fixtures."""
+    classes: Dict[str, int] = {}
+    default = 0
+    for src in [cluster] + [a.resources for a in apps]:
+        for pc in src.priority_classes:
+            classes[pc.meta.name] = pc.value
+            if pc.global_default:
+                default = pc.value
+    for p in pods:
+        if p.priority:
+            continue
+        if p.priority_class_name:
+            p.priority = classes.get(p.priority_class_name, default)
+        else:
+            p.priority = default
+
+
+def _priority_sort(pods: List[Pod]) -> List[Pod]:
+    """PrioritySort queue plugin (vendored queuesort/priority_sort.go):
+    higher priority pops first; stable keeps submission order among equals."""
+    return sorted(pods, key=lambda p: -p.priority)
+
+
 def build_pod_sequence(
     cluster: ClusterResources,
     apps: List[AppResource],
     use_greed: bool = False,
 ) -> List[Pod]:
     """Cluster pods first (placed + pending), then each app in config order
-    (reference: core.go:93-131). --use-greed sorts each app's pods by
+    (reference: core.go:93-131); each scheduling batch is priority-ordered
+    like the activeQ. --use-greed additionally sorts each app's pods by
     descending dominant share (the reference parses but never wires this
     flag; here it works)."""
     nodes = cluster.nodes
@@ -132,12 +159,17 @@ def build_pod_sequence(
     for n in nodes:
         for r, v in n.allocatable.items():
             totals[r] = totals.get(r, 0) + v
+    all_batches = [pods]
     for app in apps:
         app_pods = expand_app_resources(app.resources, nodes, app.name)
         if use_greed:
             app_pods = sort_pods_greedy(app_pods, totals)
-        pods.extend(app_pods)
-    return pods
+        all_batches.append(app_pods)
+    out: List[Pod] = []
+    for batch in all_batches:
+        _resolve_priorities(batch, cluster, apps)
+        out.extend(_priority_sort(batch))
+    return out
 
 
 def simulate(

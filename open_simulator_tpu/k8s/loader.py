@@ -28,6 +28,7 @@ from open_simulator_tpu.k8s.objects import (
 _KIND_MAP = {
     "Node": k8s.Node,
     "Pod": k8s.Pod,
+    "PriorityClass": k8s.PriorityClass,
     "Deployment": k8s.Deployment,
     "ReplicaSet": k8s.ReplicaSet,
     "StatefulSet": k8s.StatefulSet,
@@ -60,6 +61,7 @@ class ClusterResources:
     storage_classes: List[k8s.StorageClass] = field(default_factory=list)
     pvcs: List[k8s.PersistentVolumeClaim] = field(default_factory=list)
     config_maps: List[k8s.ConfigMap] = field(default_factory=list)
+    priority_classes: List[k8s.PriorityClass] = field(default_factory=list)
 
     _FIELD_BY_KIND = {
         "Node": "nodes",
@@ -75,6 +77,7 @@ class ClusterResources:
         "StorageClass": "storage_classes",
         "PersistentVolumeClaim": "pvcs",
         "ConfigMap": "config_maps",
+        "PriorityClass": "priority_classes",
     }
 
     def add(self, obj: Any, kind: str) -> None:

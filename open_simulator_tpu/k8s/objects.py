@@ -235,6 +235,8 @@ class Pod:
     containers: List[Container] = field(default_factory=list)
     init_containers: List[Container] = field(default_factory=list)
     # required/preferred node affinity, raw k8s shape
+    priority: int = 0  # resolved from priorityClassName / spec.priority
+    priority_class_name: str = ""
     node_affinity_required: Optional[List[Dict[str, Any]]] = None  # nodeSelectorTerms
     node_affinity_preferred: List[Dict[str, Any]] = field(default_factory=list)
     pod_affinity_required: List[PodAffinityTerm] = field(default_factory=list)
@@ -306,6 +308,8 @@ class Pod:
             meta=meta,
             node_name=spec.get("nodeName", "") or "",
             scheduler_name=spec.get("schedulerName") or DEFAULT_SCHEDULER,
+            priority=int(spec.get("priority") or 0),
+            priority_class_name=spec.get("priorityClassName", "") or "",
             node_selector=dict(spec.get("nodeSelector") or {}),
             tolerations=[Toleration.from_dict(t) for t in spec.get("tolerations") or []],
             containers=containers,
@@ -492,3 +496,15 @@ class PersistentVolumeClaim(_Passthrough):
 
 class ConfigMap(_Passthrough):
     KIND = "ConfigMap"
+
+
+class PriorityClass(_Passthrough):
+    KIND = "PriorityClass"
+
+    @property
+    def value(self) -> int:
+        return int(self.raw.get("value", 0))
+
+    @property
+    def global_default(self) -> bool:
+        return bool(self.raw.get("globalDefault", False))

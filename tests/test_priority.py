@@ -1,0 +1,47 @@
+"""PriorityClass resolution + PrioritySort queue ordering."""
+
+from open_simulator_tpu.core import AppResource, simulate
+from open_simulator_tpu.k8s.loader import ClusterResources
+from open_simulator_tpu.k8s.objects import PriorityClass
+from tests.conftest import make_node, make_pod
+
+
+def pc(name, value, default=False):
+    return PriorityClass.from_dict({
+        "apiVersion": "scheduling.k8s.io/v1", "kind": "PriorityClass",
+        "metadata": {"name": name}, "value": value, "globalDefault": default,
+    })
+
+
+def test_high_priority_scheduled_first_under_scarcity():
+    # One node that fits exactly one pod; low-priority pod submitted first.
+    cluster = ClusterResources()
+    cluster.nodes = [make_node("n0", cpu_m=1000)]
+    cluster.priority_classes = [pc("critical", 1000), pc("best-effort", 1, default=True)]
+    app = ClusterResources()
+    low = make_pod("low", cpu="800m")
+    high = make_pod("high", cpu="800m")
+    high.priority_class_name = "critical"
+    app.pods = [low, high]  # submission order: low first
+    res = simulate(cluster, [AppResource(name="a", resources=app)])
+    placements = res.placements()
+    # PrioritySort pops 'high' first despite later submission
+    assert "default/high" in placements
+    assert [u.pod.meta.name for u in res.unscheduled_pods] == ["low"]
+
+
+def test_priority_resolution_fallback():
+    cluster = ClusterResources()
+    cluster.nodes = [make_node("n0")]
+    cluster.priority_classes = [pc("std", 100, default=True)]
+    app = ClusterResources()
+    named = make_pod("named")
+    named.priority_class_name = "std"
+    unknown = make_pod("unknown")
+    unknown.priority_class_name = "no-such-class"
+    plain = make_pod("plain")
+    app.pods = [named, unknown, plain]
+    res = simulate(cluster, [AppResource(name="a", resources=app)])
+    assert not res.unscheduled_pods
+    by_name = {sp.pod.meta.name: sp.pod.priority for sp in res.scheduled_pods}
+    assert by_name == {"named": 100, "unknown": 100, "plain": 100}  # globalDefault
