@@ -346,18 +346,30 @@ def encode_cluster(
                 topo_onehot[kk, i, v] = 1.0
 
     # ---- selector-group membership ------------------------------------
+    # Memoized per distinct (labels, namespace, owner): workload replicas
+    # share identity, so 50k pods usually mean only dozens of distinct rows.
     match_groups = np.zeros((len(pods), S), dtype=bool)
+    _row_cache: Dict[tuple, np.ndarray] = {}
     for pi, p in enumerate(pods):
-        for gid, (sel, namespaces) in enumerate(group_sel):
-            if sel == "__owner__":
-                ns, kind, name = namespaces
-                match_groups[pi, gid] = (
-                    p.meta.namespace == ns
-                    and p.meta.owner_kind == kind
-                    and p.meta.owner_name == name
-                )
-            elif p.meta.namespace in namespaces and labels_match_selector(p.meta.labels, sel):
-                match_groups[pi, gid] = True
+        cache_key = (
+            tuple(sorted(p.meta.labels.items())), p.meta.namespace,
+            p.meta.owner_kind, p.meta.owner_name,
+        )
+        row = _row_cache.get(cache_key)
+        if row is None:
+            row = np.zeros(S, dtype=bool)
+            for gid, (sel, namespaces) in enumerate(group_sel):
+                if sel == "__owner__":
+                    ns, kind, name = namespaces
+                    row[gid] = (
+                        p.meta.namespace == ns
+                        and p.meta.owner_kind == kind
+                        and p.meta.owner_name == name
+                    )
+                elif p.meta.namespace in namespaces and labels_match_selector(p.meta.labels, sel):
+                    row[gid] = True
+            _row_cache[cache_key] = row
+        match_groups[pi] = row
 
     # ---- anti-affinity term registry ----------------------------------
     term_key_arr = np.zeros(T, dtype=np.int64)
@@ -365,12 +377,11 @@ def encode_cluster(
         term_key_arr[tid] = kid
     own_terms = np.zeros((len(pods), T), dtype=bool)
     hit_terms = np.zeros((len(pods), T), dtype=bool)
-    for pi, p in enumerate(pods):
+    for pi in range(len(pods)):
         for gid, kid in pod_anti_terms[pi]:
             own_terms[pi, term_vocab.index[(gid, kid)]] = True
-        for (gid, kid), tid in term_vocab.index.items():
-            if match_groups[pi, gid]:
-                hit_terms[pi, tid] = True
+    for (gid, kid), tid in term_vocab.index.items():
+        hit_terms[:, tid] = match_groups[:, gid]
 
     # ---- preferred-term registry (existing-pods scoring direction) ----
     T2 = max(len(pref_term_vocab), 1)
@@ -378,10 +389,8 @@ def encode_cluster(
     for (gid, kid), tid in pref_term_vocab.index.items():
         pref_term_key_arr[tid] = kid
     hit_pref_terms = np.zeros((len(pods), T2), dtype=bool)
-    for pi in range(len(pods)):
-        for (gid, kid), tid in pref_term_vocab.index.items():
-            if match_groups[pi, gid]:
-                hit_pref_terms[pi, tid] = True
+    for (gid, kid), tid in pref_term_vocab.index.items():
+        hit_pref_terms[:, tid] = match_groups[:, gid]
 
     # ---- compat classes ------------------------------------------------
     class_vocab = _Vocab()
