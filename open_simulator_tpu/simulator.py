@@ -1,0 +1,106 @@
+"""Incremental Simulator API (reference: pkg/simulator/simulator.go).
+
+The reference's library surface is NewSimulator -> RunCluster ->
+ScheduleApp(app) per app -> Close. Here the same session shape is offered
+on top of the deterministic scan: each schedule_app() appends the app's
+pods to the sequence and re-runs the whole scan on device. Determinism
+makes the prefix placements identical run to run (tested by
+tests/test_checkpoint.py's split-scan property), so each call returns
+exactly the new app's placements while every prior app's stay fixed —
+semantically identical to the reference's stateful fake cluster, minus
+the mutable state. Re-running the prefix costs milliseconds on TPU and
+keeps selector/term vocabularies exact as they grow.
+
+close() exists for API parity and is a no-op: there is no scheduler
+goroutine to flush (reference needs a throwaway pod for that,
+simulator.go:351-364 — a fragility this design deletes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from open_simulator_tpu.core import (
+    AppResource,
+    SimulateResult,
+    decode_result,
+    _priority_sort,
+    _resolve_priorities,
+)
+from open_simulator_tpu.encode.snapshot import EncodeOptions, encode_cluster
+from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
+from open_simulator_tpu.k8s.loader import ClusterResources, make_valid_node
+from open_simulator_tpu.k8s.objects import LABEL_APP_NAME, Pod
+from open_simulator_tpu.models.expand import expand_app_resources, expand_cluster_pods
+
+
+class Simulator:
+    """A scheduling session over one cluster."""
+
+    def __init__(
+        self,
+        cluster: ClusterResources,
+        encode_options: Optional[EncodeOptions] = None,
+        config_overrides: Optional[Dict] = None,
+    ):
+        self.cluster = cluster
+        self.cluster.nodes = [make_valid_node(n) for n in cluster.nodes]
+        self._encode_options = encode_options
+        self._overrides = config_overrides or {}
+        self._pods: List[Pod] = []
+        self._apps: List[AppResource] = []
+        self._last: Optional[SimulateResult] = None
+
+    # -- reference: RunCluster (simulator.go:218) -----------------------
+    def run_cluster(self) -> SimulateResult:
+        """Place the cluster's own pods (pinned + pending + workloads)."""
+        batch = expand_cluster_pods(self.cluster)
+        _resolve_priorities(batch, self.cluster, self._apps)
+        self._pods = _priority_sort(batch)
+        return self._run(select_app=None)
+
+    # -- reference: ScheduleApp (simulator.go:225) ----------------------
+    def schedule_app(self, app: AppResource) -> SimulateResult:
+        """Schedule one more app; returns only this app's placements."""
+        batch = expand_app_resources(app.resources, self.cluster.nodes, app.name)
+        self._apps.append(app)
+        _resolve_priorities(batch, self.cluster, self._apps)
+        self._pods = self._pods + _priority_sort(batch)
+        return self._run(select_app=app.name)
+
+    def cluster_status(self) -> Optional[SimulateResult]:
+        """Full-state view after the last call (reference: getClusterNodeStatus)."""
+        return self._last
+
+    def close(self) -> None:  # API parity; nothing to flush
+        return None
+
+    # -- internals -------------------------------------------------------
+    def _run(self, select_app: Optional[str]) -> SimulateResult:
+        snapshot = encode_cluster(self.cluster.nodes, self._pods, self._encode_options)
+        cfg = make_config(snapshot, **self._overrides)
+        arrs = device_arrays(snapshot)
+        out = schedule_pods(arrs, arrs.active, cfg)
+        result = decode_result(
+            snapshot,
+            np.asarray(out.node),
+            np.asarray(out.fail_counts),
+            np.asarray(arrs.active),
+            gpu_pick=np.asarray(out.gpu_pick) if cfg.enable_gpu else None,
+        )
+        self._last = result
+        if select_app is None:
+            return result
+        # trim to the newly scheduled app, like ScheduleApp's per-app result
+        def is_app(pod: Pod) -> bool:
+            return pod.meta.labels.get(LABEL_APP_NAME) == select_app
+
+        return SimulateResult(
+            unscheduled_pods=[u for u in result.unscheduled_pods if is_app(u.pod)],
+            scheduled_pods=[s for s in result.scheduled_pods if is_app(s.pod)],
+            node_status=result.node_status,
+            elapsed_s=result.elapsed_s,
+            snapshot=result.snapshot,
+        )

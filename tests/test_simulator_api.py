@@ -1,0 +1,47 @@
+"""Incremental Simulator session API: prefix stability across apps."""
+
+from open_simulator_tpu.core import AppResource
+from open_simulator_tpu.k8s.loader import ClusterResources
+from open_simulator_tpu.simulator import Simulator
+from open_simulator_tpu.testing import make_fake_deployment, make_fake_node, make_fake_pod
+
+
+def test_incremental_apps_keep_prior_placements():
+    cluster = ClusterResources()
+    cluster.nodes = [make_fake_node(f"n{i}", cpu="8", memory="16Gi") for i in range(3)]
+    cluster.pods = [make_fake_pod("seed", node_name="n0", cpu="1")]
+
+    sim = Simulator(cluster)
+    r0 = sim.run_cluster()
+    assert r0.placements() == {"default/seed": "n0"}
+
+    app1 = ClusterResources()
+    app1.deployments = [make_fake_deployment("alpha", replicas=3, cpu="2")]
+    r1 = sim.schedule_app(AppResource(name="alpha", resources=app1))
+    assert len(r1.scheduled_pods) == 3
+    alpha_placements = {s.pod.key: s.node_name for s in r1.scheduled_pods}
+
+    app2 = ClusterResources()
+    app2.deployments = [make_fake_deployment("beta", replicas=2, cpu="2")]
+    r2 = sim.schedule_app(AppResource(name="beta", resources=app2))
+    # beta's result contains only beta pods
+    assert all("beta" in s.pod.meta.name for s in r2.scheduled_pods)
+    # alpha's placements are unchanged in the full state view
+    full = sim.cluster_status().placements()
+    for key, node in alpha_placements.items():
+        assert full[key] == node
+    assert full["default/seed"] == "n0"
+    sim.close()
+
+
+def test_app_overflow_reported_per_app():
+    cluster = ClusterResources()
+    cluster.nodes = [make_fake_node("n0", cpu="2")]
+    sim = Simulator(cluster)
+    sim.run_cluster()
+    app = ClusterResources()
+    app.deployments = [make_fake_deployment("big", replicas=3, cpu="1")]
+    r = sim.schedule_app(AppResource(name="big", resources=app))
+    assert len(r.scheduled_pods) == 2  # 2000m / 1000m
+    assert len(r.unscheduled_pods) == 1
+    assert "Insufficient cpu" in r.unscheduled_pods[0].reason
