@@ -132,6 +132,7 @@ class Applier:
         self.base_dir = os.path.dirname(os.path.abspath(options.config_path))
         self.config.validate(self.base_dir)
         self._out = sys.stdout
+        self._pdbs = []
 
     # ---- inputs --------------------------------------------------------
 
@@ -201,6 +202,7 @@ class Applier:
             os.path.join(self.base_dir, self.config.new_node) if self.config.new_node else ""
         )
 
+        self._pdbs = list(cluster.pdbs) + [p for a in apps for p in a.resources.pdbs]
         pods = build_pod_sequence(cluster, apps, use_greed=self.opts.use_greed)
         max_new = self.opts.max_new_nodes if template is not None else 0
         snapshot = encode_cluster(
@@ -227,12 +229,12 @@ class Applier:
                 f"FAILED: apps do not fit even with {max_new} new node(s) "
                 f"(raise --max-new-nodes or adjust the newNode spec)"
             )
-            worst = self._result_for(snapshot, plan, len(counts) - 1)
+            worst = self._result_for(snapshot, plan, len(counts) - 1, cfg)
             self._say(full_report(worst, self.opts.extended_resources))
             return 1
 
         best_idx = plan.counts.index(plan.best_count)
-        result = self._result_for(snapshot, plan, best_idx)
+        result = self._result_for(snapshot, plan, best_idx, cfg)
         if plan.best_count > 0:
             self._say(
                 f"cluster requires {plan.best_count} new node(s) of the given spec "
@@ -249,10 +251,44 @@ class Applier:
         self._say(full_report(result, self.opts.extended_resources))
         return 0
 
-    def _result_for(self, snapshot, plan, idx: int) -> SimulateResult:
+    def _result_for(self, snapshot, plan, idx: int, cfg=None) -> SimulateResult:
         from open_simulator_tpu.parallel.sweep import active_masks_for_counts
 
         masks = active_masks_for_counts(snapshot, plan.counts)
+        import numpy as _np
+
+        lane_has_unscheduled = bool(_np.any(plan.nodes_per_scenario[idx] < 0))
+        if (
+            cfg is not None
+            and lane_has_unscheduled
+            and any(p.priority > 0 for p in snapshot.pods)
+        ):
+            # Preemption never changes the sweep verdict (victims are deleted,
+            # so the scheduled count cannot grow), but the chosen lane's
+            # placements and reasons should reflect the PostFilter pass.
+            import numpy as np
+
+            from open_simulator_tpu.engine.preemption import run_with_preemption
+            from open_simulator_tpu.engine.scheduler import device_arrays, schedule_pods
+
+            arrs = device_arrays(snapshot)
+            lane_active = np.asarray(masks[idx])
+
+            def schedule_fn(disabled, nominated):
+                return schedule_pods(arrs, lane_active, cfg, disabled=disabled,
+                                     nominated=nominated)
+
+            out, pre = run_with_preemption(
+                snapshot, lane_active, schedule_fn, list(self._pdbs or [])
+            )
+            return decode_result(
+                snapshot,
+                np.asarray(out.node),
+                np.asarray(out.fail_counts),
+                lane_active,
+                gpu_pick=np.asarray(out.gpu_pick) if cfg.enable_gpu else None,
+                preempted_by=pre.preempted_by,
+            )
         return decode_result(
             snapshot,
             plan.nodes_per_scenario[idx],
@@ -269,7 +305,7 @@ class Applier:
         current = 0
         while True:
             idx = plan.counts.index(current)
-            result = self._result_for(snapshot, plan, idx)
+            result = self._result_for(snapshot, plan, idx, cfg)
             n_failed = len(result.unscheduled_pods)
             if n_failed == 0:
                 self._say(f"all pods scheduled with {current} new node(s)")

@@ -80,6 +80,7 @@ def decode_result(
     active: np.ndarray,
     elapsed_s: float = 0.0,
     gpu_pick: Optional[np.ndarray] = None,
+    preempted_by: Optional[Dict[int, int]] = None,
 ) -> SimulateResult:
     n_active = int(np.sum(active))
     scheduled: List[ScheduledPod] = []
@@ -98,7 +99,11 @@ def decode_result(
             scheduled.append(ScheduledPod(pod=pod, node_name=snapshot.node_names[ni]))
             pods_by_node.setdefault(ni, []).append(pod)
         else:
-            if int(forced[i]) == -2:  # nodeName pointed at a node that doesn't exist
+            if ni == -3 and preempted_by and i in preempted_by:
+                # victim of DefaultPreemption: deleted to admit the preemptor
+                pre = snapshot.pods[preempted_by[i]]
+                reason = f'preempted to admit higher-priority pod "{pre.key}"'
+            elif int(forced[i]) == -2:  # nodeName pointed at a node that doesn't exist
                 reason = f'node "{pod.node_name}" not found'
             else:
                 reason = format_failure_reason(fail_counts[i], snapshot.op_names, n_active)
@@ -178,8 +183,13 @@ def simulate(
     use_greed: bool = False,
     encode_options: Optional[EncodeOptions] = None,
     config_overrides: Optional[Dict] = None,
+    preemption: bool = True,
 ) -> SimulateResult:
-    """Run one full simulation on the default device (TPU when present)."""
+    """Run one full simulation on the default device (TPU when present).
+
+    preemption=True enables the DefaultPreemption PostFilter pass (a no-op
+    unless some pod carries a nonzero priority, so the default costs nothing
+    on priority-free clusters — the reference's own fixtures are such)."""
     t0 = time.perf_counter()
     nodes = [make_valid_node(n) for n in cluster.nodes]
     cluster = _with_nodes(cluster, nodes)
@@ -187,13 +197,28 @@ def simulate(
     snapshot = encode_cluster(nodes, pods, encode_options)
     cfg = make_config(snapshot, **(config_overrides or {}))
     arrs = device_arrays(snapshot)
-    out = schedule_pods(arrs, arrs.active, cfg)
+    active_np = np.asarray(arrs.active)
+    preempted_by: Optional[Dict[int, int]] = None
+    if preemption:
+        from open_simulator_tpu.engine.preemption import run_with_preemption
+
+        pdbs = list(cluster.pdbs) + [p for a in apps for p in a.resources.pdbs]
+
+        def schedule_fn(disabled, nominated):
+            return schedule_pods(arrs, arrs.active, cfg, disabled=disabled,
+                                 nominated=nominated)
+
+        out, pre = run_with_preemption(snapshot, active_np, schedule_fn, pdbs)
+        preempted_by = pre.preempted_by
+    else:
+        out = schedule_pods(arrs, arrs.active, cfg)
     node_assign = np.asarray(out.node)
     fail_counts = np.asarray(out.fail_counts)
     gpu_pick = np.asarray(out.gpu_pick) if cfg.enable_gpu else None
     elapsed = time.perf_counter() - t0
     return decode_result(
-        snapshot, node_assign, fail_counts, np.asarray(arrs.active), elapsed, gpu_pick
+        snapshot, node_assign, fail_counts, active_np, elapsed, gpu_pick,
+        preempted_by=preempted_by,
     )
 
 

@@ -201,6 +201,15 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
         score += cfg.w_gpu * gpu_share.gpu_share_score(
             state.gpu_used, arrs.gpu_cap_mem, arrs.gpu_slot, x["gpu_mem"], x["gpu_cnt"], mask)
 
+    # Preemption retry: a nominated node (status.nominatedNodeName analog,
+    # defaultpreemption PostFilter) restricts the pick to that node while it
+    # is still feasible; if other pods took it meanwhile, fall back to the
+    # full feasible set like the vendored retry does.
+    nom = x["_nominated"]
+    nom_row = jax.nn.one_hot(nom, n_nodes, dtype=bool)  # -1 -> all-zero row
+    use_nom = (nom >= 0) & jnp.any(mask & nom_row)
+    mask = jnp.where(use_nom, mask & nom_row, mask)
+
     neg_inf = jnp.float32(-3.4e38)
     if cfg.tie_break_seed:
         # quantize to the framework's integer score scale first, so jitter can
@@ -217,6 +226,12 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
     final_node = jnp.where(
         forced >= 0, forced, jnp.where(do_schedule & any_feasible, sel_node, -1)
     ).astype(jnp.int32)
+    # A preemption victim is a deleted pod: no bind, no reasons, node = -3
+    # (the host decodes -3 as "preempted by <pod>").
+    dis = x["_disabled"]
+    final_node = jnp.where(dis, jnp.int32(-3), final_node)
+    fail_counts = jnp.where(dis, 0, fail_counts)
+    feasible_n = jnp.where(dis, 0, feasible_n)
 
     # ---- bind: carry update (masked when final_node < 0) --------------
     # NOTE(perf): onehot outer-product adds beat .at[node] row-scatters here —
@@ -273,11 +288,24 @@ def schedule_pods(
     active: jnp.ndarray,
     cfg: EngineConfig,
     state: SimState | None = None,
+    disabled: jnp.ndarray | None = None,
+    nominated: jnp.ndarray | None = None,
 ) -> ScheduleOutput:
-    """Scan the pod sequence, return assignments + reason counts + final state."""
+    """Scan the pod sequence, return assignments + reason counts + final state.
+
+    disabled [P] bool marks preemption victims (treated as deleted);
+    nominated [P] i32 is the preemption retry's nominatedNodeName (-1 = none).
+    """
     if state is None:
         state = init_state(arrs)
     xs = _pod_xs(arrs)
+    n_pods = arrs.req.shape[0]
+    xs["_disabled"] = (
+        jnp.zeros(n_pods, dtype=bool) if disabled is None else disabled.astype(bool)
+    )
+    xs["_nominated"] = (
+        jnp.full(n_pods, -1, jnp.int32) if nominated is None else nominated.astype(jnp.int32)
+    )
     step = functools.partial(_step, arrs, active, cfg)
     final_state, (nodes, fail_counts, feasible, gpu_pick) = jax.lax.scan(
         step, state, xs, unroll=cfg.scan_unroll

@@ -44,7 +44,14 @@ class Simulator:
         cluster: ClusterResources,
         encode_options: Optional[EncodeOptions] = None,
         config_overrides: Optional[Dict] = None,
+        preemption: bool = True,
     ):
+        self.preemption = preemption
+        # preemption state carried across schedule_app calls: victims stay
+        # deleted, prior placements stay pinned (kube bound-pods-never-move)
+        self._pre_disabled = np.zeros(0, dtype=bool)
+        self._pre_assign = np.zeros(0, dtype=np.int32)
+        self._preempted_by: Dict[int, int] = {}
         self.cluster = cluster
         self.cluster.nodes = [make_valid_node(n) for n in cluster.nodes]
         self._encode_options = encode_options
@@ -82,13 +89,38 @@ class Simulator:
         snapshot = encode_cluster(self.cluster.nodes, self._pods, self._encode_options)
         cfg = make_config(snapshot, **self._overrides)
         arrs = device_arrays(snapshot)
-        out = schedule_pods(arrs, arrs.active, cfg)
+        preempted_by = None
+        if self.preemption:
+            from open_simulator_tpu.engine.preemption import run_with_preemption
+
+            pdbs = list(self.cluster.pdbs) + [
+                p for a in self._apps for p in a.resources.pdbs
+            ]
+
+            def schedule_fn(disabled, nominated):
+                return schedule_pods(arrs, arrs.active, cfg, disabled=disabled,
+                                     nominated=nominated)
+
+            out, pre = run_with_preemption(
+                snapshot, np.asarray(arrs.active), schedule_fn, pdbs,
+                init_disabled=self._pre_disabled,
+                init_nominated=np.where(
+                    self._pre_assign >= 0, self._pre_assign, -1
+                ).astype(np.int32),
+            )
+            self._preempted_by.update(pre.preempted_by)
+            preempted_by = dict(self._preempted_by)
+            self._pre_disabled = np.asarray(pre.disabled)
+            self._pre_assign = np.asarray(out.node).astype(np.int32)
+        else:
+            out = schedule_pods(arrs, arrs.active, cfg)
         result = decode_result(
             snapshot,
             np.asarray(out.node),
             np.asarray(out.fail_counts),
             np.asarray(arrs.active),
             gpu_pick=np.asarray(out.gpu_pick) if cfg.enable_gpu else None,
+            preempted_by=preempted_by,
         )
         self._last = result
         if select_app is None:

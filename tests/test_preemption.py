@@ -1,0 +1,253 @@
+"""DefaultPreemption PostFilter pass (engine/preemption.py).
+
+Mirrors the vendored defaultpreemption semantics the reference compiles in
+(SURVEY.md §2b default plugin set): lower-priority victims evicted, retry on
+the nominated node, candidate ordering prefers fewer PDB violations and
+lower/fewer victims.
+"""
+
+from open_simulator_tpu.core import AppResource, simulate
+from open_simulator_tpu.k8s.loader import ClusterResources
+from open_simulator_tpu.k8s.objects import PodDisruptionBudget, PriorityClass
+from tests.conftest import make_node, make_pod
+
+
+def pc(name, value, default=False):
+    return PriorityClass.from_dict({
+        "apiVersion": "scheduling.k8s.io/v1", "kind": "PriorityClass",
+        "metadata": {"name": name}, "value": value, "globalDefault": default,
+    })
+
+
+def pdb(name, match_labels, min_available=None, max_unavailable=None, ns="default"):
+    spec = {"selector": {"matchLabels": match_labels}}
+    if min_available is not None:
+        spec["minAvailable"] = min_available
+    if max_unavailable is not None:
+        spec["maxUnavailable"] = max_unavailable
+    return PodDisruptionBudget.from_dict({
+        "apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+        "metadata": {"name": name, "namespace": ns}, "spec": spec,
+    })
+
+
+def _sim(cluster, *apps, **kw):
+    return simulate(cluster, [AppResource(name=f"a{i}", resources=a)
+                              for i, a in enumerate(apps)], **kw)
+
+
+def test_basic_preemption_evicts_lower_priority():
+    cluster = ClusterResources()
+    cluster.nodes = [make_node("n0", cpu_m=4000)]
+    cluster.priority_classes = [pc("critical", 1000)]
+    app1 = ClusterResources()
+    app1.pods = [make_pod("low-a", cpu="1800m"), make_pod("low-b", cpu="1800m")]
+    app2 = ClusterResources()
+    high = make_pod("high", cpu="1800m")
+    high.priority_class_name = "critical"
+    app2.pods = [high]
+    res = _sim(cluster, app1, app2)
+    placements = res.placements()
+    assert placements.get("default/high") == "n0"
+    # exactly one victim, with the preemption reason naming the preemptor
+    assert len(res.unscheduled_pods) == 1
+    victim = res.unscheduled_pods[0]
+    assert victim.pod.meta.name in ("low-a", "low-b")
+    assert 'preempted to admit higher-priority pod "default/high"' == victim.reason
+
+
+def test_no_preemption_among_equal_priorities():
+    cluster = ClusterResources()
+    cluster.nodes = [make_node("n0", cpu_m=4000)]
+    app = ClusterResources()
+    app.pods = [make_pod("a", cpu="1800m"), make_pod("b", cpu="1800m"),
+                make_pod("c", cpu="1800m")]
+    res = _sim(cluster, app)
+    assert len(res.unscheduled_pods) == 1
+    assert "Insufficient cpu" in res.unscheduled_pods[0].reason
+
+
+def test_preemption_flag_off():
+    cluster = ClusterResources()
+    cluster.nodes = [make_node("n0", cpu_m=4000)]
+    cluster.priority_classes = [pc("critical", 1000)]
+    app1 = ClusterResources()
+    app1.pods = [make_pod("low-a", cpu="1800m"), make_pod("low-b", cpu="1800m")]
+    app2 = ClusterResources()
+    high = make_pod("high", cpu="1800m")
+    high.priority_class_name = "critical"
+    app2.pods = [high]
+    res = _sim(cluster, app1, app2, preemption=False)
+    assert "default/high" not in res.placements()
+
+
+def test_victim_is_lowest_priority_pod():
+    # node holds a mid-priority and a zero-priority pod; evict the zero one
+    cluster = ClusterResources()
+    cluster.nodes = [make_node("n0", cpu_m=4000)]
+    cluster.priority_classes = [pc("mid", 100), pc("critical", 1000)]
+    app1 = ClusterResources()
+    mid = make_pod("mid", cpu="1800m")
+    mid.priority_class_name = "mid"
+    app1.pods = [mid, make_pod("zero", cpu="1800m")]
+    app2 = ClusterResources()
+    high = make_pod("high", cpu="1800m")
+    high.priority_class_name = "critical"
+    app2.pods = [high]
+    res = _sim(cluster, app1, app2)
+    placements = res.placements()
+    assert placements.get("default/high") == "n0"
+    assert placements.get("default/mid") == "n0"
+    assert [u.pod.meta.name for u in res.unscheduled_pods] == ["zero"]
+
+
+def test_pdb_steers_candidate_choice():
+    # Two nodes, both full of evictable pods; n0's pods are PDB-protected
+    # (minAvailable equals replica count), so the preemptor lands on n1.
+    cluster = ClusterResources()
+    cluster.nodes = [make_node("n0", cpu_m=2000), make_node("n1", cpu_m=2000)]
+    cluster.priority_classes = [pc("critical", 1000)]
+    cluster.pdbs = [pdb("guard", {"app": "guarded"}, min_available=1)]
+    app1 = ClusterResources()
+    app1.pods = [
+        make_pod("guarded", cpu="1800m", labels={"app": "guarded"},
+                 node_selector={"kubernetes.io/hostname": "n0"}),
+        make_pod("free", cpu="1800m",
+                 node_selector={"kubernetes.io/hostname": "n1"}),
+    ]
+    app2 = ClusterResources()
+    high = make_pod("high", cpu="1800m")
+    high.priority_class_name = "critical"
+    app2.pods = [high]
+    res = _sim(cluster, app1, app2)
+    placements = res.placements()
+    assert placements.get("default/high") == "n1"
+    assert [u.pod.meta.name for u in res.unscheduled_pods] == ["free"]
+
+
+def test_preemption_violates_pdb_only_as_last_resort():
+    # One node; the only victim is PDB-protected — vendored preemption still
+    # evicts (budgets order candidates, they don't veto).
+    cluster = ClusterResources()
+    cluster.nodes = [make_node("n0", cpu_m=2000)]
+    cluster.priority_classes = [pc("critical", 1000)]
+    cluster.pdbs = [pdb("guard", {"app": "guarded"}, min_available=1)]
+    app1 = ClusterResources()
+    app1.pods = [make_pod("guarded", cpu="1800m", labels={"app": "guarded"})]
+    app2 = ClusterResources()
+    high = make_pod("high", cpu="1800m")
+    high.priority_class_name = "critical"
+    app2.pods = [high]
+    res = _sim(cluster, app1, app2)
+    assert res.placements().get("default/high") == "n0"
+    assert [u.pod.meta.name for u in res.unscheduled_pods] == ["guarded"]
+
+
+def test_victims_are_deleted_not_requeued():
+    # Reference parity: simon's driver deletes failed/preempted pods from the
+    # fake clientset (simulator.go:328); a victim does not get rescheduled
+    # even if room exists elsewhere.
+    cluster = ClusterResources()
+    cluster.nodes = [make_node("n0", cpu_m=2000), make_node("n1", cpu_m=2000)]
+    cluster.priority_classes = [pc("mid", 100), pc("critical", 1000)]
+    app1 = ClusterResources()
+    mid = make_pod("mid", cpu="1800m",
+                   node_selector={"kubernetes.io/hostname": "n0"})
+    mid.priority_class_name = "mid"
+    app1.pods = [mid]
+    app2 = ClusterResources()
+    high = make_pod("high", cpu="1800m",
+                    node_selector={"kubernetes.io/hostname": "n0"})
+    high.priority_class_name = "critical"
+    app2.pods = [high]
+    res = _sim(cluster, app1, app2)
+    placements = res.placements()
+    assert placements.get("default/high") == "n0"
+    assert [u.pod.meta.name for u in res.unscheduled_pods] == ["mid"]
+    assert "preempted" in res.unscheduled_pods[0].reason
+
+
+def test_bound_pods_do_not_migrate_on_preemption_rescan():
+    # Without pinning, evicting v from n0 would let b (scanned later) migrate
+    # from n1 to the now-emptier n0 and strand the preemptor — kube never
+    # moves bound pods.
+    cluster = ClusterResources()
+    cluster.nodes = [make_node("n0", cpu_m=4000), make_node("n1", cpu_m=4000)]
+    cluster.priority_classes = [pc("critical", 1000)]
+    app1 = ClusterResources()
+    v = make_pod("victim", cpu="1800m",
+                 node_selector={"kubernetes.io/hostname": "n0"})
+    b = make_pod("bystander", cpu="1800m")  # lands on the emptier n1
+    app1.pods = [v, b]
+    app2 = ClusterResources()
+    high = make_pod("high", cpu="3000m",
+                    node_selector={"kubernetes.io/hostname": "n0"})
+    high.priority_class_name = "critical"
+    app2.pods = [high]
+    res = _sim(cluster, app1, app2)
+    placements = res.placements()
+    assert placements.get("default/bystander") == "n1"  # did not migrate
+    assert placements.get("default/high") == "n0"
+    assert [u.pod.meta.name for u in res.unscheduled_pods] == ["victim"]
+
+
+def test_rollback_when_preemptor_cannot_land():
+    # Preemptor fails on n0 for BOTH cpu and anti-affinity (vs an
+    # equal-priority pod the dry-run cannot evict). The resource dry-run
+    # plans an eviction, the rescan still fails anti-affinity, and the
+    # eviction must be rolled back — no spurious victim.
+    cluster = ClusterResources()
+    cluster.nodes = [make_node("n0", cpu_m=4000)]
+    cluster.priority_classes = [pc("mid", 100), pc("critical", 1000)]
+    app1 = ClusterResources()
+    eq = make_pod("equal", cpu="500m", labels={"app": "x"})
+    eq.priority_class_name = "mid"
+    low = make_pod("low", cpu="3000m")
+    app1.pods = [eq, low]
+    app2 = ClusterResources()
+    high = make_pod("high", cpu="1800m", affinity={
+        "podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "labelSelector": {"matchLabels": {"app": "x"}},
+                "topologyKey": "kubernetes.io/hostname",
+            }],
+        },
+    })
+    high.priority_class_name = "critical"
+    app2.pods = [high]
+    res = _sim(cluster, app1, app2)
+    placements = res.placements()
+    # both original pods kept their places; the preemptor reports failure
+    assert placements.get("default/equal") == "n0"
+    assert placements.get("default/low") == "n0"
+    assert [u.pod.meta.name for u in res.unscheduled_pods] == ["high"]
+    assert "preempted" not in res.unscheduled_pods[0].reason
+
+
+def test_session_api_keeps_victims_deleted():
+    from open_simulator_tpu.simulator import Simulator
+
+    cluster = ClusterResources()
+    cluster.nodes = [make_node("n0", cpu_m=4000)]
+    cluster.priority_classes = [pc("critical", 1000)]
+    sim = Simulator(cluster)
+    sim.run_cluster()
+    app1 = ClusterResources()
+    app1.pods = [make_pod("low-a", cpu="1800m"), make_pod("low-b", cpu="1800m")]
+    sim.schedule_app(AppResource(name="lows", resources=app1))
+    app2 = ClusterResources()
+    high = make_pod("high", cpu="1800m")
+    high.priority_class_name = "critical"
+    app2.pods = [high]
+    r2 = sim.schedule_app(AppResource(name="high", resources=app2))
+    assert "default/high" in r2.placements()
+    # a later call must not resurrect the deleted victim
+    app3 = ClusterResources()
+    app3.pods = [make_pod("tiny", cpu="100m")]
+    sim.schedule_app(AppResource(name="tiny", resources=app3))
+    full = sim.cluster_status()
+    scheduled_names = {sp.pod.meta.name for sp in full.scheduled_pods}
+    assert "tiny" in scheduled_names and "high" in scheduled_names
+    assert "low-b" not in scheduled_names or "low-a" not in scheduled_names
+    victims = [u for u in full.unscheduled_pods if "preempted" in u.reason]
+    assert len(victims) == 1
