@@ -255,23 +255,26 @@ class Applier:
         from open_simulator_tpu.parallel.sweep import active_masks_for_counts
 
         masks = active_masks_for_counts(snapshot, plan.counts)
-        import numpy as _np
+        import numpy as np
 
-        lane_has_unscheduled = bool(_np.any(plan.nodes_per_scenario[idx] < 0))
+        lane_has_unscheduled = bool(np.any(plan.nodes_per_scenario[idx] < 0))
         if (
             cfg is not None
             and lane_has_unscheduled
-            and any(p.priority > 0 for p in snapshot.pods)
+            and len({p.priority for p in snapshot.pods}) > 1
         ):
             # Preemption never changes the sweep verdict (victims are deleted,
             # so the scheduled count cannot grow), but the chosen lane's
             # placements and reasons should reflect the PostFilter pass.
-            import numpy as np
-
             from open_simulator_tpu.engine.preemption import run_with_preemption
             from open_simulator_tpu.engine.scheduler import device_arrays, schedule_pods
 
-            arrs = device_arrays(snapshot)
+            if getattr(self, "_arrs_snapshot", None) is not snapshot:
+                # one host->device upload per snapshot, reused across the
+                # interactive prompt loop's repeated lane decodes
+                self._arrs_cache = device_arrays(snapshot)
+                self._arrs_snapshot = snapshot
+            arrs = self._arrs_cache
             lane_active = np.asarray(masks[idx])
 
             def schedule_fn(disabled, nominated):

@@ -317,8 +317,8 @@ def run_with_preemption(
     has_init = init_disabled is not None or init_nominated is not None
     result = PreemptionResult(disabled=disabled, nominated=nominated)
     out = schedule_fn(disabled if has_init else None, nominated if has_init else None)
-    if not any(p.priority > 0 for p in snapshot.pods):
-        return out, result  # nothing can outrank anything: no preemption possible
+    if len({p.priority for p in snapshot.pods}) <= 1:
+        return out, result  # all priorities equal: nothing can outrank anything
 
     events_all: List[PreemptionEvent] = []
     blocked: set = set()
@@ -350,7 +350,10 @@ def run_with_preemption(
                 break
             for ev in failed:
                 for v in ev.victim_indices:
+                    # reprieved victim: re-pin to the node it was bound to so
+                    # the rollback rescan cannot migrate it
                     disabled[v] = False
+                    nominated[v] = ev.node_index
                 nominated[ev.preemptor_index] = -1
                 blocked.add(ev.preemptor_index)
                 events_all.remove(ev)

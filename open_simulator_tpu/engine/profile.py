@@ -59,4 +59,25 @@ def weight_overrides_from_file(path: str) -> Dict[str, float]:
         field = _SCORE_PLUGIN_FIELDS.get(name)
         if field is not None and field not in overrides:
             overrides[field] = 0.0
+    _apply_plugin_config(profiles[0].get("pluginConfig") or [], overrides)
     return overrides
+
+
+def _apply_plugin_config(plugin_config, overrides: Dict[str, float]) -> None:
+    """pluginConfig args. NodeResourcesFitArgs.scoringStrategy selects the
+    allocation-scoring direction (LeastAllocated default / MostAllocated
+    bin-packing), the v1beta2+ replacement for the separate
+    NodeResources{Least,Most}Allocated plugins."""
+    for entry in plugin_config:
+        if entry.get("name") != "NodeResourcesFit":
+            continue
+        strategy = ((entry.get("args") or {}).get("scoringStrategy") or {})
+        stype = strategy.get("type", "")
+        if stype == "MostAllocated":
+            weight = overrides.get("w_least", 1.0)
+            overrides["w_least"] = 0.0
+            overrides["w_most"] = weight
+        elif stype == "LeastAllocated":
+            overrides["w_least"] = overrides.get("w_least", 1.0)
+        # other strategy types / args (ignoredResources etc.) leave the
+        # enable/disable weights untouched

@@ -144,24 +144,48 @@ def topology_spread_score(
     group_count: jnp.ndarray,
     topo_onehot: jnp.ndarray,
     has_key: jnp.ndarray,
+    active: jnp.ndarray,
     spread_group: jnp.ndarray,
     spread_key: jnp.ndarray,
+    spread_hard: jnp.ndarray,
     spread_valid: jnp.ndarray,
     feasible: jnp.ndarray,
 ) -> jnp.ndarray:
-    """PodTopologySpread score over the pod's constraints (soft + hard both
-    contribute to spreading preference): fewer matching pods in the node's
-    domain = higher score. Reverse-min-max normalized x100. This captures
-    the vendored scoring's spreading direction without its two-pass
-    per-topology normalization (scoring.go:180-260) — an intentional
-    simplification, flagged in ROADMAP."""
+    """PodTopologySpread score, the vendored two-pass shape
+    (podtopologyspread/scoring.go:180-260):
+
+    1. raw(node) = Σ_c matching-pods-in-node's-domain × log(#domains_c + 2)
+       over the pod's *soft* (ScheduleAnyway) constraints only — the
+       topologyNormalizingWeight keeps a 3-zone spread comparable to a
+       100-host spread;
+    2. NormalizeScore: 100 × (max + min − raw) / max over feasible nodes
+       (fewer matching pods ⇒ higher score).
+    """
     n = group_count.shape[0]
+    act = active.astype(jnp.float32)
+    # domains per key under the active node set: hostname = active count,
+    # other keys = number of domain columns with an active member
+    dom_counts = [jnp.sum(act)]
+    for kk in range(topo_onehot.shape[0]):
+        present = jnp.any((topo_onehot[kk] * act[:, None]) > 0, axis=0)   # [D]
+        dom_counts.append(jnp.sum(present.astype(jnp.float32)))
+    dom_counts = jnp.stack(dom_counts)                                    # [K]
+
     raw = jnp.zeros((n,), dtype=jnp.float32)
     any_valid = jnp.zeros((), dtype=bool)
-    for c in range(spread_group.shape[0]):
+    node_ok = jnp.ones((n,), dtype=bool)  # vendored IgnoredNodes: a node
+    for c in range(spread_group.shape[0]):  # missing any key scores 0
+        soft = spread_valid[c] & ~spread_hard[c]
         vec = group_count[:, spread_group[c]]
         dc = domain_count(vec, spread_key[c], topo_onehot)
-        raw = raw + jnp.where(spread_valid[c], dc, 0.0)
-        any_valid |= spread_valid[c]
-    score = minmax_normalize(-raw, feasible)
+        w = jnp.log(dom_counts[spread_key[c]] + 2.0)
+        raw = raw + jnp.where(soft, dc * w, 0.0)
+        node_ok &= ~soft | (has_key[spread_key[c]] > 0)
+        any_valid |= soft
+    big = jnp.float32(3.4e38)
+    scored = feasible & node_ok
+    s_max = jnp.max(jnp.where(scored, raw, -big))
+    s_min = jnp.min(jnp.where(scored, raw, big))
+    score = jnp.where(s_max > 0, 100.0 * (s_max + s_min - raw) / jnp.maximum(s_max, 1e-9), 100.0)
+    score = jnp.where(scored, score, 0.0)
     return jnp.where(any_valid, score, 0.0)

@@ -63,6 +63,11 @@ class Simulator:
     # -- reference: RunCluster (simulator.go:218) -----------------------
     def run_cluster(self) -> SimulateResult:
         """Place the cluster's own pods (pinned + pending + workloads)."""
+        # session restart: the pod sequence is rebuilt from scratch, so any
+        # carried preemption state would index the wrong pods
+        self._pre_disabled = np.zeros(0, dtype=bool)
+        self._pre_assign = np.zeros(0, dtype=np.int32)
+        self._preempted_by = {}
         batch = expand_cluster_pods(self.cluster)
         _resolve_priorities(batch, self.cluster, self._apps)
         self._pods = _priority_sort(batch)

@@ -106,3 +106,42 @@ def test_tie_break_seed_changes_only_ties():
     assert (a >= 0).all() and (b >= 0).all() and (det >= 0).all()
     # ...and different seeds produce different tie resolution on identical nodes
     assert not np.array_equal(a, b) or not np.array_equal(a, det)
+
+
+def test_plugin_config_scoring_strategy(tmp_path):
+    # NodeResourcesFitArgs.scoringStrategy: MostAllocated moves the fit
+    # weight onto the bin-packing score (the v1beta2+ replacement for the
+    # NodeResourcesMostAllocated plugin).
+    cfg = tmp_path / "sched.yaml"
+    cfg.write_text(textwrap.dedent("""
+        apiVersion: kubescheduler.config.k8s.io/v1beta2
+        kind: KubeSchedulerConfiguration
+        profiles:
+          - plugins:
+              score:
+                enabled:
+                  - name: NodeResourcesFit
+                    weight: 4
+            pluginConfig:
+              - name: NodeResourcesFit
+                args:
+                  scoringStrategy:
+                    type: MostAllocated
+    """))
+    ov = weight_overrides_from_file(str(cfg))
+    assert ov == {"w_least": 0.0, "w_most": 4.0}
+
+
+def test_plugin_config_least_allocated_noop(tmp_path):
+    cfg = tmp_path / "sched.yaml"
+    cfg.write_text(textwrap.dedent("""
+        kind: KubeSchedulerConfiguration
+        profiles:
+          - pluginConfig:
+              - name: NodeResourcesFit
+                args:
+                  scoringStrategy:
+                    type: LeastAllocated
+    """))
+    ov = weight_overrides_from_file(str(cfg))
+    assert ov == {"w_least": 1.0}

@@ -143,3 +143,79 @@ def test_minmax_and_max_normalize_edges():
     np.testing.assert_allclose(out, [0.0, 0.0, 0.0])  # zero range -> 0, infeasible -> 0
     out2 = np.asarray(scores.max_normalize(jnp.asarray([0.0, 0.0, 0.0]), feas, reverse=True))
     np.testing.assert_allclose(out2[:2], [100.0, 100.0])  # no taints anywhere -> all max
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_topology_spread_score_oracle(seed):
+    # vendored two-pass: raw = sum_c domain-count * log(#domains_c + 2) over
+    # soft constraints; normalize 100*(max+min-raw)/max over feasible nodes
+    rng = np.random.RandomState(seed)
+    n, d, s = 11, 3, 4
+    onehot, ids = random_topology(rng, n, d)
+    group_count = rng.randint(0, 5, size=(n, s)).astype(np.float32)
+    has_key = np.ones((2, n), dtype=np.float32)
+    active = np.ones(n, dtype=bool)
+    feasible = rng.rand(n) > 0.2
+    if not feasible.any():
+        feasible[0] = True
+    spread_group = np.array([rng.randint(0, s), rng.randint(0, s)], dtype=np.int32)
+    spread_key = np.array([0, 1], dtype=np.int32)      # hostname + zone
+    spread_hard = np.array([False, False])
+    spread_valid = np.array([True, True])
+
+    got = np.asarray(scores.topology_spread_score(
+        jnp.asarray(group_count), jnp.asarray(onehot), jnp.asarray(has_key),
+        jnp.asarray(active), jnp.asarray(spread_group), jnp.asarray(spread_key),
+        jnp.asarray(spread_hard), jnp.asarray(spread_valid), jnp.asarray(feasible),
+    ))
+
+    # numpy oracle
+    n_domains = [float(n), float(len({v for v in ids if v >= 0}))]
+    raw = np.zeros(n)
+    for c in range(2):
+        vec = group_count[:, spread_group[c]]
+        if spread_key[c] == 0:
+            dc = vec
+        else:
+            per_dom = onehot[0].T @ vec
+            dc = onehot[0] @ per_dom
+        raw += dc * np.log(n_domains[spread_key[c]] + 2.0)
+    mx = raw[feasible].max()
+    mn = raw[feasible].min()
+    want = 100.0 * (mx + mn - raw) / max(mx, 1e-9) if mx > 0 else np.full(n, 100.0)
+    want = np.where(feasible, want, 0.0)
+    np.testing.assert_allclose(got, want, rtol=2e-4)
+
+
+def test_topology_spread_score_hard_constraints_excluded():
+    # DoNotSchedule constraints do not contribute to the score (vendored
+    # PreScore filters to ScheduleAnyway)
+    n, d, s = 5, 2, 1
+    onehot = np.zeros((1, n, d), dtype=np.float32)
+    group_count = np.arange(n, dtype=np.float32).reshape(n, 1)
+    got = np.asarray(scores.topology_spread_score(
+        jnp.asarray(group_count), jnp.asarray(onehot),
+        jnp.ones((2, n), dtype=np.float32), jnp.ones(n, dtype=bool),
+        jnp.array([0], dtype=np.int32), jnp.array([0], dtype=np.int32),
+        jnp.array([True]), jnp.array([True]), jnp.ones(n, dtype=bool),
+    ))
+    np.testing.assert_allclose(got, np.zeros(n))
+
+
+def test_topology_spread_score_ignores_nodes_missing_key():
+    # vendored IgnoredNodes: a node without the constraint's topology key
+    # scores 0, not best
+    n, d = 4, 2
+    onehot = np.zeros((1, n, d), dtype=np.float32)
+    onehot[0, 0, 0] = onehot[0, 1, 0] = onehot[0, 2, 1] = 1.0  # node 3 lacks key
+    has_key = np.ones((2, n), dtype=np.float32)
+    has_key[1, 3] = 0.0
+    group_count = np.array([[2.0], [2.0], [1.0], [0.0]])
+    got = np.asarray(scores.topology_spread_score(
+        jnp.asarray(group_count), jnp.asarray(onehot), jnp.asarray(has_key),
+        jnp.ones(n, dtype=bool),
+        jnp.array([0], dtype=np.int32), jnp.array([1], dtype=np.int32),
+        jnp.array([False]), jnp.array([True]), jnp.ones(n, dtype=bool),
+    ))
+    assert got[3] == 0.0
+    assert got[2] > got[0] == got[1] > 0.0

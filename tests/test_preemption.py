@@ -251,3 +251,40 @@ def test_session_api_keeps_victims_deleted():
     assert "low-b" not in scheduled_names or "low-a" not in scheduled_names
     victims = [u for u in full.unscheduled_pods if "preempted" in u.reason]
     assert len(victims) == 1
+
+
+def test_negative_priority_victims_are_preempted():
+    # PriorityClass values may be negative; a default-0 pod outranks them.
+    cluster = ClusterResources()
+    cluster.nodes = [make_node("n0", cpu_m=2000)]
+    cluster.priority_classes = [pc("underdog", -100)]
+    app1 = ClusterResources()
+    neg = make_pod("neg", cpu="1800m")
+    neg.priority_class_name = "underdog"
+    app1.pods = [neg]
+    app2 = ClusterResources()
+    app2.pods = [make_pod("plain", cpu="1800m")]  # priority 0
+    res = _sim(cluster, app1, app2)
+    assert res.placements().get("default/plain") == "n0"
+    assert [u.pod.meta.name for u in res.unscheduled_pods] == ["neg"]
+
+
+def test_session_run_cluster_resets_preemption_state():
+    from open_simulator_tpu.simulator import Simulator
+
+    cluster = ClusterResources()
+    cluster.nodes = [make_node("n0", cpu_m=4000)]
+    cluster.priority_classes = [pc("critical", 1000)]
+    sim = Simulator(cluster)
+    sim.run_cluster()
+    app1 = ClusterResources()
+    app1.pods = [make_pod("low-a", cpu="1800m"), make_pod("low-b", cpu="1800m")]
+    sim.schedule_app(AppResource(name="lows", resources=app1))
+    app2 = ClusterResources()
+    high = make_pod("high", cpu="1800m")
+    high.priority_class_name = "critical"
+    app2.pods = [high]
+    sim.schedule_app(AppResource(name="high", resources=app2))
+    # restarting the session must not crash on stale preemption arrays
+    r = sim.run_cluster()
+    assert r.unscheduled_pods == []
