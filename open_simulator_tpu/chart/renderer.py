@@ -6,10 +6,13 @@ release values, drop NOTES.txt, sort by install order). A Go Helm runtime
 is not part of this image, so rendering is tiered:
 
   1. `helm template` subprocess when a helm binary exists on PATH;
-  2. a built-in minimal renderer covering the common template subset
-     ({{ .Values.* }}, {{ .Release.* }}, {{ .Chart.* }}, default/quote
-     pipes, {{- ... -}} whitespace chomping, one-level if/end on value
-     truthiness);
+  2. a built-in renderer implementing the Go-template subset charts
+     actually use: {{ .Values.* }}/{{ .Release.* }}/{{ .Chart.* }},
+     nested if/else/end (truthiness, not/eq/ne/and/or), range (lists and
+     maps, with $k/$v bindings), with, define/include/template (+
+     _helpers.tpl), $-root access, {{- -}} whitespace chomping, and the
+     common pipes (default, quote, upper/lower/trim, indent/nindent,
+     toYaml, trunc, trimSuffix/trimPrefix, replace, printf);
   3. a clear ChartError telling the user to pre-render otherwise.
 """
 
@@ -72,14 +75,97 @@ def _render_with_helm(path: str, release: str) -> List[Dict[str, Any]]:
     return [d for d in yaml.safe_load_all(res.stdout) if isinstance(d, dict) and d.get("kind")]
 
 
-# ---- builtin minimal renderer -----------------------------------------
+# ---- builtin renderer: a Go-template subset ----------------------------
 
-_EXPR = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}")
+_TOK = re.compile(r"(\{\{-?.*?-?\}\})", re.DOTALL)
 
 
-def _lookup(ctx: Dict[str, Any], dotted: str):
-    cur: Any = ctx
-    for part in dotted.strip(".").split("."):
+def _tokenize(text: str) -> List[tuple]:
+    """-> [('text', s) | ('expr', s)] with {{- / -}} whitespace chomping."""
+    out: List[tuple] = []
+    for part in _TOK.split(text):
+        if not part:
+            continue
+        if part.startswith("{{"):
+            inner = part[2:-2]
+            chomp_before = inner.startswith("-")
+            chomp_after = inner.endswith("-")
+            expr = inner.strip("-").strip()
+            if chomp_before and out and out[-1][0] == "text":
+                out[-1] = ("text", out[-1][1].rstrip(" \t\n"))
+            out.append(("expr", expr, chomp_after))
+        else:
+            if out and out[-1][0] == "expr" and out[-1][2]:
+                part = part.lstrip(" \t\n")
+            out.append(("text", part))
+    return out
+
+
+def _split_args(s: str) -> List[str]:
+    """Split on spaces outside quotes and parens."""
+    args, buf, depth, q = [], "", 0, None
+    for ch in s:
+        if q:
+            buf += ch
+            if ch == q:
+                q = None
+        elif ch in "\"'":
+            q = ch
+            buf += ch
+        elif ch == "(":
+            depth += 1
+            buf += ch
+        elif ch == ")":
+            depth -= 1
+            buf += ch
+        elif ch == " " and depth == 0:
+            if buf:
+                args.append(buf)
+            buf = ""
+        else:
+            buf += ch
+    if buf:
+        args.append(buf)
+    return args
+
+
+class _Scope:
+    """dot + $-variables + root + named defines."""
+
+    def __init__(self, dot, root, varmap, defines, origin):
+        self.dot = dot
+        self.root = root
+        self.vars = varmap
+        self.defines = defines
+        self.origin = origin
+
+    def child(self, dot=None, extra_vars=None) -> "_Scope":
+        v = dict(self.vars)
+        if extra_vars:
+            v.update(extra_vars)
+        return _Scope(self.dot if dot is None else dot, self.root, v,
+                      self.defines, self.origin)
+
+
+def _quote(v) -> str:
+    """Helm's quote: wrap in double quotes, escaping embedded ones."""
+    s = "" if v is None else str(v).replace("\\", "\\\\").replace('"', '\\"')
+    return f'"{s}"'
+
+
+def _truthy(v) -> bool:
+    return not (v is None or v is False or v == "" or v == 0 or v == [] or v == {})
+
+
+def _to_yaml(v) -> str:
+    return yaml.safe_dump(v, default_flow_style=False, sort_keys=False).rstrip("\n")
+
+
+def _lookup_path(base, dotted: str):
+    cur = base
+    for part in dotted.split("."):
+        if not part:
+            continue
         if isinstance(cur, dict) and part in cur:
             cur = cur[part]
         else:
@@ -87,65 +173,305 @@ def _lookup(ctx: Dict[str, Any], dotted: str):
     return cur
 
 
-def _eval_expr(expr: str, ctx: Dict[str, Any]):
-    """Evaluate `.path`, `.path | default x | quote` pipelines."""
-    stages = [s.strip() for s in expr.split("|")]
-    head = stages[0]
-    if head.startswith('"') and head.endswith('"'):
-        val: Any = head.strip('"')
-    elif head.startswith("."):
-        val = _lookup(ctx, head)
-    else:
-        return None
+def _eval_atom(tok: str, sc: _Scope):
+    if tok.startswith("(") and tok.endswith(")"):
+        return _eval_pipeline(tok[1:-1], sc)
+    if tok.startswith('"') and tok.endswith('"') and len(tok) >= 2:
+        return tok[1:-1]
+    if tok.startswith("'") and tok.endswith("'") and len(tok) >= 2:
+        return tok[1:-1]
+    if re.fullmatch(r"-?\d+", tok):
+        return int(tok)
+    if re.fullmatch(r"-?\d+\.\d+", tok):
+        return float(tok)
+    if tok in ("true", "false"):
+        return tok == "true"
+    if tok == ".":
+        return sc.dot
+    if tok == "$":
+        return sc.root
+    if tok.startswith("$."):
+        return _lookup_path(sc.root, tok[2:])
+    if tok.startswith("$"):
+        name, _, rest = tok[1:].partition(".")
+        base = sc.vars.get("$" + name)
+        return _lookup_path(base, rest) if rest else base
+    if tok.startswith("."):
+        return _lookup_path(sc.dot, tok[1:])
+    return None
+
+
+def _eval_call(args: List[str], sc: _Scope):
+    """Function-call position: `not x`, `eq a b`, `include "n" .`, ..."""
+    fn = args[0]
+    if fn == "not":
+        return not _truthy(_eval_atom(args[1], sc))
+    if fn in ("eq", "ne"):
+        a, b = _eval_atom(args[1], sc), _eval_atom(args[2], sc)
+        return (a == b) if fn == "eq" else (a != b)
+    if fn in ("lt", "le", "gt", "ge"):
+        a, b = _eval_atom(args[1], sc), _eval_atom(args[2], sc)
+        try:
+            return {"lt": a < b, "le": a <= b, "gt": a > b, "ge": a >= b}[fn]
+        except TypeError:
+            return False
+    if fn == "and":
+        v = True
+        for a in args[1:]:
+            v = _eval_atom(a, sc)
+            if not _truthy(v):
+                return v
+        return v
+    if fn == "or":
+        for a in args[1:]:
+            v = _eval_atom(a, sc)
+            if _truthy(v):
+                return v
+        return v
+    if fn in ("include", "template"):
+        name = _eval_atom(args[1], sc)
+        new_dot = _eval_atom(args[2], sc) if len(args) > 2 else sc.dot
+        body = sc.defines.get(name)
+        if body is None:
+            raise ChartError(f"{sc.origin}: undefined template {name!r}")
+        return _render_nodes(body, sc.child(dot=new_dot))
+    if fn == "printf":
+        fmt = _eval_atom(args[1], sc)
+        vals = [_eval_atom(a, sc) for a in args[2:]]
+        try:
+            return fmt % tuple(vals)
+        except (TypeError, ValueError):
+            return fmt
+    if fn == "default":
+        fallback = _eval_atom(args[1], sc)
+        v = _eval_atom(args[2], sc) if len(args) > 2 else None
+        return v if _truthy(v) else fallback
+    if fn == "toYaml":
+        return _to_yaml(_eval_atom(args[1], sc))
+    if fn == "quote":
+        return _quote(_eval_atom(args[1], sc))
+    if len(args) == 1:
+        return _eval_atom(fn, sc)
+    raise ChartError(
+        f"{sc.origin}: unsupported template function {fn!r} — install helm or "
+        "pre-render with `helm template`"
+    )
+
+
+def _apply_pipe(stage: str, val, sc: _Scope):
+    args = _split_args(stage)
+    fn = args[0]
+    if fn == "default":
+        fallback = _eval_atom(args[1], sc)
+        return val if _truthy(val) else fallback
+    if fn == "quote":
+        return _quote(val)
+    if fn == "squote":
+        s = "" if val is None else str(val).replace("'", "''")
+        return f"'{s}'"
+    if fn in ("lower", "upper"):
+        return getattr(str(val), fn)() if val is not None else val
+    if fn == "trim":
+        return str(val).strip() if val is not None else val
+    if fn == "toYaml":
+        return _to_yaml(val)
+    if fn == "toString":
+        return str(val)
+    if fn == "indent" or fn == "nindent":
+        n = int(_eval_atom(args[1], sc) or 0)
+        pad = " " * n
+        body = "\n".join(pad + ln for ln in str(val).splitlines())
+        return ("\n" + body) if fn == "nindent" else body
+    if fn == "trunc":
+        n = int(_eval_atom(args[1], sc) or 0)
+        return str(val)[:n]
+    if fn == "trimSuffix":
+        sfx = str(_eval_atom(args[1], sc) or "")
+        s = str(val)
+        return s[: -len(sfx)] if sfx and s.endswith(sfx) else s
+    if fn == "trimPrefix":
+        pfx = str(_eval_atom(args[1], sc) or "")
+        s = str(val)
+        return s[len(pfx):] if pfx and s.startswith(pfx) else s
+    if fn == "replace":
+        old = str(_eval_atom(args[1], sc) or "")
+        new = str(_eval_atom(args[2], sc) or "")
+        return str(val).replace(old, new)
+    if fn == "first":
+        return val[0] if isinstance(val, (list, tuple)) and val else None
+    if fn == "len":
+        try:
+            return len(val)
+        except TypeError:
+            return 0
+    raise ChartError(
+        f"{sc.origin}: unsupported pipe {fn!r} — install helm or pre-render "
+        "with `helm template`"
+    )
+
+
+def _split_pipes(s: str) -> List[str]:
+    """Split on '|' outside quotes and parens (a literal '|' inside a
+    printf format string is not a pipe)."""
+    stages, buf, depth, q = [], "", 0, None
+    for ch in s:
+        if q:
+            buf += ch
+            if ch == q:
+                q = None
+        elif ch in "\"'":
+            q = ch
+            buf += ch
+        elif ch == "(":
+            depth += 1
+            buf += ch
+        elif ch == ")":
+            depth -= 1
+            buf += ch
+        elif ch == "|" and depth == 0:
+            stages.append(buf)
+            buf = ""
+        else:
+            buf += ch
+    stages.append(buf)
+    return stages
+
+
+def _eval_pipeline(expr: str, sc: _Scope):
+    stages = [s.strip() for s in _split_pipes(expr)]
+    head_args = _split_args(stages[0])
+    val = _eval_call(head_args, sc) if head_args else None
     for stage in stages[1:]:
-        if stage.startswith("default "):
-            arg = stage[len("default "):].strip().strip('"')
-            if val in (None, ""):
-                val = arg
-        elif stage == "quote":
-            val = f'"{val if val is not None else ""}"'
-        elif stage in ("lower", "upper", "trim"):
-            if isinstance(val, str):
-                val = getattr(val, stage.replace("trim", "strip"))()
+        val = _apply_pipe(stage, val, sc)
     return val
 
 
-def _render_template(text: str, ctx: Dict[str, Any], origin: str) -> str:
-    out_lines: List[str] = []
-    skip_depth = 0
-    for line in text.splitlines():
-        stripped = line.strip()
-        m = _EXPR.fullmatch(stripped) if stripped.startswith("{{") else None
-        if m:
-            expr = m.group(1)
-            if expr.startswith("if "):
-                cond = _eval_expr(expr[3:].strip(), ctx)
-                if skip_depth or not cond:
-                    skip_depth += 1
-                continue
-            if expr in ("end", "end -"):
-                if skip_depth:
-                    skip_depth -= 1
-                continue
-            if expr.startswith(("range", "with", "define", "template", "include")):
-                raise ChartError(
-                    f"{origin}: template uses {{{{ {expr.split()[0]} }}}} — beyond the "
-                    "builtin renderer; install helm or pre-render with `helm template`"
-                )
-        if skip_depth:
-            continue
+# ---- parse to AST ------------------------------------------------------
 
-        def sub(match: re.Match) -> str:
-            val = _eval_expr(match.group(1), ctx)
+def _parse(tokens: List[tuple], i: int, origin: str, stop=()):
+    """-> (nodes, next_index, stop_word). Node kinds:
+    ('text', s) ('action', expr) ('if', [(cond, body), ...], else_body)
+    ('range', binding, expr, body) ('with', expr, body) ('define', name, body)
+    """
+    nodes: List[tuple] = []
+    while i < len(tokens):
+        tok = tokens[i]
+        if tok[0] == "text":
+            nodes.append(("text", tok[1]))
+            i += 1
+            continue
+        expr = tok[1]
+        word = expr.split(" ", 1)[0] if expr else ""
+        if word in stop:
+            return nodes, i + 1, word if word != "else" else expr
+        if word == "if":
+            branches = []
+            cond_expr = expr[3:].strip()
+            while True:
+                body, i, stopped = _parse(tokens, i + 1, origin, stop=("end", "else"))
+                branches.append((cond_expr, body))
+                if stopped == "end":
+                    nodes.append(("if", branches, []))
+                    break
+                if stopped.startswith("else if"):
+                    cond_expr = stopped[len("else if"):].strip()
+                    i -= 1  # reparse from the else-if token's body
+                    continue
+                # plain else
+                else_body, i, _ = _parse(tokens, i, origin, stop=("end",))
+                nodes.append(("if", branches, else_body))
+                break
+        elif word == "range":
+            rest = expr[6:].strip()
+            binding = None
+            if ":=" in rest:
+                left, rest = rest.split(":=", 1)
+                binding = [v.strip() for v in left.split(",")]
+                rest = rest.strip()
+            body, i, _ = _parse(tokens, i + 1, origin, stop=("end",))
+            nodes.append(("range", binding, rest, body))
+        elif word == "with":
+            body, i, _ = _parse(tokens, i + 1, origin, stop=("end",))
+            nodes.append(("with", expr[5:].strip(), body))
+        elif word == "define":
+            name = expr[7:].strip().strip('"')
+            body, i, _ = _parse(tokens, i + 1, origin, stop=("end",))
+            nodes.append(("define", name, body))
+        else:
+            nodes.append(("action", expr))
+            i += 1
+    return nodes, i, ""
+
+
+def _render_nodes(nodes: List[tuple], sc: _Scope) -> str:
+    out: List[str] = []
+    for node in nodes:
+        kind = node[0]
+        if kind == "text":
+            out.append(node[1])
+        elif kind == "action":
+            expr = node[1]
+            if expr.startswith("/*"):
+                continue
+            if expr.startswith("$") and ":=" in expr:
+                name, rhs = expr.split(":=", 1)
+                sc.vars[name.strip()] = _eval_pipeline(rhs.strip(), sc)
+                continue
+            val = _eval_pipeline(expr, sc)
             if val is None:
                 raise ChartError(
-                    f"{origin}: cannot resolve {{{{ {match.group(1)} }}}} — install helm "
+                    f"{sc.origin}: cannot resolve {{{{ {expr} }}}} — install helm "
                     "or pre-render with `helm template`"
                 )
-            return str(val)
+            out.append(val if isinstance(val, str) else
+                       _to_yaml(val) if isinstance(val, (dict, list)) else str(val))
+        elif kind == "if":
+            _, branches, else_body = node
+            done = False
+            for cond_expr, body in branches:
+                if _truthy(_eval_pipeline(cond_expr, sc)):
+                    out.append(_render_nodes(body, sc))
+                    done = True
+                    break
+            if not done and else_body:
+                out.append(_render_nodes(else_body, sc))
+        elif kind == "range":
+            _, binding, expr, body = node
+            coll = _eval_pipeline(expr, sc)
+            items = (
+                list(coll.items()) if isinstance(coll, dict)
+                else list(enumerate(coll)) if isinstance(coll, (list, tuple))
+                else []
+            )
+            for k, v in items:
+                extra = {}
+                if binding:
+                    if len(binding) == 2:
+                        extra = {binding[0]: k, binding[1]: v}
+                    else:
+                        extra = {binding[0]: v}
+                out.append(_render_nodes(body, sc.child(dot=v, extra_vars=extra)))
+        elif kind == "with":
+            _, expr, body = node
+            val = _eval_pipeline(expr, sc)
+            if _truthy(val):
+                out.append(_render_nodes(body, sc.child(dot=val)))
+        elif kind == "define":
+            sc.defines[node[1]] = node[2]
+    return "".join(out)
 
-        out_lines.append(_EXPR.sub(sub, line))
-    return "\n".join(out_lines)
+
+def _render_template(text: str, ctx: Dict[str, Any], origin: str,
+                     defines: Dict[str, list] | None = None) -> str:
+    tokens = _tokenize(text)
+    nodes, _, _ = _parse(tokens, 0, origin)
+    sc = _Scope(dot=ctx, root=ctx, varmap={}, defines=defines if defines is not None else {},
+                origin=origin)
+    # hoist defines (helpers may be used before their define in file order)
+    for node in nodes:
+        if node[0] == "define":
+            sc.defines[node[1]] = node[2]
+    return _render_nodes(nodes, sc)
 
 
 def _render_builtin(path: str, chart_meta: Dict[str, Any], release: str) -> List[Dict[str, Any]]:
@@ -163,12 +489,24 @@ def _render_builtin(path: str, chart_meta: Dict[str, Any], release: str) -> List
     tmpl_dir = os.path.join(path, "templates")
     if not os.path.isdir(tmpl_dir):
         return docs
+    # pass 1: collect {{ define }} blocks from helper files (_helpers.tpl etc.)
+    defines: Dict[str, list] = {}
+    for fname in sorted(os.listdir(tmpl_dir)):
+        if fname.startswith("_") and fname.endswith((".tpl", ".yaml", ".yml")):
+            with open(os.path.join(tmpl_dir, fname), "r", encoding="utf-8") as f:
+                nodes, _, _ = _parse(_tokenize(f.read()), 0, fname)
+            for node in nodes:
+                if node[0] == "define":
+                    defines[node[1]] = node[2]
+    # pass 2: render manifests with the shared define registry
     for fname in sorted(os.listdir(tmpl_dir)):
         if fname == "NOTES.txt" or fname.startswith("_") or not fname.endswith((".yaml", ".yml")):
             continue
         fpath = os.path.join(tmpl_dir, fname)
         with open(fpath, "r", encoding="utf-8") as f:
-            rendered = _render_template(f.read(), ctx, f"{os.path.basename(path)}/{fname}")
+            rendered = _render_template(
+                f.read(), ctx, f"{os.path.basename(path)}/{fname}", defines=dict(defines)
+            )
         for doc in yaml.safe_load_all(rendered):
             if isinstance(doc, dict) and doc.get("kind"):
                 doc.setdefault("metadata", {}).setdefault("namespace", "default")

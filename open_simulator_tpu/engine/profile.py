@@ -59,7 +59,7 @@ def weight_overrides_from_file(path: str) -> Dict[str, float]:
         field = _SCORE_PLUGIN_FIELDS.get(name)
         if field is not None and field not in overrides:
             overrides[field] = 0.0
-    _apply_plugin_config(profiles[0].get("pluginConfig") or [], overrides)
+    _apply_plugin_config((profiles[0] or {}).get("pluginConfig") or [], overrides)
     return overrides
 
 

@@ -1,0 +1,240 @@
+"""Builtin chart renderer: the Go-template subset charts actually use.
+
+The reference renders charts through the embedded Helm v3 engine
+(pkg/chart/chart.go:18-118); this exercises the builtin fallback on a
+realistic chart shape (helpers, include/nindent, range, with, if/else,
+toYaml, variables).
+"""
+
+import textwrap
+
+import pytest
+
+from open_simulator_tpu.chart.renderer import ChartError, process_chart
+
+
+def write_chart(root, values, templates, helpers=None):
+    (root / "Chart.yaml").write_text(
+        "apiVersion: v2\nname: webstack\nversion: 1.0.0\n"
+    )
+    (root / "values.yaml").write_text(values)
+    tdir = root / "templates"
+    tdir.mkdir()
+    if helpers:
+        (tdir / "_helpers.tpl").write_text(helpers)
+    for name, content in templates.items():
+        (tdir / name).write_text(content)
+    return str(root)
+
+
+HELPERS = textwrap.dedent("""\
+    {{- define "webstack.fullname" -}}
+    {{ .Release.Name }}-{{ .Chart.Name | trunc 20 | trimSuffix "-" }}
+    {{- end -}}
+    {{- define "webstack.labels" -}}
+    app: {{ include "webstack.fullname" . }}
+    chart: {{ .Chart.Name }}
+    {{- end -}}
+""")
+
+
+def test_full_featured_chart(tmp_path):
+    values = textwrap.dedent("""\
+        replicas: 3
+        image:
+          repository: nginx
+          tag: ""
+        resources:
+          requests:
+            cpu: 250m
+            memory: 256Mi
+        extraPorts: [8080, 9090]
+        nodeSelector:
+          disk: ssd
+        serviceEnabled: true
+    """)
+    deploy = textwrap.dedent("""\
+        apiVersion: apps/v1
+        kind: Deployment
+        metadata:
+          name: {{ include "webstack.fullname" . }}
+          labels:
+            {{- include "webstack.labels" . | nindent 4 }}
+        spec:
+          replicas: {{ .Values.replicas }}
+          selector:
+            matchLabels:
+              app: {{ include "webstack.fullname" . }}
+          template:
+            metadata:
+              labels:
+                {{- include "webstack.labels" . | nindent 8 }}
+            spec:
+              containers:
+              - name: web
+                image: "{{ .Values.image.repository }}:{{ .Values.image.tag | default "latest" }}"
+                resources:
+                  {{- toYaml .Values.resources | nindent 18 }}
+                ports:
+                {{- range $i, $p := .Values.extraPorts }}
+                - containerPort: {{ $p }}
+                  name: "port-{{ $i }}"
+                {{- end }}
+              {{- with .Values.nodeSelector }}
+              nodeSelector:
+                {{- toYaml . | nindent 16 }}
+              {{- end }}
+    """)
+    service = textwrap.dedent("""\
+        {{- if .Values.serviceEnabled }}
+        apiVersion: v1
+        kind: Service
+        metadata:
+          name: {{ include "webstack.fullname" . }}
+        spec:
+          selector:
+            app: {{ include "webstack.fullname" . }}
+        {{- else }}
+        # no service
+        {{- end }}
+    """)
+    path = write_chart(
+        tmp_path, values,
+        {"deployment.yaml": deploy, "service.yaml": service},
+        helpers=HELPERS,
+    )
+    docs = process_chart(path)
+    kinds = [d["kind"] for d in docs]
+    assert kinds == ["Service", "Deployment"]  # install order
+    dep = docs[1]
+    assert dep["metadata"]["name"] == "webstack-webstack"
+    assert dep["metadata"]["labels"] == {
+        "app": "webstack-webstack", "chart": "webstack",
+    }
+    spec = dep["spec"]
+    assert spec["replicas"] == 3
+    c = spec["template"]["spec"]["containers"][0]
+    assert c["image"] == "nginx:latest"
+    assert c["resources"] == {"requests": {"cpu": "250m", "memory": "256Mi"}}
+    assert c["ports"] == [
+        {"containerPort": 8080, "name": "port-0"},
+        {"containerPort": 9090, "name": "port-1"},
+    ]
+    assert spec["template"]["spec"]["nodeSelector"] == {"disk": "ssd"}
+
+
+def test_if_else_branches_and_eq(tmp_path):
+    values = "mode: canary\n"
+    tmpl = textwrap.dedent("""\
+        apiVersion: v1
+        kind: ConfigMap
+        metadata:
+          name: cm
+        data:
+          {{- if eq .Values.mode "canary" }}
+          weight: "10"
+          {{- else if eq .Values.mode "stable" }}
+          weight: "100"
+          {{- else }}
+          weight: "0"
+          {{- end }}
+          missing: {{ .Values.absent | default "fallback" | quote }}
+    """)
+    path = write_chart(tmp_path, values, {"cm.yaml": tmpl})
+    docs = process_chart(path)
+    assert docs[0]["data"] == {"weight": "10", "missing": "fallback"}
+
+
+def test_range_over_map_with_bindings(tmp_path):
+    values = textwrap.dedent("""\
+        annotations:
+          a.example.com/x: "1"
+          b.example.com/y: "2"
+    """)
+    tmpl = textwrap.dedent("""\
+        apiVersion: v1
+        kind: ConfigMap
+        metadata:
+          name: cm
+          annotations:
+            {{- range $k, $v := .Values.annotations }}
+            {{ $k }}: {{ $v | quote }}
+            {{- end }}
+    """)
+    path = write_chart(tmp_path, values, {"cm.yaml": tmpl})
+    docs = process_chart(path)
+    assert docs[0]["metadata"]["annotations"] == {
+        "a.example.com/x": "1", "b.example.com/y": "2",
+    }
+
+
+def test_unsupported_pipe_raises_chart_error(tmp_path):
+    tmpl = textwrap.dedent("""\
+        apiVersion: v1
+        kind: ConfigMap
+        metadata:
+          name: {{ .Release.Name | sha256sum }}
+    """)
+    path = write_chart(tmp_path, "x: 1\n", {"cm.yaml": tmpl})
+    with pytest.raises(ChartError, match="sha256sum"):
+        process_chart(path)
+
+
+def test_variable_assignment(tmp_path):
+    values = "name: base\n"
+    tmpl = textwrap.dedent("""\
+        {{- $full := printf "%s-%s" .Release.Name .Values.name }}
+        apiVersion: v1
+        kind: ConfigMap
+        metadata:
+          name: {{ $full }}
+    """)
+    path = write_chart(tmp_path, values, {"cm.yaml": tmpl})
+    docs = process_chart(path)
+    assert docs[0]["metadata"]["name"] == "webstack-base"
+
+
+def test_unknown_function_raises_not_silent_false(tmp_path):
+    tmpl = textwrap.dedent("""\
+        {{- if hasKey .Values "x" }}
+        apiVersion: v1
+        kind: ConfigMap
+        metadata: {name: cm}
+        {{- end }}
+    """)
+    path = write_chart(tmp_path, "x: 1\n", {"cm.yaml": tmpl})
+    with pytest.raises(ChartError, match="hasKey"):
+        process_chart(path)
+
+
+def test_quote_escapes_embedded_quotes(tmp_path):
+    values = 'cmd: echo "hi"\n'
+    tmpl = textwrap.dedent("""\
+        apiVersion: v1
+        kind: ConfigMap
+        metadata: {name: cm}
+        data:
+          cmd: {{ .Values.cmd | quote }}
+    """)
+    path = write_chart(tmp_path, values, {"cm.yaml": tmpl})
+    docs = process_chart(path)
+    assert docs[0]["data"]["cmd"] == 'echo "hi"'
+
+
+def test_pipe_char_inside_printf_string(tmp_path):
+    tmpl = textwrap.dedent("""\
+        apiVersion: v1
+        kind: ConfigMap
+        metadata:
+          name: {{ printf "%s|%s" .Release.Name .Chart.Name | replace "|" "-" }}
+    """)
+    path = write_chart(tmp_path, "x: 1\n", {"cm.yaml": tmpl})
+    docs = process_chart(path)
+    assert docs[0]["metadata"]["name"] == "webstack-webstack"
+
+
+def test_null_profile_entry_tolerated(tmp_path):
+    from open_simulator_tpu.engine.profile import weight_overrides_from_file
+    cfg = tmp_path / "sched.yaml"
+    cfg.write_text("kind: KubeSchedulerConfiguration\nprofiles:\n  -\n")
+    assert weight_overrides_from_file(str(cfg)) == {}
