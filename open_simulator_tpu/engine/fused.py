@@ -1,0 +1,660 @@
+"""Fused Pallas scan: the whole scheduling step in one VMEM-resident kernel.
+
+Motivation (measured, see ROADMAP perf notes): the lax.scan engine
+round-trips the carry (used/group_count/term_block/pref_paint/ports) through
+HBM every pod step — ~160KB × pods × lanes ≈ the v5e's entire HBM bandwidth
+at the bench shape. This kernel keeps the carry in VMEM *scratch* for the
+full pod sequence: grid = (lanes, pods), pods innermost, scratch persists
+across grid steps, per-pod rows stream in as tiny auto-pipelined blocks.
+HBM traffic drops from O(P·carry) to O(P·pod_row + carry) per lane.
+
+Semantics are bit-compatible with engine/scheduler._step for the supported
+subset (`fused_eligible`): every filter, every score, forced binds,
+preemption's disabled/nominated columns, first-failing-op reason counts.
+Not supported (falls back to the lax.scan engine): gpu-share packing,
+tie-break jitter, and feature vocabularies too wide to unroll.
+
+Layout: node-axis arrays are transposed host-side to feature-major [F, Np]
+(Np = nodes padded to the 128-lane boundary) so every per-feature op is a
+(1, Np) VPU row op; per-pod vectors stay [P, F] and are consumed as (1, F)
+blocks with static-index scalar reads — no in-kernel transposes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from open_simulator_tpu.encode.snapshot import OP_FIT_BASE, SnapshotArrays
+from open_simulator_tpu.engine.scheduler import EngineConfig, ScheduleOutput, SimState
+
+_BIG = 3.4e38
+_BIG_I = 2**31 - 1
+MAX_SCORE = 100.0
+
+# unroll caps: every feature axis becomes a static python loop in the kernel
+_CAPS = dict(S=96, T=48, T2=48, Pt=48, A=8, B=8, Cs=8, Ap=8, K=6, D=16, R=16, C=512)
+
+
+def fused_eligible(arrs: SnapshotArrays, cfg: EngineConfig) -> bool:
+    if cfg.enable_gpu or cfg.tie_break_seed:
+        return False
+    k1, _, d = arrs.topo_onehot.shape
+    dims = dict(
+        S=arrs.match_groups.shape[1], T=arrs.own_terms.shape[1],
+        T2=arrs.hit_pref.shape[1], Pt=arrs.ports.shape[1],
+        A=arrs.aff_group.shape[1], B=arrs.anti_group.shape[1],
+        Cs=arrs.spread_group.shape[1], Ap=arrs.pref_group.shape[1],
+        K=k1 + 1, D=d, R=arrs.alloc.shape[1], C=arrs.class_affinity.shape[0],
+    )
+    if any(dims[k] > _CAPS[k] for k in dims):
+        return False
+    # meta ints must round-trip exactly (k8s weights/skews are integral)
+    if not np.allclose(arrs.pref_weight, np.round(arrs.pref_weight)):
+        return False
+    if not np.allclose(arrs.spread_skew, np.round(arrs.spread_skew)):
+        return False
+    return True
+
+
+class _Fused(NamedTuple):
+    """Device-ready feature-major snapshot (host-prepared once per arrs)."""
+
+    alloc: jnp.ndarray      # [R, Np]
+    unsched_ok: jnp.ndarray  # [1, Np] 1.0 = schedulable
+    class_aff: jnp.ndarray  # [C, Np]
+    class_taint: jnp.ndarray
+    class_na: jnp.ndarray
+    class_tt: jnp.ndarray
+    topo: jnp.ndarray       # [K1*D, Np]
+    haskey: jnp.ndarray     # [K, Np]
+    req: jnp.ndarray        # [P, R]
+    ports: jnp.ndarray      # [P, Pt] f32
+    match: jnp.ndarray      # [P, S] f32
+    own: jnp.ndarray        # [P, T] f32
+    hit: jnp.ndarray        # [P, T] f32
+    hitpref: jnp.ndarray    # [P, T2] f32
+    meta: jnp.ndarray       # [P, M] i32
+    term_key: jnp.ndarray   # [T] i32
+    n_real: int             # unpadded node count
+
+
+def _pad_nodes(x: np.ndarray, np_pad: int) -> np.ndarray:
+    """[..., N] -> [..., Np] zero-padded."""
+    pad = np_pad - x.shape[-1]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * (x.ndim - 1) + [(0, pad)]
+    return np.pad(x, widths)
+
+
+_prepare_memo: dict = {}
+
+
+def prepare_fused(arrs: SnapshotArrays) -> _Fused:
+    # keyed by identity; the memo holds the arrs object itself so the id
+    # cannot be recycled for a different snapshot while the entry lives
+    memo_key = id(arrs)
+    hit = _prepare_memo.get(memo_key)
+    if hit is not None and hit[0] is arrs:
+        return hit[1]
+    a = jax.tree_util.tree_map(np.asarray, arrs)
+    n = a.alloc.shape[0]
+    np_pad = max(128, -(-n // 128) * 128)
+    f32 = np.float32
+    k1, _, d = a.topo_onehot.shape
+    topo = a.topo_onehot.transpose(0, 2, 1).reshape(k1 * d, n)
+
+    P = a.req.shape[0]
+    A, B, Cs, Ap = (a.aff_group.shape[1], a.anti_group.shape[1],
+                    a.spread_group.shape[1], a.pref_group.shape[1])
+    m_cols = 4 + 4 * A + 3 * B + 6 * Cs + 5 * Ap
+    meta = np.zeros((P, m_cols), dtype=np.int32)
+    meta[:, 0] = a.class_id
+    meta[:, 1] = a.forced_node
+    meta[:, 2] = -1  # nominated (filled per call)
+    meta[:, 3] = 0   # disabled  (filled per call)
+    c = 4
+    for i in range(A):
+        meta[:, c + 0] = a.aff_group[:, i]
+        meta[:, c + 1] = a.aff_key[:, i]
+        meta[:, c + 2] = a.aff_valid[:, i]
+        meta[:, c + 3] = a.aff_self[:, i]
+        c += 4
+    for i in range(B):
+        meta[:, c + 0] = a.anti_group[:, i]
+        meta[:, c + 1] = a.anti_key[:, i]
+        meta[:, c + 2] = a.anti_valid[:, i]
+        c += 3
+    spread_self = np.zeros((P, Cs), dtype=bool)
+    for i in range(Cs):
+        spread_self[:, i] = (
+            a.match_groups[np.arange(P), a.spread_group[:, i]] & a.spread_valid[:, i]
+        )
+        meta[:, c + 0] = a.spread_group[:, i]
+        meta[:, c + 1] = a.spread_key[:, i]
+        meta[:, c + 2] = np.round(a.spread_skew[:, i]).astype(np.int32)
+        meta[:, c + 3] = a.spread_hard[:, i]
+        meta[:, c + 4] = a.spread_valid[:, i]
+        meta[:, c + 5] = spread_self[:, i]
+        c += 6
+    for i in range(Ap):
+        meta[:, c + 0] = a.pref_group[:, i]
+        meta[:, c + 1] = a.pref_key[:, i]
+        meta[:, c + 2] = np.round(a.pref_weight[:, i]).astype(np.int32)
+        meta[:, c + 3] = a.pref_valid[:, i]
+        meta[:, c + 4] = a.pref_tid[:, i]
+        c += 5
+
+    out = _Fused(
+        alloc=jnp.asarray(_pad_nodes(a.alloc.T.astype(f32), np_pad)),
+        unsched_ok=jnp.asarray(_pad_nodes((~a.unschedulable).astype(f32)[None, :], np_pad)),
+        class_aff=jnp.asarray(_pad_nodes(a.class_affinity.astype(f32), np_pad)),
+        class_taint=jnp.asarray(_pad_nodes(a.class_taint.astype(f32), np_pad)),
+        class_na=jnp.asarray(_pad_nodes(a.class_node_aff_score.astype(f32), np_pad)),
+        class_tt=jnp.asarray(_pad_nodes(a.class_taint_prefer.astype(f32), np_pad)),
+        topo=jnp.asarray(_pad_nodes(topo.astype(f32), np_pad)),
+        haskey=jnp.asarray(_pad_nodes(a.has_key.astype(f32), np_pad)),
+        req=jnp.asarray(a.req.astype(f32)),
+        ports=jnp.asarray(a.ports.astype(f32)),
+        match=jnp.asarray(a.match_groups.astype(f32)),
+        own=jnp.asarray(a.own_terms.astype(f32)),
+        hit=jnp.asarray(a.hit_terms.astype(f32)),
+        hitpref=jnp.asarray(a.hit_pref.astype(f32)),
+        meta=jnp.asarray(meta),
+        term_key=jnp.asarray(a.term_key.astype(np.int32)),
+        n_real=n,
+    )
+    _prepare_memo.clear()  # keep at most one snapshot resident
+    _prepare_memo[memo_key] = (arrs, out)
+    return out
+
+
+def _kernel_body(cfg: EngineConfig, dims: dict,
+                 # scalar-prefetched SMEM: per-pod meta + rows, term keys
+                 meta_ref, tkey_ref, req_ref, ports_ref, match_ref,
+                 own_ref, hit_ref, hitpref_ref,
+                 # node constants (VMEM)
+                 act_ref, alloc_ref, unsched_ref, caff_ref, ctaint_ref,
+                 cna_ref, ctt_ref, topo_ref, haskey_ref,
+                 # carry state at chunk entry (VMEM, per lane-block)
+                 su_ref, sg_ref, st_ref, sp_ref, spt_ref,
+                 # outputs
+                 o_sel, o_feas, o_fail, o_used, o_group, o_term, o_pref, o_ports,
+                 # scratch
+                 used_s, group_s, term_s, pref_s, ports_s, sd_s):
+    """One grid cell = one lane-block × the whole pod chunk.
+
+    TPU grid steps carry ~20µs of fixed overhead on this platform (measured;
+    see ROADMAP), so the sequential pod walk lives INSIDE the kernel as a
+    fori_loop and the grid only spans lane-blocks. All per-pod operands are
+    scalar-prefetched into SMEM; every vector op is an (LB, Np) VPU tile —
+    the same lane vectorization vmap gives the lax.scan engine, with the
+    carry never leaving VMEM.
+    """
+    R, S, T, T2, Pt = dims["R"], dims["S"], dims["T"], dims["T2"], dims["Pt"]
+    A, B, Cs, Ap, K, D = (dims["A"], dims["B"], dims["Cs"], dims["Ap"],
+                          dims["K"], dims["D"])
+    LB = act_ref.shape[1]
+    npad = act_ref.shape[2]
+    n_pods = meta_ref.shape[0]
+    f32 = jnp.float32
+
+    act = act_ref[0]                                  # (LB, Np) f32 0/1
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, npad), 1)
+
+    used_s[...] = su_ref[0]
+    group_s[...] = sg_ref[0]
+    term_s[...] = st_ref[0]
+    pref_s[...] = sp_ref[0]
+    ports_s[...] = spt_ref[0]
+
+    def dyn_row(ref, idx):
+        """node-const [F, Np] -> (1, Np), broadcasts over lanes."""
+        return ref[pl.ds(idx, 1), :]
+
+    def dyn_lane(ref, idx):
+        """lane scratch [F, LB, Np] -> (LB, Np)."""
+        return ref[pl.ds(idx, 1), :, :][0]
+
+    def lsum(x):
+        return jnp.sum(x, axis=1, keepdims=True)      # (LB, 1)
+
+    def lmax(x):
+        return jnp.max(x, axis=1, keepdims=True)
+
+    def lmin(x):
+        return jnp.min(x, axis=1, keepdims=True)
+
+    def domain_count(vec, kid):
+        """(LB, Np) per-node sum of vec over its domain under key kid."""
+        dc = vec
+        for k in range(1, K):
+            acc = jnp.zeros((LB, npad), f32)
+            for dd in range(D):
+                oh = topo_ref[(k - 1) * D + dd: (k - 1) * D + dd + 1, :]
+                acc = acc + oh * lsum(oh * vec)
+            dc = jnp.where(kid == k, acc, dc)
+        return dc
+
+    def domain_min(vec, kid, elig):
+        """(LB, 1) min over domains containing an eligible node (0 if none)."""
+        mn = lmin(jnp.where(elig > 0, vec, _BIG))     # hostname
+        for k in range(1, K):
+            acc = jnp.full((LB, 1), _BIG, f32)
+            for dd in range(D):
+                oh = topo_ref[(k - 1) * D + dd: (k - 1) * D + dd + 1, :]
+                tot = lsum(oh * vec)
+                has = lmax(oh * elig) > 0
+                acc = jnp.minimum(acc, jnp.where(has, tot, _BIG))
+            mn = jnp.where(kid == k, acc, mn)
+        any_elig = lmax(elig) > 0
+        return jnp.where(any_elig, mn, 0.0)
+
+    def minmax_norm(raw, feas):
+        lo = lmin(jnp.where(feas > 0, raw, _BIG))
+        hi = lmax(jnp.where(feas > 0, raw, -_BIG))
+        rng = hi - lo
+        out = jnp.where(rng > 0, (raw - lo) * MAX_SCORE / jnp.where(rng > 0, rng, 1.0), 0.0)
+        return jnp.where(feas > 0, out, 0.0)
+
+    def max_norm(raw, feas, reverse=False):
+        hi = lmax(jnp.where(feas > 0, raw, 0.0))
+        scaled = jnp.where(hi > 0, raw * MAX_SCORE / jnp.where(hi > 0, hi, 1.0), 0.0)
+        out = MAX_SCORE - scaled if reverse else scaled
+        return jnp.where(feas > 0, out, 0.0)
+
+    def step(p, _):
+        cid = meta_ref[p, 0]
+        forced = meta_ref[p, 1]
+        nominated = meta_ref[p, 2]
+        disabled = meta_ref[p, 3]
+
+        # ---- filters --------------------------------------------------
+        ok_unsched = jnp.broadcast_to(unsched_ref[0:1, :], (LB, npad))
+        cm_aff = jnp.broadcast_to(dyn_row(caff_ref, cid), (LB, npad))
+        cm_taint = jnp.broadcast_to(dyn_row(ctaint_ref, cid), (LB, npad))
+
+        conflict = jnp.zeros((LB, npad), f32)
+        for j in range(Pt):
+            conflict = conflict + ports_s[j] * ports_ref[p, j]
+        ok_ports = (conflict == 0).astype(f32)
+
+        fit_rows = []
+        for r in range(R):
+            fit_rows.append(
+                (used_s[r] + req_ref[p, r] <= alloc_ref[r:r + 1, :]).astype(f32)
+            )
+
+        ok_aff = jnp.ones((LB, npad), f32)
+        c = 4
+        for _t in range(A):
+            gid, kid = meta_ref[p, c], meta_ref[p, c + 1]
+            valid, self_m = meta_ref[p, c + 2], meta_ref[p, c + 3]
+            vec = dyn_lane(group_s, gid)
+            dc = domain_count(vec, kid)
+            node_has = dyn_row(haskey_ref, kid)
+            total = lsum(vec)
+            term_ok = (node_has > 0) & ((dc > 0) | ((total == 0) & (self_m > 0)))
+            ok_aff = ok_aff * jnp.where(valid > 0, term_ok.astype(f32), 1.0)
+            c += 4
+
+        ok_anti = jnp.ones((LB, npad), f32)
+        for _t in range(B):
+            gid, kid, valid = meta_ref[p, c], meta_ref[p, c + 1], meta_ref[p, c + 2]
+            vec = dyn_lane(group_s, gid)
+            dc = domain_count(vec, kid)
+            ok_anti = ok_anti * jnp.where(valid > 0, (dc == 0).astype(f32), 1.0)
+            c += 3
+        blocked = jnp.zeros((LB, npad), f32)
+        for t in range(T):
+            blocked = blocked + term_s[t] * hit_ref[p, t]
+        ok_anti = ok_anti * (blocked == 0).astype(f32)
+
+        spread_base = c
+        ok_spread = jnp.ones((LB, npad), f32)
+        for _t in range(Cs):
+            gid, kid = meta_ref[p, c], meta_ref[p, c + 1]
+            skew_max, hard = meta_ref[p, c + 2], meta_ref[p, c + 3]
+            valid, self_m = meta_ref[p, c + 4], meta_ref[p, c + 5]
+            vec = dyn_lane(group_s, gid)
+            dc = domain_count(vec, kid)
+            node_has = dyn_row(haskey_ref, kid)
+            elig = act * cm_aff * node_has
+            min_val = domain_min(vec, kid, elig)
+            skew = dc + self_m.astype(f32) - min_val
+            term_ok = (node_has > 0) & (skew <= skew_max.astype(f32))
+            applies = (valid > 0) & (hard > 0)
+            ok_spread = ok_spread * jnp.where(applies, term_ok.astype(f32), 1.0)
+            c += 6
+        pref_base = c
+
+        ops_ok = [ok_unsched, cm_aff, cm_taint, ok_ports]
+        ops_ok += fit_rows
+        ops_ok += [ok_aff, ok_anti, ok_spread, jnp.ones((LB, npad), f32)]
+
+        # first-failing-op reason counts + overall mask
+        n_ops = len(ops_ok)
+        ops_iota = jax.lax.broadcasted_iota(jnp.int32, (1, n_ops), 1)
+        fail_vec = jnp.zeros((LB, n_ops), f32)
+        remaining = act
+        mask = act
+        for i, ok in enumerate(ops_ok):
+            newly = remaining * (1.0 - jnp.minimum(ok, 1.0))
+            fail_vec = fail_vec + lsum(newly) * (ops_iota == i).astype(f32)
+            remaining = remaining * jnp.minimum(ok, 1.0)
+            mask = mask * jnp.minimum(ok, 1.0)
+
+        # ---- scores ---------------------------------------------------
+        score = jnp.zeros((LB, npad), f32)
+        ci, mi = cfg.cpu_mem_idx
+        fr = []
+        for r in (ci, mi):
+            cap = alloc_ref[r:r + 1, :]
+            want = used_s[r] + req_ref[p, r]
+            fr.append(jnp.where(cap > 0, want / jnp.where(cap > 0, cap, 1.0), 0.0))
+        mean = (fr[0] + fr[1]) * 0.5
+        var = ((fr[0] - mean) ** 2 + (fr[1] - mean) ** 2) * 0.5
+        score = score + cfg.w_balanced * (1.0 - jnp.sqrt(var)) * MAX_SCORE
+        tot_free = jnp.zeros((LB, npad), f32)
+        for r in (ci, mi):
+            cap = alloc_ref[r:r + 1, :]
+            free = cap - used_s[r] - req_ref[p, r]
+            tot_free = tot_free + jnp.where(
+                cap > 0, jnp.clip(free, 0.0) / jnp.where(cap > 0, cap, 1.0), 0.0)
+        score = score + cfg.w_least * tot_free * (MAX_SCORE / 2.0)
+        if cfg.w_most:
+            tot_want = jnp.zeros((LB, npad), f32)
+            for r in (ci, mi):
+                cap = alloc_ref[r:r + 1, :]
+                want = used_s[r] + req_ref[p, r]
+                tot_want = tot_want + jnp.where(
+                    cap > 0, jnp.clip(want / jnp.where(cap > 0, cap, 1.0), 0.0, 1.0), 0.0)
+            score = score + cfg.w_most * tot_want * (MAX_SCORE / 2.0)
+
+        score = score + cfg.w_node_aff * max_norm(
+            jnp.broadcast_to(dyn_row(cna_ref, cid), (LB, npad)), mask)
+        score = score + cfg.w_taint * max_norm(
+            jnp.broadcast_to(dyn_row(ctt_ref, cid), (LB, npad)), mask, reverse=True)
+
+        # interpod preference, both directions
+        ip_raw = jnp.zeros((LB, npad), f32)
+        for t in range(T2):
+            ip_raw = ip_raw + pref_s[t] * hitpref_ref[p, t]
+        c = pref_base
+        for _t in range(Ap):
+            gid, kid = meta_ref[p, c], meta_ref[p, c + 1]
+            w, valid = meta_ref[p, c + 2], meta_ref[p, c + 3]
+            vec = dyn_lane(group_s, gid)
+            dc = domain_count(vec, kid)
+            contrib = w.astype(f32) * dc * (dyn_row(haskey_ref, kid) > 0).astype(f32)
+            ip_raw = ip_raw + jnp.where(valid > 0, contrib, 0.0)
+            c += 5
+        score = score + cfg.w_interpod * minmax_norm(ip_raw, mask)
+
+        # topology spread (two-pass, soft constraints only)
+        sp_raw = jnp.zeros((LB, npad), f32)
+        sp_node_ok = jnp.ones((LB, npad), f32)
+        any_soft = jnp.zeros((), jnp.bool_)
+        c = spread_base
+        for _t in range(Cs):
+            gid, kid = meta_ref[p, c], meta_ref[p, c + 1]
+            hard, valid = meta_ref[p, c + 3], meta_ref[p, c + 4]
+            soft = (valid > 0) & (hard == 0)
+            vec = dyn_lane(group_s, gid)
+            dc = domain_count(vec, kid)
+            w = jnp.log(lsum(act) + 2.0)              # hostname (LB, 1)
+            for k in range(1, K):
+                cnt = jnp.zeros((LB, 1), f32)
+                for dd in range(D):
+                    oh = topo_ref[(k - 1) * D + dd: (k - 1) * D + dd + 1, :]
+                    cnt = cnt + (lmax(oh * act) > 0).astype(f32)
+                w = jnp.where(kid == k, jnp.log(cnt + 2.0), w)
+            sp_raw = sp_raw + jnp.where(soft, dc * w, 0.0)
+            node_has = jnp.broadcast_to((dyn_row(haskey_ref, kid) > 0).astype(f32),
+                                        (LB, npad))
+            sp_node_ok = sp_node_ok * jnp.where(soft, node_has, 1.0)
+            any_soft |= soft
+            c += 6
+        scored = mask * sp_node_ok
+        s_max = lmax(jnp.where(scored > 0, sp_raw, -_BIG))
+        s_min = lmin(jnp.where(scored > 0, sp_raw, _BIG))
+        sp = jnp.where(
+            s_max > 0,
+            MAX_SCORE * (s_max + s_min - sp_raw) / jnp.maximum(s_max, 1e-9),
+            MAX_SCORE,
+        )
+        sp = jnp.where(scored > 0, sp, 0.0)
+        score = score + cfg.w_spread * jnp.where(any_soft, sp, 0.0)
+
+        # simon max-share (static allocatable)
+        sim_raw = jnp.zeros((1, npad), f32)
+        for r in range(R):
+            rq = req_ref[p, r]
+            avail = alloc_ref[r:r + 1, :] - rq
+            share = jnp.where(
+                avail != 0, rq / jnp.where(avail != 0, avail, 1.0),
+                jnp.where(rq != 0, 1.0, 0.0),
+            )
+            share = jnp.where(rq > 0, jnp.clip(share, 0.0, 1.0), 0.0)
+            sim_raw = jnp.maximum(sim_raw, share)
+        score = score + cfg.w_simon * minmax_norm(
+            jnp.broadcast_to(sim_raw, (LB, npad)) * MAX_SCORE, mask)
+
+        # ---- nominated restriction + argmax ---------------------------
+        nom_row = (iota == nominated).astype(f32)     # (1, Np)
+        use_nom = (nominated >= 0) & (lmax(mask * nom_row) > 0)
+        mask = jnp.where(use_nom, mask * nom_row, mask)
+
+        masked = jnp.where(mask > 0, score, -_BIG)
+        top = lmax(masked)
+        sel = lmin(jnp.where((masked == top) & (mask > 0), iota, _BIG_I))
+        feasible_n = lsum(mask).astype(jnp.int32)     # (LB, 1)
+        any_feasible = feasible_n > 0
+
+        final = jnp.where(
+            forced >= 0, forced,
+            jnp.where((forced == -1) & any_feasible, sel, -1),
+        ).astype(jnp.int32)
+        final = jnp.where(disabled > 0, jnp.int32(-3), final)  # (LB, 1)
+        o_sel[0, pl.ds(p, 1)] = final.reshape(1, LB, 1)
+        o_feas[0, pl.ds(p, 1)] = jnp.where(disabled > 0, 0, feasible_n).reshape(1, LB, 1)
+        fail_out = jnp.where(disabled > 0, 0.0, fail_vec).astype(jnp.int32)
+        o_fail[0, pl.ds(p, 1)] = fail_out.reshape(1, LB, n_ops)
+
+        # ---- bind -----------------------------------------------------
+        oh_sel = ((iota == final) & (final >= 0)).astype(f32)  # (LB, Np)
+        for r in range(R):
+            used_s[r] = used_s[r] + oh_sel * req_ref[p, r]
+        for si in range(S):
+            group_s[si] = group_s[si] + oh_sel * match_ref[p, si]
+        for j in range(Pt):
+            ports_s[j] = jnp.minimum(ports_s[j] + oh_sel * ports_ref[p, j], 1.0)
+
+        # same-domain rows of the bound node under every key
+        sd_s[0] = oh_sel
+        for k in range(1, K):
+            acc = jnp.zeros((LB, npad), f32)
+            for dd in range(D):
+                oh = topo_ref[(k - 1) * D + dd: (k - 1) * D + dd + 1, :]
+                acc = acc + oh * lsum(oh * oh_sel)
+            sd_s[k] = acc
+
+        for t in range(T):
+            tk = tkey_ref[t]
+            term_s[t] = term_s[t] + dyn_lane(sd_s, tk) * own_ref[p, t]
+        c = pref_base
+        for _t in range(Ap):
+            kid = meta_ref[p, c + 1]
+            w, valid, tid = meta_ref[p, c + 2], meta_ref[p, c + 3], meta_ref[p, c + 4]
+            paint = dyn_lane(sd_s, kid) * w.astype(f32) * (valid > 0).astype(f32)
+            cur = pref_s[pl.ds(tid, 1), :, :]
+            pref_s[pl.ds(tid, 1), :, :] = cur + paint[None]
+            c += 5
+        return 0
+
+    jax.lax.fori_loop(0, n_pods, step, 0)
+
+    o_used[0] = used_s[...]
+    o_group[0] = group_s[...]
+    o_term[0] = term_s[...]
+    o_pref[0] = pref_s[...]
+    o_ports[0] = ports_s[...]
+
+
+def _pick_lane_block(L: int, npad: int) -> int:
+    """Largest lane block that divides L and keeps scratch VMEM modest."""
+    budget = 32768  # LB * npad cap: 16 lanes at 2048 padded nodes
+    for lb in (32, 16, 8, 4, 2, 1):
+        if L % lb == 0 and lb * npad <= budget:
+            return lb
+    return 1
+
+
+def schedule_pods_fused(
+    arrs: SnapshotArrays,
+    active_lanes: jnp.ndarray,           # [L, N] bool
+    cfg: EngineConfig,
+    disabled: Optional[jnp.ndarray] = None,   # [P] bool
+    nominated: Optional[jnp.ndarray] = None,  # [P] i32
+    interpret: bool = False,
+) -> ScheduleOutput:
+    """Run the fused kernel over L lanes; returns a lane-batched
+    ScheduleOutput matching vmap(schedule_pods) for eligible configs."""
+    fd = prepare_fused(arrs)
+    n = fd.n_real
+    npad = fd.alloc.shape[1]
+    L = active_lanes.shape[0]
+    P = fd.req.shape[0]
+    R, S = fd.alloc.shape[0], fd.match.shape[1]
+    T, T2, Pt = fd.own.shape[1], fd.hitpref.shape[1], fd.ports.shape[1]
+    C = fd.class_aff.shape[0]
+    K = fd.haskey.shape[0]
+    k1d = fd.topo.shape[0]
+    D = k1d // max(K - 1, 1) if K > 1 else k1d
+    A = arrs.aff_group.shape[1]
+    B = arrs.anti_group.shape[1]
+    Cs = arrs.spread_group.shape[1]
+    Ap = arrs.pref_group.shape[1]
+    OPS = cfg.n_ops
+    dims = dict(R=R, S=S, T=T, T2=T2, Pt=Pt, A=A, B=B, Cs=Cs, Ap=Ap, K=K, D=D)
+
+    meta = fd.meta
+    if nominated is not None:
+        meta = meta.at[:, 2].set(nominated.astype(jnp.int32))
+    if disabled is not None:
+        meta = meta.at[:, 3].set(disabled.astype(jnp.int32))
+    M = meta.shape[1]
+
+    LB = _pick_lane_block(L, npad)
+    NB = L // LB
+    act = jnp.zeros((NB, LB, npad), jnp.float32).at[:, :, :n].set(
+        active_lanes.astype(jnp.float32).reshape(NB, LB, n))
+
+    f32 = jnp.float32
+    # pod-axis chunking: all per-pod operands are scalar-prefetched into
+    # SMEM (~1MB with padding overhead) — bound a chunk's SMEM footprint and
+    # thread the carry state between chunks through HBM
+    smem_cols = M + R + Pt + S + 2 * T + T2
+    chunk = max(1, min(P, 8192 // max(smem_cols, 1)))
+    const = lambda l, *_: (0, 0)
+    per_block4 = lambda l, *_: (l, 0, 0, 0)
+    per_block3 = lambda l, *_: (l, 0, 0)
+
+    kernel = functools.partial(_kernel_body, cfg, dims)
+    state_dims = (R, S, T, T2, Pt)
+    state_specs = [
+        pl.BlockSpec((1, f, LB, npad), per_block4, memory_space=pltpu.VMEM)
+        for f in state_dims
+    ]
+
+    def call_chunk(meta_c, pod_rows, state_in, n_pods_c):
+        out_shapes = (
+            jax.ShapeDtypeStruct((NB, n_pods_c, LB, 1), jnp.int32),    # sel
+            jax.ShapeDtypeStruct((NB, n_pods_c, LB, 1), jnp.int32),    # feasible
+            jax.ShapeDtypeStruct((NB, n_pods_c, LB, OPS), jnp.int32),  # fails
+            *[jax.ShapeDtypeStruct((NB, f, LB, npad), f32) for f in state_dims],
+        )
+        out_specs = (
+            pl.BlockSpec((1, n_pods_c, LB, 1), per_block4, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_pods_c, LB, 1), per_block4, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_pods_c, LB, OPS), per_block4, memory_space=pltpu.VMEM),
+            *state_specs,
+        )
+        in_specs = [
+            pl.BlockSpec((1, LB, npad), per_block3, memory_space=pltpu.VMEM),  # act
+            pl.BlockSpec((R, npad), const, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, npad), const, memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, npad), const, memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, npad), const, memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, npad), const, memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, npad), const, memory_space=pltpu.VMEM),
+            pl.BlockSpec((k1d, npad), const, memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, npad), const, memory_space=pltpu.VMEM),
+            *state_specs,
+        ]
+        scratch = [
+            pltpu.VMEM((R, LB, npad), f32), pltpu.VMEM((S, LB, npad), f32),
+            pltpu.VMEM((T, LB, npad), f32), pltpu.VMEM((T2, LB, npad), f32),
+            pltpu.VMEM((Pt, LB, npad), f32), pltpu.VMEM((K, LB, npad), f32),
+        ]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=8,
+            grid=(NB,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=scratch,
+        )
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=out_shapes,
+            interpret=interpret,
+        )(meta_c, fd.term_key, *pod_rows,
+          act, fd.alloc, fd.unsched_ok,
+          fd.class_aff, fd.class_taint, fd.class_na, fd.class_tt,
+          fd.topo, fd.haskey, *state_in)
+
+    state_in = [jnp.zeros((NB, f, LB, npad), f32) for f in state_dims]
+    sels, fails, feass = [], [], []
+    for start in range(0, P, chunk):
+        stop = min(start + chunk, P)
+        pod_rows = [
+            x[start:stop]
+            for x in (fd.req, fd.ports, fd.match, fd.own, fd.hit, fd.hitpref)
+        ]
+        sel, feas, fail, *state_in = call_chunk(
+            meta[start:stop], pod_rows, state_in, stop - start
+        )
+        # [NB, chunk, LB, .] -> [L, chunk, .]
+        sels.append(jnp.transpose(sel[..., 0], (0, 2, 1)).reshape(L, stop - start))
+        feass.append(jnp.transpose(feas[..., 0], (0, 2, 1)).reshape(L, stop - start))
+        fails.append(
+            jnp.transpose(fail, (0, 2, 1, 3)).reshape(L, stop - start, OPS))
+    usedo, groupo, termo, prefo, portso = state_in
+
+    def unstate(x, f):
+        # [NB, F, LB, npad] -> [L, n, F]
+        return jnp.transpose(x, (0, 2, 3, 1)).reshape(L, npad, f)[:, :n, :]
+
+    g = arrs.gpu_slot.shape[1]
+    state = SimState(
+        used=unstate(usedo, R),
+        group_count=unstate(groupo, S),
+        term_block=unstate(termo, T),
+        pref_paint=unstate(prefo, T2),
+        ports_used=unstate(portso, Pt) > 0,
+        gpu_used=jnp.zeros((L, n, g), f32),
+    )
+    return ScheduleOutput(
+        node=jnp.concatenate(sels, axis=1),
+        fail_counts=jnp.concatenate(fails, axis=1),
+        feasible=jnp.concatenate(feass, axis=1),
+        gpu_pick=jnp.zeros((L, P, g), bool), state=state,
+    )

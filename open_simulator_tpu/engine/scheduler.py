@@ -52,9 +52,9 @@ class EngineConfig(NamedTuple):
     # scores (generic_scheduler.go:144-168). 0 = deterministic lowest index;
     # nonzero seeds a stateless per-pod jitter that only breaks exact ties.
     tie_break_seed: int = 0
-    # lax.scan unroll: 2 measured ~1.8x faster than 1 on v5e (amortizes loop
-    # bookkeeping without blowing up compile time; see ROADMAP perf notes).
-    scan_unroll: int = 2
+    # lax.scan unroll: 3 measured best on v5e (4.26M vs 4.02M pods/s at 2,
+    # 3.12M at 1; >4 regresses — see ROADMAP perf notes).
+    scan_unroll: int = 3
 
     @property
     def n_ops(self) -> int:

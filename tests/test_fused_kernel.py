@@ -1,0 +1,127 @@
+"""Differential test: the fused Pallas kernel vs the lax.scan engine.
+
+Runs in Pallas interpret mode on the CPU backend (tests/conftest.py forces
+jax_platforms=cpu), so CI validates kernel semantics without TPU hardware.
+On-device parity was verified bit-exact on a v5e chip (see ROADMAP perf
+notes — the kernel is gated off by default there only because the axon
+tunnel adds ~0.5s fixed overhead per pallas_call invocation).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from open_simulator_tpu.core import build_pod_sequence
+from open_simulator_tpu.encode.snapshot import EncodeOptions, encode_cluster
+from open_simulator_tpu.engine.fused import fused_eligible, schedule_pods_fused
+from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
+from open_simulator_tpu.k8s.loader import ClusterResources
+from open_simulator_tpu.parallel.sweep import active_masks_for_counts
+from tests.conftest import make_node, make_pod
+
+
+def build_snapshot(n_nodes=12, n_pods=24, max_new=4, with_affinity=True):
+    rng = np.random.RandomState(7)
+    nodes = []
+    for i in range(n_nodes):
+        labels = {"topology.kubernetes.io/zone": f"z{i % 3}"}
+        if i % 4 == 0:
+            labels["disk"] = "ssd"
+        taints = (
+            [{"key": "dedicated", "value": "infra", "effect": "NoSchedule"}]
+            if i % 5 == 4 else []
+        )
+        nodes.append(make_node(f"n{i}", cpu_m=4000, mem_mib=8192,
+                               labels=labels, taints=taints))
+    pods = []
+    for i in range(n_pods):
+        kw = dict(cpu=f"{rng.randint(100, 900)}m", mem=f"{rng.randint(64, 512)}Mi",
+                  labels={"app": f"a{i % 3}"})
+        if with_affinity and i % 5 == 0:
+            kw["affinity"] = {
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [{
+                        "labelSelector": {"matchLabels": {"app": f"a{i % 3}"}},
+                        "topologyKey": "kubernetes.io/hostname",
+                    }],
+                    # preferred terms: Ap scoring loop + pref_paint bind loop
+                    "preferredDuringSchedulingIgnoredDuringExecution": [{
+                        "weight": 10,
+                        "podAffinityTerm": {
+                            "labelSelector": {"matchLabels": {"app": f"a{(i + 1) % 3}"}},
+                            "topologyKey": "topology.kubernetes.io/zone",
+                        },
+                    }],
+                },
+            }
+        if with_affinity and i % 7 == 0:
+            kw["spread"] = [{
+                "maxSkew": 2, "topologyKey": "topology.kubernetes.io/zone",
+                "whenUnsatisfiable": "DoNotSchedule",
+                "labelSelector": {"matchLabels": {"app": f"a{i % 3}"}},
+            }]
+        if i % 11 == 0:
+            kw["host_ports"] = [8080]
+        if i % 6 == 1:  # class diversity: node selector
+            kw["node_selector"] = {"disk": "ssd"}
+        if i % 6 == 2:  # class diversity: toleration
+            kw["tolerations"] = [{"key": "dedicated", "operator": "Exists",
+                                  "effect": "NoSchedule"}]
+        if i % 9 == 3:  # forced bind path
+            kw["node_name"] = f"n{i % n_nodes}"
+        pods.append(make_pod(f"p{i}", **kw))
+    template = make_node("template", cpu_m=4000)
+    return encode_cluster(
+        nodes, pods,
+        EncodeOptions(max_new_nodes=max_new, new_node_template=template),
+    )
+
+
+@pytest.mark.parametrize("with_affinity", [True, False])
+def test_fused_matches_engine(with_affinity):
+    snap = build_snapshot(with_affinity=with_affinity)
+    arrs = device_arrays(snap)
+    cfg = make_config(snap)
+    assert fused_eligible(snap.arrays, cfg)
+    masks = jnp.asarray(active_masks_for_counts(snap, [0, 2, 4]))
+    ref = jax.vmap(lambda a: schedule_pods(arrs, a, cfg))(masks)
+    out = schedule_pods_fused(arrs, masks, cfg, interpret=True)
+    np.testing.assert_array_equal(np.asarray(ref.node), np.asarray(out.node))
+    np.testing.assert_array_equal(
+        np.asarray(ref.fail_counts), np.asarray(out.fail_counts))
+    np.testing.assert_array_equal(
+        np.asarray(ref.feasible), np.asarray(out.feasible))
+    np.testing.assert_allclose(
+        np.asarray(ref.state.used), np.asarray(out.state.used), atol=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(ref.state.group_count), np.asarray(out.state.group_count),
+        atol=1e-3)
+
+
+def test_fused_disabled_nominated_columns():
+    snap = build_snapshot(with_affinity=False, n_pods=12)
+    arrs = device_arrays(snap)
+    cfg = make_config(snap)
+    P = snap.n_pods
+    disabled = np.zeros(P, dtype=bool)
+    disabled[3] = True
+    nominated = np.full(P, -1, dtype=np.int32)
+    nominated[5] = 2
+    masks = jnp.asarray(active_masks_for_counts(snap, [0, 2]))
+    ref = jax.vmap(
+        lambda a: schedule_pods(
+            arrs, a, cfg, disabled=jnp.asarray(disabled),
+            nominated=jnp.asarray(nominated))
+    )(masks)
+    out = schedule_pods_fused(
+        arrs, masks, cfg, disabled=jnp.asarray(disabled),
+        nominated=jnp.asarray(nominated), interpret=True)
+    np.testing.assert_array_equal(np.asarray(ref.node), np.asarray(out.node))
+    assert np.all(np.asarray(out.node)[:, 3] == -3)
+
+
+def test_fused_ineligible_on_gpu():
+    snap = build_snapshot(with_affinity=False, n_pods=6)
+    cfg = make_config(snap)._replace(enable_gpu=True)
+    assert not fused_eligible(snap.arrays, cfg)
