@@ -55,14 +55,17 @@ class CapacityPlan:
 
 
 def make_mesh(
-    n_scenario: Optional[int] = None, n_node: int = 1, require_all: bool = False
+    n_scenario: Optional[int] = None,
+    n_node: int = 1,
+    require_all: bool = False,
+    devices: Optional[Sequence] = None,
 ) -> Mesh:
     """Build a ("scenario", "node") mesh over the available devices.
     Defaults to all devices on the scenario axis (pure data parallel).
     Unused trailing devices are dropped unless require_all — multi-host
     callers must not silently exclude a host's devices (a host with no
     addressable shard hangs instead of erroring)."""
-    devs = np.array(jax.devices())
+    devs = np.array(jax.devices() if devices is None else list(devices))
     if n_scenario is None:
         n_scenario = len(devs) // n_node
     used = n_scenario * n_node
