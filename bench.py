@@ -126,6 +126,11 @@ def main():
         "value": round(pods_per_sec, 1),
         "unit": "pods/s",
         "vs_baseline": round(vs, 2),
+        # the baseline is this same engine single-lane on one XLA:CPU
+        # thread-pool (the reference publishes no numbers, BASELINE.md) —
+        # vs_baseline is a round-over-round tracking ratio, NOT "x the Go
+        # reference"
+        "baseline": "xla_cpu_single_lane_same_engine",
     }))
 
 

@@ -49,7 +49,9 @@ class ApplyOptions:
     """CLI surface parity (cmd/apply/apply.go:27-36)."""
 
     config_path: str = ""
-    default_scheduler_config: str = ""   # accepted, engine profile knobs TBD
+    default_scheduler_config: str = ""   # KubeSchedulerConfiguration file; Score
+                                         # enable/disable/weights + pluginConfig
+                                         # map onto EngineConfig (engine/profile.py)
     output_file: str = ""
     use_greed: bool = False
     interactive: bool = False
@@ -263,9 +265,13 @@ class Applier:
             and lane_has_unscheduled
             and len({p.priority for p in snapshot.pods}) > 1
         ):
-            # Preemption never changes the sweep verdict (victims are deleted,
-            # so the scheduled count cannot grow), but the chosen lane's
-            # placements and reasons should reflect the PostFilter pass.
+            # The chosen lane's placements and reasons should reflect the
+            # PostFilter pass. Note a multi-victim preemption can *shrink*
+            # the scheduled count relative to the sweep lane (one preemptor
+            # in, N victims out), so this decode — not the sweep's
+            # best_count message — is the authoritative per-pod report.
+            import time
+
             from open_simulator_tpu.engine.preemption import run_with_preemption
             from open_simulator_tpu.engine.scheduler import device_arrays, schedule_pods
 
@@ -281,6 +287,7 @@ class Applier:
                 return schedule_pods(arrs, lane_active, cfg, disabled=disabled,
                                      nominated=nominated)
 
+            t0 = time.perf_counter()
             out, pre = run_with_preemption(
                 snapshot, lane_active, schedule_fn, list(self._pdbs or [])
             )
@@ -289,6 +296,7 @@ class Applier:
                 np.asarray(out.node),
                 np.asarray(out.fail_counts),
                 lane_active,
+                elapsed_s=time.perf_counter() - t0,
                 gpu_pick=np.asarray(out.gpu_pick) if cfg.enable_gpu else None,
                 preempted_by=pre.preempted_by,
             )

@@ -426,15 +426,18 @@ def _render_nodes(nodes: List[tuple], sc: _Scope) -> str:
             out.append(val if isinstance(val, str) else
                        _to_yaml(val) if isinstance(val, (dict, list)) else str(val))
         elif kind == "if":
+            # Go templates scope $-variables to the block they are declared
+            # in — render branch bodies in a child scope like range/with so
+            # `$x :=` inside a branch does not leak out.
             _, branches, else_body = node
             done = False
             for cond_expr, body in branches:
                 if _truthy(_eval_pipeline(cond_expr, sc)):
-                    out.append(_render_nodes(body, sc))
+                    out.append(_render_nodes(body, sc.child()))
                     done = True
                     break
             if not done and else_body:
-                out.append(_render_nodes(else_body, sc))
+                out.append(_render_nodes(else_body, sc.child()))
         elif kind == "range":
             _, binding, expr, body = node
             coll = _eval_pipeline(expr, sc)

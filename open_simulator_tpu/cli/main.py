@@ -23,7 +23,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     ap = sub.add_parser("apply", help="run a capacity-planning simulation")
     ap.add_argument("-f", "--simon-config", required=True, help="simon/v1alpha1 Config file")
-    ap.add_argument("--default-scheduler-config", default="", help="scheduler config file (profile knobs)")
+    ap.add_argument(
+        "--default-scheduler-config", default="",
+        help="KubeSchedulerConfiguration file: Score plugin enable/disable/"
+             "weights and NodeResourcesFit scoringStrategy are applied; "
+             "Filter enable/disable is ignored with a warning",
+    )
     ap.add_argument("--output-file", default="", help="redirect the report to a file")
     ap.add_argument("--use-greed", action="store_true", help="sort app pods by dominant share (big rocks first)")
     ap.add_argument("-i", "--interactive", action="store_true", help="interactive add-node prompt loop")

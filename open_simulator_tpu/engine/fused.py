@@ -406,6 +406,7 @@ def _kernel_body(cfg: EngineConfig, dims: dict,
         for _t in range(Cs):
             gid, kid = meta_ref[p, c], meta_ref[p, c + 1]
             hard, valid = meta_ref[p, c + 3], meta_ref[p, c + 4]
+            skew_max = meta_ref[p, c + 2]
             soft = (valid > 0) & (hard == 0)
             vec = dyn_lane(group_s, gid)
             dc = domain_count(vec, kid)
@@ -416,7 +417,9 @@ def _kernel_body(cfg: EngineConfig, dims: dict,
                     oh = topo_ref[(k - 1) * D + dd: (k - 1) * D + dd + 1, :]
                     cnt = cnt + (lmax(oh * act) > 0).astype(f32)
                 w = jnp.where(kid == k, jnp.log(cnt + 2.0), w)
-            sp_raw = sp_raw + jnp.where(soft, dc * w, 0.0)
+            # scoreForCount's maxSkew-1 shift (scoring.go:292) — pass 2 below
+            # is not shift-invariant, so it changes scores when maxSkew > 1
+            sp_raw = sp_raw + jnp.where(soft, dc * w + (skew_max - 1).astype(f32), 0.0)
             node_has = jnp.broadcast_to((dyn_row(haskey_ref, kid) > 0).astype(f32),
                                         (LB, npad))
             sp_node_ok = sp_node_ok * jnp.where(soft, node_has, 1.0)
@@ -543,6 +546,11 @@ def schedule_pods_fused(
     Cs = arrs.spread_group.shape[1]
     Ap = arrs.pref_group.shape[1]
     OPS = cfg.n_ops
+    # the kernel's hand-built ops_ok list ([4 base] + R fit rows + [4 tail])
+    # must stay in lockstep with filter_op_table for fail-reason decode
+    assert OPS == OP_FIT_BASE + R + 4, (
+        f"fused op list ({OP_FIT_BASE}+{R}+4) out of sync with cfg.n_ops={OPS}"
+    )
     dims = dict(R=R, S=S, T=T, T2=T2, Pt=Pt, A=A, B=B, Cs=Cs, Ap=Ap, K=K, D=D)
 
     meta = fd.meta

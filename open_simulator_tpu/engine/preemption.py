@@ -83,10 +83,20 @@ class _PdbState:
                     and labels_match_selector(p.meta.labels, sel)
                 )
             healthy = int(np.sum(member & (assign >= 0)))
+            # Percentages resolve against the controller's *expected* pod
+            # count, not the currently-healthy count (kube's PDB controller
+            # reads scale subresources; the total matching-pod count is the
+            # stand-in here) — resolving against healthy would shrink the
+            # minAvailable floor in partially-scheduled states.
+            expected = int(np.sum(member))
             if spec.get("minAvailable") is not None:
-                allowed = healthy - _resolve_budget(spec["minAvailable"], healthy)
+                allowed = healthy - _resolve_budget(spec["minAvailable"], expected)
             elif spec.get("maxUnavailable") is not None:
-                allowed = _resolve_budget(spec["maxUnavailable"], healthy)
+                # kube: disruptionsAllowed = currentHealthy − desiredHealthy,
+                # desiredHealthy = expected − maxUnavailable — already-missing
+                # pods consume the budget
+                desired = expected - _resolve_budget(spec["maxUnavailable"], expected)
+                allowed = healthy - desired
             else:
                 allowed = healthy
             self.members.append(member)

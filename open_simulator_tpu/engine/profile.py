@@ -10,9 +10,12 @@ and the reference never disables them either).
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict
 
 import yaml
+
+log = logging.getLogger(__name__)
 
 # plugin name -> EngineConfig weight field
 _SCORE_PLUGIN_FIELDS = {
@@ -43,6 +46,16 @@ def weight_overrides_from_file(path: str) -> Dict[str, float]:
     if not profiles:
         return {}
     plugins = (profiles[0] or {}).get("plugins") or {}
+    for point in ("filter", "preFilter", "postFilter"):
+        section = plugins.get(point) or {}
+        touched = [e.get("name", "?") for e in (section.get("enabled") or [])]
+        touched += [e.get("name", "?") for e in (section.get("disabled") or [])]
+        if touched:
+            log.warning(
+                "%s: %s plugin enable/disable (%s) is ignored — filter ops are "
+                "always-on tensor ops in this engine",
+                path, point, ", ".join(touched),
+            )
     score = plugins.get("score") or {}
     overrides: Dict[str, float] = {}
     for entry in score.get("enabled") or []:

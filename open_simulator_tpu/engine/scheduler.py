@@ -196,7 +196,7 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
     score += cfg.w_spread * scores.topology_spread_score(
         state.group_count, arrs.topo_onehot, arrs.has_key, active,
         x["spread_group"], x["spread_key"], x["spread_hard"],
-        x["spread_valid"], mask)
+        x["spread_valid"], mask, spread_skew=x["spread_skew"])
     score += cfg.w_simon * scores.simon_max_share_score(arrs.alloc, x["req"], mask)
     if cfg.enable_gpu:
         score += cfg.w_gpu * gpu_share.gpu_share_score(

@@ -150,14 +150,17 @@ def topology_spread_score(
     spread_hard: jnp.ndarray,
     spread_valid: jnp.ndarray,
     feasible: jnp.ndarray,
+    spread_skew: jnp.ndarray = None,
 ) -> jnp.ndarray:
     """PodTopologySpread score, the vendored two-pass shape
     (podtopologyspread/scoring.go:180-260):
 
     1. raw(node) = Σ_c matching-pods-in-node's-domain × log(#domains_c + 2)
-       over the pod's *soft* (ScheduleAnyway) constraints only — the
-       topologyNormalizingWeight keeps a 3-zone spread comparable to a
-       100-host spread;
+       + (maxSkew_c − 1) over the pod's *soft* (ScheduleAnyway) constraints
+       only — the topologyNormalizingWeight keeps a 3-zone spread comparable
+       to a 100-host spread, and the maxSkew−1 shift (scoreForCount,
+       scoring.go:292) waters down domain differences at higher tolerances
+       (the shift matters because pass 2 is not shift-invariant);
     2. NormalizeScore: 100 × (max + min − raw) / max over feasible nodes
        (fewer matching pods ⇒ higher score).
     """
@@ -179,7 +182,8 @@ def topology_spread_score(
         vec = group_count[:, spread_group[c]]
         dc = domain_count(vec, spread_key[c], topo_onehot)
         w = jnp.log(dom_counts[spread_key[c]] + 2.0)
-        raw = raw + jnp.where(soft, dc * w, 0.0)
+        shift = 0.0 if spread_skew is None else spread_skew[c] - 1.0
+        raw = raw + jnp.where(soft, dc * w + shift, 0.0)
         node_ok &= ~soft | (has_key[spread_key[c]] > 0)
         any_valid |= soft
     big = jnp.float32(3.4e38)

@@ -238,3 +238,22 @@ def test_null_profile_entry_tolerated(tmp_path):
     cfg = tmp_path / "sched.yaml"
     cfg.write_text("kind: KubeSchedulerConfiguration\nprofiles:\n  -\n")
     assert weight_overrides_from_file(str(cfg)) == {}
+
+
+def test_if_block_scopes_variable_declarations(tmp_path):
+    # Go templates scope $x := to the enclosing block: a redeclaration
+    # inside {{ if }} must not leak into the outer scope.
+    values = "override: true\n"
+    tmpl = textwrap.dedent("""\
+        {{- $name := "outer" }}
+        {{- if .Values.override }}
+        {{- $name := "inner" }}
+        {{- end }}
+        apiVersion: v1
+        kind: ConfigMap
+        metadata:
+          name: {{ $name }}
+    """)
+    path = write_chart(tmp_path, values, {"cm.yaml": tmpl})
+    docs = process_chart(path)
+    assert docs[0]["metadata"]["name"] == "outer"

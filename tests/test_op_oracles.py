@@ -219,3 +219,31 @@ def test_topology_spread_score_ignores_nodes_missing_key():
     ))
     assert got[3] == 0.0
     assert got[2] > got[0] == got[1] > 0.0
+
+
+def test_topology_spread_score_max_skew_shift():
+    # scoreForCount adds maxSkew-1 to raw before the normalize pass
+    # (podtopologyspread/scoring.go:292); the (max+min-raw)/max pass is not
+    # shift-invariant, so maxSkew > 1 must change the normalized scores.
+    n, d = 4, 2
+    onehot = np.zeros((1, n, d), dtype=np.float32)
+    onehot[0, 0, 0] = onehot[0, 1, 0] = onehot[0, 2, 1] = onehot[0, 3, 1] = 1.0
+    group_count = np.array([[3.0], [3.0], [1.0], [1.0]], dtype=np.float32)
+
+    def run(skew):
+        return np.asarray(scores.topology_spread_score(
+            jnp.asarray(group_count), jnp.asarray(onehot),
+            jnp.ones((2, n), dtype=np.float32), jnp.ones(n, dtype=bool),
+            jnp.array([0], dtype=np.int32), jnp.array([1], dtype=np.int32),
+            jnp.array([False]), jnp.array([True]), jnp.ones(n, dtype=bool),
+            spread_skew=jnp.array([skew], dtype=np.float32),
+        ))
+
+    # numpy oracle: dc = per-domain matching totals, w = log(#domains + 2)
+    w = np.log(2 + 2.0)
+    for skew in (1.0, 5.0):
+        raw = np.array([6.0, 6.0, 2.0, 2.0]) * w + (skew - 1.0)
+        mx, mn = raw.max(), raw.min()
+        want = 100.0 * (mx + mn - raw) / mx
+        np.testing.assert_allclose(run(skew), want, rtol=2e-4)
+    assert run(5.0)[0] > run(1.0)[0]  # the shift waters down the spread penalty

@@ -288,3 +288,32 @@ def test_session_run_cluster_resets_preemption_state():
     # restarting the session must not crash on stale preemption arrays
     r = sim.run_cluster()
     assert r.unscheduled_pods == []
+
+
+def test_pdb_percentage_resolves_against_expected_count():
+    # kube resolves minAvailable/maxUnavailable percentages against the
+    # controller's expected pod count; in a partially-scheduled state the
+    # healthy count would understate the floor and over-allow disruptions.
+    import numpy as np
+
+    from open_simulator_tpu.encode.snapshot import encode_cluster
+    from open_simulator_tpu.engine.preemption import _PdbState
+
+    nodes = [make_node("n0")]
+    pods = [make_pod(f"p{i}", labels={"app": "db"}) for i in range(4)]
+    snap = encode_cluster(nodes, pods)
+    assign = np.array([0, 0, -1, -1])  # 2 healthy of 4 expected
+
+    st = _PdbState(snap, [pdb("b", {"app": "db"}, min_available="50%")], assign)
+    # 50% of expected(4) = 2 must stay; healthy = 2 -> zero disruptions left
+    # (against healthy(2) the floor would shrink to 1, allowing one eviction)
+    assert st.allowed == [0]
+
+    # maxUnavailable: disruptionsAllowed = healthy - (expected - maxUnavailable);
+    # the two already-missing pods consumed the whole 25%-of-4 budget
+    st2 = _PdbState(snap, [pdb("b2", {"app": "db"}, max_unavailable="25%")], assign)
+    assert st2.allowed == [0]
+    # with everything healthy the same budget allows one eviction
+    st3 = _PdbState(snap, [pdb("b3", {"app": "db"}, max_unavailable="25%")],
+                    np.array([0, 0, 0, 0]))
+    assert st3.allowed == [1]
