@@ -31,14 +31,17 @@ def build(n_nodes: int, n_pods: int, max_new: int):
     return ge._synthetic_snapshot(n_nodes=n_nodes, n_pods=n_pods, max_new=max_new)
 
 
-def run_batched(snapshot, n_scenarios: int):
+def run_batched(snapshot, n_scenarios: int, fail_reasons: bool = False):
+    """Time the capacity-sweep product path: what-if lanes run with
+    fail_reasons off (the applier re-runs only the decoded lane with
+    reasons on — not part of the per-lane sweep cost; parallel/sweep.py)."""
     import jax
     import jax.numpy as jnp
 
     from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
     from open_simulator_tpu.parallel.sweep import active_masks_for_counts
 
-    cfg = make_config(snapshot)
+    cfg = make_config(snapshot)._replace(fail_reasons=fail_reasons)
     arrs = device_arrays(snapshot)
     max_new = snapshot.n_nodes - snapshot.n_real_nodes
     counts = [min(i % (max_new + 1), max_new) for i in range(n_scenarios)]
@@ -49,7 +52,7 @@ def run_batched(snapshot, n_scenarios: int):
     jax.block_until_ready(out.node)
 
     best = float("inf")
-    for _ in range(3):
+    for _ in range(5):  # the axon tunnel adds run-to-run noise; keep the best
         t0 = time.perf_counter()
         out = fn(masks)
         jax.block_until_ready(out.node)
@@ -96,6 +99,7 @@ PRESETS = {
     "fit1k": dict(nodes=1024, pods=10240, scenarios=64, max_new=64),   # config 2
     "affinity1k": dict(nodes=1024, pods=10240, scenarios=64, max_new=64),  # config 3 (synthetic pods carry spread constraints already)
     "sweep": dict(nodes=1024, pods=2048, scenarios=512, max_new=512),  # config 4
+    "northstar": dict(nodes=5120, pods=51200, scenarios=64, max_new=64),  # BASELINE.md north star shape (single chip)
     "default": dict(nodes=1024, pods=2048, scenarios=256, max_new=64),
 }
 
@@ -108,6 +112,11 @@ def main():
     ap.add_argument("--scenarios", type=int)
     ap.add_argument("--max-new", type=int)
     ap.add_argument("--skip-baseline", action="store_true")
+    ap.add_argument(
+        "--fail-reasons", action="store_true",
+        help="time the simulate() path (per-op failure accounting in every "
+             "lane) instead of the default sweep path",
+    )
     args = ap.parse_args()
     preset = PRESETS[args.preset]
     for k in ("nodes", "pods", "scenarios", "max_new"):
@@ -115,8 +124,9 @@ def main():
             setattr(args, k, preset[k])
 
     snapshot = build(args.nodes, args.pods, args.max_new)
-    dt = run_batched(snapshot, args.scenarios)
+    dt = run_batched(snapshot, args.scenarios, fail_reasons=args.fail_reasons)
     pods_per_sec = args.pods * args.scenarios / dt
+    scenarios_per_sec = args.scenarios / dt
 
     base_rate = 0.0 if args.skip_baseline else cpu_baseline_rate(args.nodes)
     vs = pods_per_sec / base_rate if base_rate > 0 else 0.0
@@ -131,6 +141,8 @@ def main():
         # vs_baseline is a round-over-round tracking ratio, NOT "x the Go
         # reference"
         "baseline": "xla_cpu_single_lane_same_engine",
+        "scenarios_per_sec": round(scenarios_per_sec, 2),
+        "preset": args.preset,
     }))
 
 

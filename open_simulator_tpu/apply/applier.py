@@ -273,14 +273,9 @@ class Applier:
             import time
 
             from open_simulator_tpu.engine.preemption import run_with_preemption
-            from open_simulator_tpu.engine.scheduler import device_arrays, schedule_pods
+            from open_simulator_tpu.engine.scheduler import schedule_pods
 
-            if getattr(self, "_arrs_snapshot", None) is not snapshot:
-                # one host->device upload per snapshot, reused across the
-                # interactive prompt loop's repeated lane decodes
-                self._arrs_cache = device_arrays(snapshot)
-                self._arrs_snapshot = snapshot
-            arrs = self._arrs_cache
+            arrs = self._device_arrays_for(snapshot)
             lane_active = np.asarray(masks[idx])
 
             def schedule_fn(disabled, nominated):
@@ -300,6 +295,26 @@ class Applier:
                 gpu_pick=np.asarray(out.gpu_pick) if cfg.enable_gpu else None,
                 preempted_by=pre.preempted_by,
             )
+        if lane_has_unscheduled and cfg is not None:
+            # The sweep lanes run with fail_reasons off (EngineConfig); the
+            # reported lane needs real per-op counts, so re-run just this
+            # lane with the accounting on — and decode the re-run's own
+            # assignments so node picks and fail rows come from one run
+            # (vmap vs single-lane reduction order can break exact ties
+            # differently).
+            from open_simulator_tpu.engine.scheduler import schedule_pods
+
+            out = schedule_pods(
+                self._device_arrays_for(snapshot), np.asarray(masks[idx]),
+                cfg._replace(fail_reasons=True),
+            )
+            return decode_result(
+                snapshot,
+                np.asarray(out.node),
+                np.asarray(out.fail_counts),
+                masks[idx],
+                gpu_pick=np.asarray(out.gpu_pick) if cfg.enable_gpu else None,
+            )
         return decode_result(
             snapshot,
             plan.nodes_per_scenario[idx],
@@ -307,6 +322,16 @@ class Applier:
             masks[idx],
             gpu_pick=plan.gpu_pick[idx] if plan.gpu_pick is not None else None,
         )
+
+    def _device_arrays_for(self, snapshot):
+        """One host->device upload per snapshot, reused across the
+        interactive prompt loop's repeated lane decodes."""
+        if getattr(self, "_arrs_snapshot", None) is not snapshot:
+            from open_simulator_tpu.engine.scheduler import device_arrays
+
+            self._arrs_cache = device_arrays(snapshot)
+            self._arrs_snapshot = snapshot
+        return self._arrs_cache
 
     def _run_interactive(self, snapshot, cfg, thresholds, max_new: int) -> int:
         """Parity mode: the reference's prompt loop (apply.go:202-258),

@@ -52,9 +52,21 @@ class EngineConfig(NamedTuple):
     # scores (generic_scheduler.go:144-168). 0 = deterministic lowest index;
     # nonzero seeds a stateless per-pod jitter that only breaks exact ties.
     tie_break_seed: int = 0
-    # lax.scan unroll: 3 measured best on v5e (4.26M vs 4.02M pods/s at 2,
-    # 3.12M at 1; >4 regresses — see ROADMAP perf notes).
+    # lax.scan unroll (retuned on v5e with compact_carry + fail_reasons off;
+    # the driver-captured number is the number, per-round BENCH_r*.json).
     scan_unroll: int = 3
+    # Carry compaction: group_count/term_block hold small integer counts;
+    # storing them bfloat16 (native on the VPU; integer-exact to 256) halves
+    # their carry bytes. make_config disables this if any node could hold
+    # >= 255 pods (the count would stop incrementing exactly). int16 was
+    # measured too: emulated integer adds cost more than the bytes saved.
+    compact_carry: bool = True
+    # Per-op failure-reason accounting (the "0/N nodes are available: ..."
+    # decode). Computing first-failing-op one-hots over [OPS, N] every step
+    # costs ~45% of scan throughput (measured v5e, 1024n); the capacity
+    # sweep turns it off for the what-if lanes and re-runs only the decoded
+    # lane with reasons on (parallel/sweep.py + apply/applier.py).
+    fail_reasons: bool = True
 
     @property
     def n_ops(self) -> int:
@@ -64,14 +76,18 @@ class EngineConfig(NamedTuple):
 class SimState(NamedTuple):
     """The scan carry — the whole mutable world of the simulation.
     (The reference spreads this across the fake clientset, the scheduler
-    cache, and the gpu-share cache; here it is five dense arrays.)"""
+    cache, and the gpu-share cache; here it is five dense arrays.)
 
-    used: jnp.ndarray         # [N, R]
-    group_count: jnp.ndarray  # [N, S]
-    term_block: jnp.ndarray   # [N, T]
-    pref_paint: jnp.ndarray   # [N, T2] weighted preferred-term domains
+    group_count/term_block store small integer counts; with
+    cfg.compact_carry they are bfloat16 (f32 otherwise), halving their
+    carry bytes per step."""
+
+    used: jnp.ndarray         # [N, R] f32
+    group_count: jnp.ndarray  # [N, S] bf16 | f32
+    term_block: jnp.ndarray   # [N, T] bf16 | f32
+    pref_paint: jnp.ndarray   # [N, T2] f32 weighted preferred-term domains
     ports_used: jnp.ndarray   # [N, Pt] bool
-    gpu_used: jnp.ndarray     # [N, G]
+    gpu_used: jnp.ndarray     # [N, G] f32
 
 
 class ScheduleOutput(NamedTuple):
@@ -88,7 +104,7 @@ def device_arrays(snapshot: ClusterSnapshot) -> SnapshotArrays:
     return jax.tree_util.tree_map(jnp.asarray, snapshot.arrays)
 
 
-def init_state(arrs: SnapshotArrays) -> SimState:
+def init_state(arrs: SnapshotArrays, cfg: "EngineConfig | None" = None) -> SimState:
     n, r = arrs.alloc.shape
     s = arrs.match_groups.shape[1]
     t = arrs.own_terms.shape[1]
@@ -96,10 +112,12 @@ def init_state(arrs: SnapshotArrays) -> SimState:
     pt = arrs.ports.shape[1]
     g = arrs.gpu_slot.shape[1]
     f32 = jnp.float32
+    # no cfg -> f32: only make_config knows whether bf16 counts stay exact
+    cdt = jnp.bfloat16 if (cfg is not None and cfg.compact_carry) else f32
     return SimState(
         used=jnp.zeros((n, r), f32),
-        group_count=jnp.zeros((n, s), f32),
-        term_block=jnp.zeros((n, t), f32),
+        group_count=jnp.zeros((n, s), cdt),
+        term_block=jnp.zeros((n, t), cdt),
         pref_paint=jnp.zeros((n, t2), f32),
         ports_used=jnp.zeros((n, pt), dtype=bool),
         gpu_used=jnp.zeros((n, g), f32),
@@ -126,6 +144,11 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
     n_nodes = arrs.alloc.shape[0]
     f32 = jnp.float32
 
+    # compact carry columns are stored bf16; compute in f32 (the casts fuse
+    # into the loop body — only the halved carry bytes hit HBM per step)
+    gc = state.group_count.astype(f32)
+    tb = state.term_block.astype(f32)
+
     cm_aff = arrs.class_affinity[x["class_id"]]      # [N]
     cm_taint = arrs.class_taint[x["class_id"]]
     na_row = arrs.class_node_aff_score[x["class_id"]]
@@ -138,16 +161,16 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
     ok_ports = filters.ports_free(state.ports_used, x["ports"])
     fit = filters.fit_per_resource(state.used, arrs.alloc, x["req"])   # [N, R]
     ok_pod_aff = filters.pod_affinity_ok(
-        state.group_count, arrs.topo_onehot, arrs.has_key,
+        gc, arrs.topo_onehot, arrs.has_key,
         x["aff_group"], x["aff_key"], x["aff_valid"], x["aff_self"],
     )
     ok_pod_anti = filters.pod_anti_affinity_ok(
-        state.group_count, state.term_block, arrs.topo_onehot, arrs.has_key,
+        gc, tb, arrs.topo_onehot, arrs.has_key,
         x["anti_group"], x["anti_key"], x["anti_valid"], x["hit_terms"],
     )
     spread_self = x["match_groups"][x["spread_group"]] & x["spread_valid"]
     ok_spread = filters.topology_spread_ok(
-        state.group_count, arrs.topo_onehot, arrs.has_key,
+        gc, arrs.topo_onehot, arrs.has_key,
         active & cm_aff,
         x["spread_group"], x["spread_key"], x["spread_skew"],
         x["spread_hard"], x["spread_valid"], spread_self,
@@ -167,12 +190,16 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
     mask = active & jnp.all(ops_ok, axis=0)          # [N]
 
     # first failing op per node -> per-op failure counts (active nodes only)
-    fails = ~ops_ok                                   # [OPS, N]
-    first_fail = jnp.argmax(fails, axis=0)            # [N]
-    any_fail = jnp.any(fails, axis=0)
-    charged = active & any_fail
-    onehot_ops = (first_fail[None, :] == jnp.arange(cfg.n_ops)[:, None])  # [OPS, N]
-    fail_counts = jnp.sum(onehot_ops & charged[None, :], axis=1).astype(jnp.int32)
+    if cfg.fail_reasons:
+        fails = ~ops_ok                               # [OPS, N]
+        first_fail = jnp.argmax(fails, axis=0)        # [N]
+        any_fail = jnp.any(fails, axis=0)
+        charged = active & any_fail
+        onehot_ops = (first_fail[None, :] == jnp.arange(cfg.n_ops)[:, None])  # [OPS, N]
+        fail_counts = jnp.sum(onehot_ops & charged[None, :], axis=1).astype(jnp.int32)
+    else:
+        # shape [0]: no per-step ys emitted, no [P, OPS] output materialized
+        fail_counts = jnp.zeros((0,), jnp.int32)
 
     # ---- scores (feasible nodes only) ---------------------------------
     score = jnp.zeros((n_nodes,), f32)
@@ -190,11 +217,11 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
     # "existing pod" direction)
     existing_pref_raw = state.pref_paint @ x["hit_pref"].astype(f32)
     score += cfg.w_interpod * scores.interpod_preference_score(
-        state.group_count, arrs.topo_onehot, arrs.has_key,
+        gc, arrs.topo_onehot, arrs.has_key,
         x["pref_group"], x["pref_key"], x["pref_weight"], x["pref_valid"], mask,
         extra_raw=existing_pref_raw)
     score += cfg.w_spread * scores.topology_spread_score(
-        state.group_count, arrs.topo_onehot, arrs.has_key, active,
+        gc, arrs.topo_onehot, arrs.has_key, active,
         x["spread_group"], x["spread_key"], x["spread_hard"],
         x["spread_valid"], mask, spread_skew=x["spread_skew"])
     score += cfg.w_simon * scores.simon_max_share_score(arrs.alloc, x["req"], mask)
@@ -242,8 +269,11 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
     bound = final_node >= 0
     safe_node = jnp.maximum(final_node, 0)
     onehot_n = jax.nn.one_hot(final_node, n_nodes, dtype=f32)  # -1 -> zeros
+    cdt = state.group_count.dtype
     used = state.used + onehot_n[:, None] * x["req"][None, :]
-    group_count = state.group_count + onehot_n[:, None] * x["match_groups"].astype(f32)[None, :]
+    group_count = state.group_count + (
+        onehot_n[:, None] * x["match_groups"].astype(f32)[None, :]
+    ).astype(cdt)
     ports_used = state.ports_used | ((onehot_n[:, None] > 0) & x["ports"][None, :])
 
     # anti-affinity domain paint for this pod's own terms:
@@ -255,7 +285,7 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
         sd_list.append(oh @ oh[safe_node] * bound.astype(f32))
     sd_all = jnp.stack(sd_list)                       # [K, N]
     paint = sd_all[arrs.term_key].T * x["own_terms"].astype(f32)[None, :]  # [N, T]
-    term_block = state.term_block + paint
+    term_block = state.term_block + paint.astype(cdt)  # 0/1 values, cast exact
 
     # weighted paint of this pod's own preferred terms (for future pods'
     # existing-direction score); Ap is tiny and static -> unrolled
@@ -298,7 +328,7 @@ def schedule_pods(
     nominated [P] i32 is the preemption retry's nominatedNodeName (-1 = none).
     """
     if state is None:
-        state = init_state(arrs)
+        state = init_state(arrs, cfg)
     xs = _pod_xs(arrs)
     n_pods = arrs.req.shape[0]
     xs["_disabled"] = (
@@ -311,6 +341,10 @@ def schedule_pods(
     final_state, (nodes, fail_counts, feasible, gpu_pick) = jax.lax.scan(
         step, state, xs, unroll=cfg.scan_unroll
     )
+    if not cfg.fail_reasons:
+        # keep the output contract ([P, OPS]) without paying a per-step
+        # accounting pass or a materialized scan output
+        fail_counts = jnp.zeros((n_pods, cfg.n_ops), jnp.int32)
     return ScheduleOutput(
         node=nodes, fail_counts=fail_counts, feasible=feasible, gpu_pick=gpu_pick,
         state=final_state,
@@ -336,8 +370,16 @@ def make_config(snapshot: ClusterSnapshot, **overrides) -> EngineConfig:
     res = snapshot.resources
     cpu_mem = (res.index("cpu"), res.index("memory"))
     enable_gpu = bool(np.any(snapshot.arrays.gpu_count > 0))
+    # bf16 carry counts stay integer-exact while no node can hold 255 pods;
+    # the per-node ceiling is min(pods allocatable, total pod count)
+    if "pods" in res:
+        max_per_node = float(np.min([np.max(snapshot.arrays.alloc[:, res.index("pods")]),
+                                     snapshot.n_pods]))
+    else:
+        max_per_node = float(snapshot.n_pods)
     kw: Dict[str, Any] = dict(
-        n_resources=len(res), cpu_mem_idx=cpu_mem, enable_gpu=enable_gpu
+        n_resources=len(res), cpu_mem_idx=cpu_mem, enable_gpu=enable_gpu,
+        compact_carry=max_per_node < 255,
     )
     kw.update(overrides)
     return EngineConfig(**kw)

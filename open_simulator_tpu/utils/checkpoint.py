@@ -26,18 +26,43 @@ def save_simulation(
     node_assign: Optional[np.ndarray] = None,
     meta: Optional[dict] = None,
 ) -> None:
-    arrays = {f"state_{k}": np.asarray(v) for k, v in state._asdict().items()}
+    # npz cannot round-trip ml_dtypes (the compact bfloat16 carry comes back
+    # as raw void bytes) — store widened and record the original dtype
+    arrays = {}
+    dtypes = {}
+    for k, v in state._asdict().items():
+        a = np.asarray(v)
+        dtypes[k] = str(a.dtype)
+        if a.dtype not in (np.float32, np.float64, np.int32, np.int64, np.bool_):
+            a = a.astype(np.float32)
+        arrays[f"state_{k}"] = a
     if node_assign is not None:
         arrays["node_assign"] = np.asarray(node_assign)
     arrays["meta_json"] = np.frombuffer(
-        json.dumps(meta or {}).encode(), dtype=np.uint8
+        json.dumps({"user": meta or {}, "state_dtypes": dtypes}).encode(), dtype=np.uint8
     )
     np.savez_compressed(path, **arrays)
 
 
 def load_simulation(path: str) -> Tuple[SimState, Optional[np.ndarray], dict]:
+    import ml_dtypes  # jax dependency; provides the bfloat16 numpy dtype
+
     with np.load(path) as z:
-        state = SimState(**{k[len("state_"):]: z[k] for k in z.files if k.startswith("state_")})
+        raw = json.loads(bytes(z["meta_json"]).decode()) if "meta_json" in z.files else {}
+        if "state_dtypes" in raw:
+            meta, dtypes = raw.get("user", {}), raw["state_dtypes"]
+        else:  # pre-round-2 checkpoint: meta only, dtypes as stored
+            meta, dtypes = raw, {}
+        fields = {}
+        for k in z.files:
+            if not k.startswith("state_"):
+                continue
+            name = k[len("state_"):]
+            a = z[k]
+            want = dtypes.get(name, str(a.dtype))
+            if want != str(a.dtype):
+                a = a.astype(np.dtype(want) if want != "bfloat16" else ml_dtypes.bfloat16)
+            fields[name] = a
+        state = SimState(**fields)
         node_assign = z["node_assign"] if "node_assign" in z.files else None
-        meta = json.loads(bytes(z["meta_json"]).decode()) if "meta_json" in z.files else {}
     return state, node_assign, meta
