@@ -91,11 +91,22 @@ def decode_result(
         ni = int(node_assign[i])
         if ni >= 0:
             if gpu_pick is not None and pod.gpu_request()[0] > 0:
-                devs = [str(d) for d in np.nonzero(gpu_pick[i])[0]]
-                if devs:
+                if bool(snapshot.arrays.gpu_has_forced[i]):
+                    # user-pinned gpu-index is honored verbatim (the check
+                    # is encode-time truth, NOT the annotation dict — decode
+                    # itself writes that annotation, and repeated decodes of
+                    # the same snapshot must not treat it as a pin)
+                    pass
+                else:
                     # gpu-index assignment annotation, as the reference's
-                    # Reserve writes back (open-gpu-share.go:147-188)
-                    pod.meta.annotations[ANNO_GPU_INDEX] = "-".join(devs)
+                    # Reserve writes back (open-gpu-share.go:147-188);
+                    # counts > 1 repeat the device id ("0-0-1"), matching
+                    # the two-pointer's candDevIdList order
+                    devs: List[str] = []
+                    for d in np.nonzero(gpu_pick[i])[0]:
+                        devs += [str(d)] * int(gpu_pick[i][d])
+                    if devs:
+                        pod.meta.annotations[ANNO_GPU_INDEX] = "-".join(devs)
             scheduled.append(ScheduledPod(pod=pod, node_name=snapshot.node_names[ni]))
             pods_by_node.setdefault(ni, []).append(pod)
         else:

@@ -121,7 +121,7 @@ class SnapshotArrays:
     hit_pref: np.ndarray       # [P, T2] pod matches preferred term t2's selector
     gpu_mem: np.ndarray        # [P] f32 per-device gpu memory request
     gpu_cnt: np.ndarray        # [P] f32 number of devices wanted
-    gpu_forced: np.ndarray     # [P, G] bool pre-pinned device ids (gpu-index anno)
+    gpu_forced: np.ndarray     # [P, G] i32 pre-pinned device multiplicities (gpu-index anno)
     gpu_has_forced: np.ndarray  # [P] bool
 
 
@@ -443,7 +443,9 @@ def encode_cluster(
     gpu_mem = np.zeros(P, dtype=np.float32)
     gpu_cnt = np.zeros(P, dtype=np.float32)
     G = max(1, min(opts.max_gpus_per_node, 64))
-    gpu_forced = np.zeros((P, G), dtype=bool)
+    # per-device multiplicities: a pinned "0-0-1" packs two of the pod's
+    # GPUs onto device 0 (AllocateGpuId's two-pointer can do the same)
+    gpu_forced = np.zeros((P, G), dtype=np.int32)
     gpu_has_forced = np.zeros(P, dtype=bool)
     for pi, p in enumerate(pods):
         for r, v in p.requests().items():
@@ -459,7 +461,7 @@ def encode_cluster(
             gpu_has_forced[pi] = True
             for tok in str(idx_anno).split("-"):
                 if tok.isdigit() and int(tok) < G:
-                    gpu_forced[pi, int(tok)] = True
+                    gpu_forced[pi, int(tok)] += 1
 
     # ---- gpu node arrays ----------------------------------------------
     gpu_count = np.zeros(N, dtype=np.float32)

@@ -193,7 +193,8 @@ def _gpu_row(arrs, i: int) -> np.ndarray:
     if mem <= 0 or cnt <= 0:
         return row
     if arrs.gpu_has_forced[i]:
-        row[np.asarray(arrs.gpu_forced[i])] = mem
+        # gpu_forced holds per-device multiplicities ("0-0-1" -> [2,1,...])
+        row += np.asarray(arrs.gpu_forced[i], dtype=np.float32) * mem
     else:
         row[:cnt] = mem
     return row
@@ -266,7 +267,10 @@ def _select_victims_on_node(
         mem, cnt = float(arrs.gpu_mem[i]), int(arrs.gpu_cnt[i])
         if mem > 0 and cnt > 0:
             free = (arrs.gpu_cap_mem[n] - gp) * arrs.gpu_slot[n]
-            if int(np.sum(free >= mem - 1e-6)) < cnt:
+            # two-pointer feasibility: one device holds floor(idle/mem) of
+            # the requested GPUs (gpu_share._slots_per_device host mirror)
+            slots = np.floor(np.clip(free + 1e-6, 0.0, None) / mem)
+            if int(np.sum(slots)) < cnt:
                 return False
         return True
 

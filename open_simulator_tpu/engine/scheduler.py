@@ -94,7 +94,7 @@ class ScheduleOutput(NamedTuple):
     node: jnp.ndarray         # [P] i32, -1 = unscheduled
     fail_counts: jnp.ndarray  # [P, OPS] i32
     feasible: jnp.ndarray     # [P] i32 feasible-node count
-    gpu_pick: jnp.ndarray     # [P, G] bool devices assigned on the bound node
+    gpu_pick: jnp.ndarray     # [P, G] i32 per-device GPU multiplicities on the bound node
     state: SimState
 
 
@@ -177,7 +177,8 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
     )
     if cfg.enable_gpu:
         ok_gpu = gpu_share.gpu_fit(
-            state.gpu_used, arrs.gpu_cap_mem, arrs.gpu_slot, x["gpu_mem"], x["gpu_cnt"]
+            state.gpu_used, arrs.gpu_cap_mem, arrs.gpu_slot, x["gpu_mem"], x["gpu_cnt"],
+            x["gpu_has_forced"],
         )
     else:
         ok_gpu = jnp.ones((n_nodes,), dtype=bool)
@@ -301,12 +302,12 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
             state.gpu_used[safe_node], arrs.gpu_cap_mem[safe_node], arrs.gpu_slot[safe_node],
             x["gpu_mem"], x["gpu_cnt"], x["gpu_forced"], x["gpu_has_forced"],
         )
-        pick = pick & bound
+        pick = pick * bound  # [G] i32 multiplicities; zeroed when unbound
         gpu_used = state.gpu_used + (
             onehot_n[:, None] * pick.astype(f32)[None, :] * x["gpu_mem"]
         )
     else:
-        pick = jnp.zeros_like(state.gpu_used[0], dtype=bool)
+        pick = jnp.zeros_like(state.gpu_used[0], dtype=jnp.int32)
         gpu_used = state.gpu_used
 
     new_state = SimState(used, group_count, term_block, pref_paint, ports_used, gpu_used)

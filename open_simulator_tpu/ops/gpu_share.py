@@ -8,21 +8,39 @@ on a dense per-device memory array:
   node  gpu_cap  [N]      per-device memory capacity (uniform per node)
         gpu_slot [N, G]   1.0 for real device slots
 
-Filter (open-gpu-share.go:51-81): a node fits a (mem, cnt) request iff it
-has >= cnt devices with free memory >= mem. This is exactly the
-feasibility of the reference's tightest-fit / two-pointer packing
-(gpunodeinfo.go:232-290), because every selected device just needs `mem`.
+Allocation parity with AllocateGpuId (gpunodeinfo.go:232-290):
 
-Assignment on bind: the cnt feasible devices with the least free memory
-(tightest fit), matching the reference's preference for packing; realized
-with a branchless top-k over sort keys.
+  * single-GPU (cnt == 1): tightest fit — the feasible device with the
+    least idle memory, first (lowest id) wins ties;
+  * multi-GPU: the two-pointer greedy packs requested GPUs onto devices in
+    ascending id order, and a single physical device takes as many of the
+    requested GPUs as its idle memory holds (floor(idle/mem) "slots") —
+    so an assignment is a per-device COUNT, e.g. "0-0-1";
+  * a pre-pinned gpu-index annotation is honored verbatim (found=true
+    without capacity checks, gpunodeinfo.go:247-253).
+
+Filter parity (open-gpu-share.go:51-81): no-GPU pods pass; otherwise the
+node's TOTAL GPU capacity must cover mem*cnt and AllocateGpuId must
+succeed (pinned pods therefore auto-pass the second check).
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 _BIG = jnp.float32(3.4e38)
+
+
+def _slots_per_device(
+    gpu_used: jnp.ndarray, gpu_cap, gpu_slot: jnp.ndarray, mem_p: jnp.ndarray
+) -> jnp.ndarray:
+    """floor(idle/mem) per device — how many of the pod's requested GPUs a
+    single physical device can hold (the two-pointer inner loop)."""
+    free = gpu_cap - gpu_used
+    mem_safe = jnp.where(mem_p > 0, mem_p, 1.0)
+    slots = jnp.floor(jnp.clip(free, 0.0) / mem_safe)
+    return jnp.where(gpu_slot > 0, slots, 0.0)
 
 
 def gpu_fit(
@@ -31,13 +49,18 @@ def gpu_fit(
     gpu_slot: jnp.ndarray,  # [N, G]
     mem_p: jnp.ndarray,     # scalar: per-device memory request
     cnt_p: jnp.ndarray,     # scalar: device count request
+    has_forced_p: jnp.ndarray = False,  # scalar bool: pre-pinned gpu-index
 ) -> jnp.ndarray:
-    """[N] bool: node has >= cnt devices with free >= mem. Pods without a
-    GPU request pass everywhere."""
-    free = gpu_cap[:, None] - gpu_used                      # [N, G]
-    feasible_dev = (gpu_slot > 0) & (free >= mem_p)
-    n_feasible = jnp.sum(feasible_dev.astype(jnp.float32), axis=1)
-    ok = n_feasible >= cnt_p
+    """[N] bool: GPU-share Filter. Total capacity must cover mem*cnt and
+    the two-pointer allocation must succeed: sum_d floor(idle_d/mem) >= cnt
+    (for cnt == 1 this reduces to "some device has idle >= mem"). Pods
+    without a GPU request pass everywhere; pinned pods skip the
+    allocation-feasibility check like the reference's early return."""
+    total_cap = gpu_cap * jnp.sum(gpu_slot, axis=1)
+    cap_ok = total_cap >= mem_p * cnt_p
+    slots = _slots_per_device(gpu_used, gpu_cap[:, None], gpu_slot, mem_p)  # [N, G]
+    alloc_ok = jnp.sum(slots, axis=1) >= cnt_p
+    ok = cap_ok & (alloc_ok | jnp.asarray(has_forced_p, dtype=bool))
     return jnp.where(cnt_p > 0, ok, True)
 
 
@@ -70,18 +93,31 @@ def gpu_pick_devices(
     gpu_slot_n: jnp.ndarray,  # [G]
     mem_p: jnp.ndarray,
     cnt_p: jnp.ndarray,
-    forced_mask: jnp.ndarray,   # [G] pre-pinned device ids (gpu-index annotation)
-    has_forced: jnp.ndarray,    # scalar bool
+    forced_counts: jnp.ndarray,  # [G] i32 pre-pinned multiplicities (gpu-index)
+    has_forced: jnp.ndarray,     # scalar bool
 ) -> jnp.ndarray:
-    """[G] bool: which devices receive `mem_p`. Tightest fit: among feasible
-    devices, pick the cnt with the least free memory (gpunodeinfo.go:232-290
-    single-GPU tightest-fit generalized; honors a pre-pinned gpu-index)."""
+    """[G] int32: how many of the pod's requested GPUs each device receives
+    (device d's memory debit is count*mem). Exact AllocateGpuId parity:
+    tightest fit for cnt == 1, ascending-id two-pointer greedy with
+    per-device multiplicity for cnt > 1, pinned gpu-index verbatim."""
     g = gpu_used_n.shape[0]
     free = gpu_cap_n - gpu_used_n
     feasible = (gpu_slot_n > 0) & (free >= mem_p)
-    key = jnp.where(feasible, free, _BIG)             # prefer least free
-    order = jnp.argsort(key)                           # ascending
-    rank = jnp.zeros((g,), dtype=jnp.int32).at[order].set(jnp.arange(g, dtype=jnp.int32))
-    pick = feasible & (rank < cnt_p.astype(jnp.int32))
-    pick = jnp.where(has_forced, forced_mask, pick)
-    return pick & (cnt_p > 0)
+
+    # multi-GPU: ascending two-pointer; device d takes
+    # min(floor(idle_d/mem), cnt - slots already taken by devices < d)
+    slots = _slots_per_device(gpu_used_n, gpu_cap_n, gpu_slot_n, mem_p)  # [G]
+    before = jnp.cumsum(slots) - slots
+    take = jnp.clip(cnt_p - before, 0.0, slots)
+    complete = jnp.sum(slots) >= cnt_p                # two-pointer found?
+    multi = jnp.where(complete, take, 0.0)
+
+    # single GPU: tightest fit; argmin keeps the first (lowest id) on ties
+    # like the reference's strict < update
+    key = jnp.where(feasible, free, _BIG)
+    sel = jnp.argmin(key)
+    single = jax.nn.one_hot(sel, g, dtype=jnp.float32) * jnp.any(feasible)
+
+    pick = jnp.where(cnt_p == 1, single, multi)
+    pick = jnp.where(has_forced, forced_counts.astype(jnp.float32), pick)
+    return (pick * (cnt_p > 0)).astype(jnp.int32)

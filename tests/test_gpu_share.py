@@ -74,16 +74,28 @@ def test_tightest_fit_prefers_fuller_device():
     assert nxt.pod.meta.annotations[ANNO_GPU_INDEX] == "1"
 
 
-def test_multi_gpu_pod():
+def test_multi_gpu_pod_packs_like_two_pointer():
     res = run(
         [gpu_node("g0", gpus=1, mem_per_gpu=16), gpu_node("g1", gpus=4, mem_per_gpu=16)],
         [gpu_pod("dist", mem=8, count=3)],
     )
     assert not res.unscheduled_pods
     sp = res.scheduled_pods[0]
-    assert sp.node_name == "g1"
-    devs = sp.pod.meta.annotations[ANNO_GPU_INDEX].split("-")
-    assert len(devs) == 3 and len(set(devs)) == 3
+    assert sp.node_name == "g1"  # g0's single 16GiB device holds only 2 slots
+    # AllocateGpuId's two-pointer packs as many requested GPUs per device as
+    # idle memory holds, ascending ids: 16GiB/8GiB = 2 slots on dev 0, then 1
+    # on dev 1 (gpunodeinfo.go:269-289) — NOT three distinct devices
+    assert sp.pod.meta.annotations[ANNO_GPU_INDEX] == "0-0-1"
+
+
+def test_multi_gpu_spreads_when_devices_are_fragmented():
+    # 4 devices of 8GiB: an 8GiB x 3 pod takes one slot per device 0,1,2
+    res = run(
+        [gpu_node("g0", gpus=4, mem_per_gpu=8)],
+        [gpu_pod("dist", mem=8, count=3)],
+    )
+    assert not res.unscheduled_pods
+    assert res.scheduled_pods[0].pod.meta.annotations[ANNO_GPU_INDEX] == "0-1-2"
 
 
 def test_non_gpu_pods_avoid_nothing_but_gpu_nodes_allowed():
