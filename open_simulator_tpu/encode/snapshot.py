@@ -54,6 +54,7 @@ def filter_op_table(resources: Sequence[str]) -> List[str]:
         "node(s) didn't match pod anti-affinity rules",
         "node(s) didn't match pod topology spread constraints",
         "Insufficient GPU memory in one or more devices",
+        "node(s) had no volume group / free device for the pod's local volumes",
     ]
     return ops
 
@@ -123,6 +124,14 @@ class SnapshotArrays:
     gpu_cnt: np.ndarray        # [P] f32 number of devices wanted
     gpu_forced: np.ndarray     # [P, G] i32 pre-pinned device multiplicities (gpu-index anno)
     gpu_has_forced: np.ndarray  # [P] bool
+    # open-local exact storage (ops/storage.py); V VGs, E devices, Lv/Ev
+    # volumes per pod
+    vg_cap: np.ndarray         # [N, V] f32 MiB per volume group
+    sdev_cap: np.ndarray       # [N, E] f32 MiB per free exclusive device (0 = none)
+    sdev_ssd: np.ndarray       # [N, E] bool media type
+    lvm_req: np.ndarray        # [P, Lv] f32 MiB LVM volume sizes, descending
+    sdev_req: np.ndarray       # [P, Ev] f32 MiB exclusive-device claims, descending
+    sdev_req_ssd: np.ndarray   # [P, Ev] bool wants-ssd per claim
 
 
 @dataclass
@@ -474,6 +483,37 @@ def encode_cluster(
         gpu_cap_mem[i] = float(per_mem)
         gpu_slot[i, :cnt] = 1.0
 
+    # ---- open-local exact storage arrays ------------------------------
+    from open_simulator_tpu.k8s.local_storage import (
+        node_storage_layout,
+        pod_storage_volumes,
+    )
+
+    node_layouts = [node_storage_layout(n) for n in all_nodes]
+    pod_vols = [pod_storage_volumes(p) for p in pods]
+    V = max([len(vgs) for vgs, _ in node_layouts] + [1])
+    E = max([len(devs) for _, devs in node_layouts] + [1])
+    Lv = max([len(lvm) for lvm, _ in pod_vols] + [0])
+    Ev = max([len(d) for _, d in pod_vols] + [0])
+    vg_cap = np.zeros((N, V), dtype=np.float32)
+    sdev_cap = np.zeros((N, E), dtype=np.float32)
+    sdev_ssd = np.zeros((N, E), dtype=bool)
+    for i, (vgs, devs) in enumerate(node_layouts):
+        for j, cap in enumerate(vgs[:V]):
+            vg_cap[i, j] = float(cap)
+        for j, (cap, is_ssd) in enumerate(devs[:E]):
+            sdev_cap[i, j] = float(cap)
+            sdev_ssd[i, j] = is_ssd
+    lvm_req = np.zeros((P, max(Lv, 1)), dtype=np.float32)
+    sdev_req = np.zeros((P, max(Ev, 1)), dtype=np.float32)
+    sdev_req_ssd = np.zeros((P, max(Ev, 1)), dtype=bool)
+    for pi, (lvm, devs) in enumerate(pod_vols):
+        for j, size in enumerate(lvm):
+            lvm_req[pi, j] = float(size)
+        for j, (size, wants_ssd) in enumerate(devs):
+            sdev_req[pi, j] = float(size)
+            sdev_req_ssd[pi, j] = wants_ssd
+
     # ---- ragged term arrays -> padded ---------------------------------
     A = max((len(t) for t in pod_aff_terms), default=0)
     B = max((len(t) for t in pod_anti_terms), default=0)
@@ -546,6 +586,12 @@ def encode_cluster(
         gpu_cnt=gpu_cnt,
         gpu_forced=gpu_forced,
         gpu_has_forced=gpu_has_forced,
+        vg_cap=vg_cap,
+        sdev_cap=sdev_cap,
+        sdev_ssd=sdev_ssd,
+        lvm_req=lvm_req,
+        sdev_req=sdev_req,
+        sdev_req_ssd=sdev_req_ssd,
     )
 
     group_desc = [f"group#{i}" for i in range(S)]

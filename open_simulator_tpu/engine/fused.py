@@ -43,7 +43,7 @@ _CAPS = dict(S=96, T=48, T2=48, Pt=48, A=8, B=8, Cs=8, Ap=8, K=6, D=16, R=16, C=
 
 
 def fused_eligible(arrs: SnapshotArrays, cfg: EngineConfig) -> bool:
-    if cfg.enable_gpu or cfg.tie_break_seed:
+    if cfg.enable_gpu or cfg.tie_break_seed or cfg.enable_storage:
         return False
     k1, _, d = arrs.topo_onehot.shape
     dims = dict(
@@ -337,7 +337,9 @@ def _kernel_body(cfg: EngineConfig, dims: dict,
 
         ops_ok = [ok_unsched, cm_aff, cm_taint, ok_ports]
         ops_ok += fit_rows
-        ops_ok += [ok_aff, ok_anti, ok_spread, jnp.ones((LB, npad), f32)]
+        # gpu + storage rows are constant-true: fused_eligible excludes both
+        ops_ok += [ok_aff, ok_anti, ok_spread,
+                   jnp.ones((LB, npad), f32), jnp.ones((LB, npad), f32)]
 
         # first-failing-op reason counts + overall mask
         n_ops = len(ops_ok)
@@ -546,10 +548,10 @@ def schedule_pods_fused(
     Cs = arrs.spread_group.shape[1]
     Ap = arrs.pref_group.shape[1]
     OPS = cfg.n_ops
-    # the kernel's hand-built ops_ok list ([4 base] + R fit rows + [4 tail])
+    # the kernel's hand-built ops_ok list ([4 base] + R fit rows + [5 tail])
     # must stay in lockstep with filter_op_table for fail-reason decode
-    assert OPS == OP_FIT_BASE + R + 4, (
-        f"fused op list ({OP_FIT_BASE}+{R}+4) out of sync with cfg.n_ops={OPS}"
+    assert OPS == OP_FIT_BASE + R + 5, (
+        f"fused op list ({OP_FIT_BASE}+{R}+5) out of sync with cfg.n_ops={OPS}"
     )
     dims = dict(R=R, S=S, T=T, T2=T2, Pt=Pt, A=A, B=B, Cs=Cs, Ap=Ap, K=K, D=D)
 
@@ -659,10 +661,13 @@ def schedule_pods_fused(
         pref_paint=unstate(prefo, T2),
         ports_used=unstate(portso, Pt) > 0,
         gpu_used=jnp.zeros((L, n, g), f32),
+        # gpu/storage excluded by fused_eligible; keep the pytree shape
+        vg_used=jnp.zeros((L, n, arrs.vg_cap.shape[1]), f32),
+        sdev_taken=jnp.zeros((L, n, arrs.sdev_cap.shape[1]), bool),
     )
     return ScheduleOutput(
         node=jnp.concatenate(sels, axis=1),
         fail_counts=jnp.concatenate(fails, axis=1),
         feasible=jnp.concatenate(feass, axis=1),
-        gpu_pick=jnp.zeros((L, P, g), bool), state=state,
+        gpu_pick=jnp.zeros((L, P, g), jnp.int32), state=state,
     )

@@ -27,7 +27,7 @@ from open_simulator_tpu.encode.snapshot import (
     ClusterSnapshot,
     SnapshotArrays,
 )
-from open_simulator_tpu.ops import filters, gpu_share, scores
+from open_simulator_tpu.ops import filters, gpu_share, scores, storage
 
 
 class EngineConfig(NamedTuple):
@@ -38,6 +38,10 @@ class EngineConfig(NamedTuple):
     n_resources: int
     cpu_mem_idx: Tuple[int, ...] = (0, 1)
     enable_gpu: bool = False
+    # open-local exact per-VG/per-device storage ops (ops/storage.py);
+    # autodetected off when no node carries a local-storage annotation so
+    # storage-free clusters pay nothing
+    enable_storage: bool = False
     # score weights (v1beta2 defaults + Simon appended with weight 1)
     w_balanced: float = 1.0
     w_least: float = 1.0
@@ -70,7 +74,9 @@ class EngineConfig(NamedTuple):
 
     @property
     def n_ops(self) -> int:
-        return OP_FIT_BASE + self.n_resources + 4
+        # 4 pre-fit masks + R fit rows + [pod-aff, anti-aff, spread, gpu,
+        # storage] (filter_op_table order)
+        return OP_FIT_BASE + self.n_resources + 5
 
 
 class SimState(NamedTuple):
@@ -88,6 +94,8 @@ class SimState(NamedTuple):
     pref_paint: jnp.ndarray   # [N, T2] f32 weighted preferred-term domains
     ports_used: jnp.ndarray   # [N, Pt] bool
     gpu_used: jnp.ndarray     # [N, G] f32
+    vg_used: jnp.ndarray      # [N, V] f32 open-local volume-group MiB
+    sdev_taken: jnp.ndarray   # [N, E] bool exclusive devices claimed
 
 
 class ScheduleOutput(NamedTuple):
@@ -121,6 +129,8 @@ def init_state(arrs: SnapshotArrays, cfg: "EngineConfig | None" = None) -> SimSt
         pref_paint=jnp.zeros((n, t2), f32),
         ports_used=jnp.zeros((n, pt), dtype=bool),
         gpu_used=jnp.zeros((n, g), f32),
+        vg_used=jnp.zeros((n, arrs.vg_cap.shape[1]), f32),
+        sdev_taken=jnp.zeros((n, arrs.sdev_cap.shape[1]), dtype=bool),
     )
 
 
@@ -134,6 +144,7 @@ def _pod_xs(arrs: SnapshotArrays) -> Dict[str, jnp.ndarray]:
         "spread_group", "spread_key", "spread_skew", "spread_hard", "spread_valid",
         "pref_group", "pref_key", "pref_weight", "pref_valid", "pref_tid", "hit_pref",
         "gpu_mem", "gpu_cnt", "gpu_forced", "gpu_has_forced",
+        "lvm_req", "sdev_req", "sdev_req_ssd",
     ]
     xs = {k: getattr(arrs, k) for k in names}
     xs["_pod_index"] = jnp.arange(arrs.req.shape[0], dtype=jnp.int32)
@@ -182,10 +193,17 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
         )
     else:
         ok_gpu = jnp.ones((n_nodes,), dtype=bool)
+    if cfg.enable_storage:
+        ok_storage, vg_add, sdev_take = storage.storage_fit_and_plan(
+            state.vg_used, arrs.vg_cap, state.sdev_taken, arrs.sdev_cap,
+            arrs.sdev_ssd, x["lvm_req"], x["sdev_req"], x["sdev_req_ssd"],
+        )
+    else:
+        ok_storage = jnp.ones((n_nodes,), dtype=bool)
 
     op_masks = [ok_unsched, ok_aff, ok_taint, ok_ports]
     op_masks += [fit[:, r] for r in range(cfg.n_resources)]
-    op_masks += [ok_pod_aff, ok_pod_anti, ok_spread, ok_gpu]
+    op_masks += [ok_pod_aff, ok_pod_anti, ok_spread, ok_gpu, ok_storage]
     ops_ok = jnp.stack(op_masks)                     # [OPS, N]
 
     mask = active & jnp.all(ops_ok, axis=0)          # [N]
@@ -310,7 +328,19 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
         pick = jnp.zeros_like(state.gpu_used[0], dtype=jnp.int32)
         gpu_used = state.gpu_used
 
-    new_state = SimState(used, group_count, term_block, pref_paint, ports_used, gpu_used)
+    if cfg.enable_storage:
+        # commit the filter pass's plan for the bound node (rows of the
+        # [N, V]/[N, E] plans, scattered like every other carry column)
+        vg_used = state.vg_used + onehot_n[:, None] * vg_add[safe_node][None, :]
+        sdev_taken = state.sdev_taken | (
+            (onehot_n[:, None] > 0) & sdev_take[safe_node][None, :]
+        )
+    else:
+        vg_used = state.vg_used
+        sdev_taken = state.sdev_taken
+
+    new_state = SimState(used, group_count, term_block, pref_paint, ports_used,
+                         gpu_used, vg_used, sdev_taken)
     return new_state, (final_node, fail_counts, feasible_n, pick)
 
 
@@ -378,8 +408,12 @@ def make_config(snapshot: ClusterSnapshot, **overrides) -> EngineConfig:
                                      snapshot.n_pods]))
     else:
         max_per_node = float(snapshot.n_pods)
+    enable_storage = bool(
+        np.any(snapshot.arrays.vg_cap > 0) or np.any(snapshot.arrays.sdev_cap > 0)
+    )
     kw: Dict[str, Any] = dict(
         n_resources=len(res), cpu_mem_idx=cpu_mem, enable_gpu=enable_gpu,
+        enable_storage=enable_storage,
         compact_carry=max_per_node < 255,
     )
     kw.update(overrides)

@@ -9,22 +9,28 @@ Reference schema (pkg/utils/utils.go:458-528, pkg/type/const.go):
   pod annotation simon.tpu/pod-local-storage:
       {"volumes": [{"size": "<bytes>", "kind": "LVM|HDD|SSD", "scName": ...}]}
 
-TPU-first mapping: local storage becomes ordinary resource columns, so VG
-fit rides the same NodeResourcesFit tensor op as cpu/memory:
+TPU-first mapping, two tiers:
 
-  open-local/vg          aggregate VG capacity / LVM volume sizes (MiB)
-  open-local/device-hdd  count of free exclusive HDD devices / HDD volumes
-  open-local/device-ssd  likewise for SSD
+1. Aggregate resource columns ride the NodeResourcesFit tensor op like
+   cpu/memory (cheap first-pass mask + reports/occupancy):
 
-Granularity caveat (ROADMAP): per-VG and per-device-size packing is
-aggregated; exclusive devices are counted, not size-matched.
+     open-local/vg          aggregate VG capacity / LVM volume sizes (MiB)
+     open-local/device-hdd  count of free exclusive HDD devices / HDD volumes
+     open-local/device-ssd  likewise for SSD
+
+2. Exact per-VG / per-device ops (ops/storage.py): LVM volumes greedily
+   packed largest-first into the most-free VG; exclusive HDD/SSD claims
+   size-matched tightest-fit onto free devices. The reference parses this
+   granularity (GetPodLocalPVCs) but never enforces it at placement time
+   (the open-local scheduler extender is not vendored) — enforcing it here
+   is deliberately beyond-reference.
 """
 
 from __future__ import annotations
 
 import json
 import logging
-from typing import Dict
+from typing import Dict, List, Tuple
 
 from open_simulator_tpu.k8s.objects import (
     ANNO_NODE_LOCAL_STORAGE,
@@ -40,49 +46,116 @@ RES_VG = "open-local/vg"
 RES_DEVICE_HDD = "open-local/device-hdd"
 RES_DEVICE_SSD = "open-local/device-ssd"
 
+# open-local / yoda storage-class names (reference: pkg/utils/const.go:4-16)
+SC_LVM = {"open-local-lvm", "yoda-lvm-default"}
+SC_DEVICE_HDD = {"open-local-device-hdd", "yoda-device-hdd"}
+SC_DEVICE_SSD = {"open-local-device-ssd", "yoda-device-ssd"}
+
 _MIB = 1024 * 1024
 
 
 def node_storage_resources(node: Node) -> ResourceList:
-    raw = node.meta.annotations.get(ANNO_NODE_LOCAL_STORAGE)
-    if not raw:
-        return {}
-    try:
-        info = json.loads(raw)
-    except json.JSONDecodeError:
-        log.warning("node %s: bad local-storage annotation", node.name)
-        return {}
+    """Aggregate resource-column view, derived from the exact layout so the
+    annotation is decoded exactly once and by one rule set."""
+    vgs, devs = node_storage_layout(node)
     out: ResourceList = {}
-    vg_bytes = sum(int(vg.get("capacity", 0)) for vg in info.get("vgs") or [])
-    if vg_bytes:
-        out[RES_VG] = vg_bytes // _MIB
-    for dev in info.get("devices") or []:
-        if str(dev.get("isAllocated", "false")).lower() == "true":
-            continue
-        res = RES_DEVICE_SSD if str(dev.get("mediaType", "")).lower() == "ssd" else RES_DEVICE_HDD
+    vg_mib = sum(vgs)
+    if vg_mib:
+        out[RES_VG] = vg_mib
+    for _cap, is_ssd in devs:
+        res = RES_DEVICE_SSD if is_ssd else RES_DEVICE_HDD
         out[res] = out.get(res, 0) + 1
     return out
 
 
 def pod_storage_resources(pod: Pod) -> ResourceList:
-    raw = pod.meta.annotations.get(ANNO_POD_LOCAL_STORAGE)
-    if not raw:
-        return {}
-    try:
-        req = json.loads(raw)
-    except json.JSONDecodeError:
-        log.warning("pod %s: bad local-storage annotation", pod.key)
-        return {}
     out: ResourceList = {}
-    for vol in req.get("volumes") or []:
-        kind = str(vol.get("kind", "")).upper()
-        size = int(vol.get("size", 0))
+    for kind, size_mib in _pod_volumes(pod):
         if kind == "LVM":
-            out[RES_VG] = out.get(RES_VG, 0) + max(size // _MIB, 1)
+            out[RES_VG] = out.get(RES_VG, 0) + max(size_mib, 1)
         elif kind == "HDD":
             out[RES_DEVICE_HDD] = out.get(RES_DEVICE_HDD, 0) + 1
         elif kind == "SSD":
             out[RES_DEVICE_SSD] = out.get(RES_DEVICE_SSD, 0) + 1
-        else:
+    return out
+
+
+def _pod_volumes(pod: Pod) -> List[Tuple[str, int]]:
+    """(kind, size MiB) per volume from the pod-local-storage annotation."""
+    raw = pod.meta.annotations.get(ANNO_POD_LOCAL_STORAGE)
+    if not raw:
+        return []
+    try:
+        req = json.loads(raw)
+    except json.JSONDecodeError:
+        log.warning("pod %s: bad local-storage annotation", pod.key)
+        return []
+    out: List[Tuple[str, int]] = []
+    for vol in req.get("volumes") or []:
+        kind = str(vol.get("kind", "")).upper()
+        if kind not in ("LVM", "HDD", "SSD"):
             log.warning("pod %s: unsupported volume kind %s", pod.key, kind)
+            continue
+        out.append((kind, int(vol.get("size", 0)) // _MIB))
+    return out
+
+
+def node_storage_layout(node: Node) -> Tuple[List[int], List[Tuple[int, bool]]]:
+    """Exact layout for ops/storage.py: per-VG capacities (MiB) in
+    annotation order, and free exclusive devices as (capacity MiB, is_ssd)."""
+    raw = node.meta.annotations.get(ANNO_NODE_LOCAL_STORAGE)
+    if not raw:
+        return [], []
+    try:
+        info = json.loads(raw)
+    except json.JSONDecodeError:
+        log.warning("node %s: bad local-storage annotation", node.name)
+        return [], []
+    vgs = [int(vg.get("capacity", 0)) // _MIB for vg in info.get("vgs") or []]
+    devs: List[Tuple[int, bool]] = []
+    for dev in info.get("devices") or []:
+        if str(dev.get("isAllocated", "false")).lower() == "true":
+            continue
+        is_ssd = str(dev.get("mediaType", "")).lower() == "ssd"
+        devs.append((int(dev.get("capacity", 0)) // _MIB, is_ssd))
+    return vgs, devs
+
+
+def pod_storage_volumes(pod: Pod) -> Tuple[List[int], List[Tuple[int, bool]]]:
+    """Exact request for ops/storage.py: LVM volume sizes (MiB, descending —
+    the greedy packer's deterministic order) and exclusive-device claims as
+    (size MiB, wants_ssd), descending."""
+    lvm: List[int] = []
+    devs: List[Tuple[int, bool]] = []
+    for kind, size_mib in _pod_volumes(pod):
+        if kind == "LVM":
+            lvm.append(max(size_mib, 1))
+        else:
+            devs.append((max(size_mib, 1), kind == "SSD"))
+    lvm.sort(reverse=True)
+    devs.sort(key=lambda t: t[0], reverse=True)
+    return lvm, devs
+
+
+def volumes_from_claim_templates(templates: List[dict]) -> List[dict]:
+    """STS volumeClaimTemplates with open-local/yoda storage-class names ->
+    pod-local-storage volume dicts (the reference routes the same SC names
+    through GetPodLocalPVCs, pkg/utils/utils.go:485-528)."""
+    out: List[dict] = []
+    for t in templates or []:
+        spec = t.get("spec") or {}
+        sc = spec.get("storageClassName") or ""
+        size = str(((spec.get("resources") or {}).get("requests") or {}).get("storage", "0"))
+        from open_simulator_tpu.k8s.quantity import parse_quantity
+
+        size_bytes = int(parse_quantity(size))
+        if sc in SC_LVM:
+            kind = "LVM"
+        elif sc in SC_DEVICE_HDD:
+            kind = "HDD"
+        elif sc in SC_DEVICE_SSD:
+            kind = "SSD"
+        else:
+            continue  # not an open-local class; VolumeBinding pass-through
+        out.append({"size": str(size_bytes), "kind": kind, "scName": sc})
     return out

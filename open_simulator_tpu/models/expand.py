@@ -68,10 +68,13 @@ def expand_workload(obj: Any, app_name: str = "") -> List[k8s.Pod]:
     meta = obj.meta
     kind = obj.KIND
     if kind in ("Deployment", "ReplicaSet", "StatefulSet"):
-        return [
+        pods = [
             _pod_from_template(obj.template, f"{meta.name}-{i}", meta.namespace, kind, meta.name, extra)
             for i in range(obj.replicas)
         ]
+        if kind == "StatefulSet":
+            _merge_claim_template_storage(obj, pods)
+        return pods
     if kind == "Job":
         # completions pods, capped by nothing (parallelism limits concurrency,
         # not the total — reference creates `completions` pods, utils.go:170-190)
@@ -89,6 +92,42 @@ def expand_workload(obj: Any, app_name: str = "") -> List[k8s.Pod]:
             for i in range(n)
         ]
     raise ValueError(f"cannot expand workload kind {kind}")
+
+
+def _merge_claim_template_storage(sts: Any, pods: List[k8s.Pod]) -> None:
+    """STS volumeClaimTemplates with open-local/yoda storage classes become
+    per-pod local-storage volumes (each replica gets its own claims — the
+    reference's open_local example relies on this PVC path,
+    pkg/utils/utils.go:485-528)."""
+    import json
+
+    from open_simulator_tpu.k8s.local_storage import volumes_from_claim_templates
+    from open_simulator_tpu.k8s.objects import ANNO_POD_LOCAL_STORAGE
+
+    vols = volumes_from_claim_templates(
+        (sts.raw.get("spec") or {}).get("volumeClaimTemplates") or []
+    )
+    if not vols:
+        return
+    import logging
+
+    log = logging.getLogger("simon-tpu.expand")
+    for pod in pods:
+        existing = []
+        raw = pod.meta.annotations.get(ANNO_POD_LOCAL_STORAGE)
+        if raw:
+            try:
+                existing = json.loads(raw).get("volumes") or []
+            except json.JSONDecodeError:
+                log.warning(
+                    "pod %s/%s: bad pod-local-storage annotation on the %s "
+                    "template; its volumes are dropped, keeping the "
+                    "volumeClaimTemplates-derived ones",
+                    pod.meta.namespace, pod.meta.name, sts.KIND,
+                )
+        pod.meta.annotations[ANNO_POD_LOCAL_STORAGE] = json.dumps(
+            {"volumes": existing + vols}
+        )
 
 
 def daemonset_node_should_run(ds: k8s.DaemonSet, node: k8s.Node) -> bool:

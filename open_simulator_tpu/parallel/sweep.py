@@ -124,7 +124,7 @@ def shard_arrays(arrs, mesh: Mesh):
     heuristics would misfire when P happens to equal N).
     """
     node_first = {"alloc", "active", "is_new_node", "gpu_cap_mem", "gpu_count", "gpu_slot",
-                  "unschedulable"}
+                  "unschedulable", "vg_cap", "sdev_cap", "sdev_ssd"}
     node_second = {"topo_onehot", "has_key", "class_affinity", "class_taint",
                    "class_node_aff_score", "class_taint_prefer"}
 
@@ -187,16 +187,26 @@ def capacity_sweep(
     used = np.asarray(out.state.used)          # [S, N, R]
     alloc = np.asarray(arrs.alloc)             # [N, R]
 
-    from open_simulator_tpu.k8s.local_storage import RES_VG
-
     cpu_i = snapshot.resources.index("cpu")
     mem_i = snapshot.resources.index("memory")
-    vg_i = snapshot.resources.index(RES_VG) if RES_VG in snapshot.resources else None
+    vg_cap = np.asarray(arrs.vg_cap)           # [N, V]
+    has_storage = bool(np.any(vg_cap > 0))
+    vg_used_all = np.asarray(out.state.vg_used) if has_storage else None
 
     def occupancy(si, lane_active, ri) -> float:
         tot = float(np.sum(alloc[lane_active, ri]))
         u = float(np.sum(used[si][lane_active, ri]))
         return 100.0 * u / tot if tot else 0.0
+
+    def vg_occupancy(si, lane_active) -> float:
+        """MaxVG is enforced per volume group: the WORST VG's occupancy
+        across active nodes (the reference parses MaxVG but never checks
+        it, apply.go:614-681 — per-VG is the meaningful strictness)."""
+        cap = vg_cap[lane_active]                       # [n, V]
+        u = vg_used_all[si][lane_active]
+        with np.errstate(invalid="ignore", divide="ignore"):
+            pct = np.where(cap > 0, 100.0 * u / np.where(cap > 0, cap, 1.0), 0.0)
+        return float(pct.max()) if pct.size else 0.0
 
     all_scheduled, cpu_occ, mem_occ, satisfied = [], [], [], []
     for si in range(len(counts)):
@@ -204,7 +214,7 @@ def capacity_sweep(
         ok = bool(np.all(nodes[si] >= 0))
         c_pct = occupancy(si, lane_active, cpu_i)
         m_pct = occupancy(si, lane_active, mem_i)
-        v_pct = occupancy(si, lane_active, vg_i) if vg_i is not None else 0.0
+        v_pct = vg_occupancy(si, lane_active) if has_storage else 0.0
         sat = (
             ok
             and c_pct <= thresholds.max_cpu_pct

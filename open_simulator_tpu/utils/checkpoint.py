@@ -63,6 +63,16 @@ def load_simulation(path: str) -> Tuple[SimState, Optional[np.ndarray], dict]:
             if want != str(a.dtype):
                 a = a.astype(np.dtype(want) if want != "bfloat16" else ml_dtypes.bfloat16)
             fields[name] = a
+        # checkpoints predating newer SimState fields (e.g. the open-local
+        # vg_used/sdev_taken columns): fill empty zero columns so old files
+        # keep loading (their snapshots had no storage, so [N, 1] zeros are
+        # the exact state they would have carried)
+        n = fields["used"].shape[0] if "used" in fields else 0
+        for name in SimState._fields:
+            if name not in fields:
+                fields[name] = np.zeros(
+                    (n, 1), dtype=bool if name == "sdev_taken" else np.float32
+                )
         state = SimState(**fields)
         node_assign = z["node_assign"] if "node_assign" in z.files else None
     return state, node_assign, meta

@@ -1,0 +1,232 @@
+"""open-local exact storage: per-VG LVM packing + device size-matching.
+
+Oracle tests per op (numpy recomputation) plus the end-to-end example
+corpus (examples/openlocal-config.yaml) with hand-computed placements.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from open_simulator_tpu.ops.storage import device_match, lvm_pack
+
+GI = 1024  # MiB per Gi
+
+
+# ---------------------------------------------------------------- lvm_pack
+
+def test_lvm_pack_distinct_vgs():
+    # 90 + 40 over VGs [100, 50]: largest-first -> pool0, then pool1
+    ok, add = lvm_pack(
+        jnp.zeros((1, 2)), jnp.asarray([[100.0, 50.0]]) * GI,
+        jnp.asarray([90.0, 40.0]) * GI,
+    )
+    assert bool(ok[0])
+    np.testing.assert_allclose(np.asarray(add)[0], [90 * GI, 40 * GI])
+
+
+def test_lvm_pack_rejects_when_no_single_vg_fits():
+    # aggregate free = 20 but split 10+10: a 15 volume must NOT fit
+    ok, _ = lvm_pack(
+        jnp.asarray([[90.0, 40.0]]) * GI, jnp.asarray([[100.0, 50.0]]) * GI,
+        jnp.asarray([15.0 * GI]),
+    )
+    assert not bool(ok[0])
+
+
+def test_lvm_pack_most_free_greedy_oracle():
+    rng = np.random.RandomState(7)
+    for _ in range(100):
+        v = rng.randint(1, 5)
+        cap = rng.randint(10, 200, size=v).astype(np.float64)
+        used = (cap * rng.rand(v)).round()
+        sizes = np.sort(rng.randint(1, 120, size=rng.randint(1, 4)))[::-1].astype(np.float64)
+
+        free = cap - used
+        want_ok, want_add = True, np.zeros(v)
+        for s in sizes:  # the documented greedy: largest volume, most-free VG
+            j = int(np.argmax(free))
+            if free[j] < s:
+                want_ok = False
+            free[j] -= s
+            want_add[j] += s
+
+        ok, add = lvm_pack(jnp.asarray(used), jnp.asarray(cap), jnp.asarray(sizes))
+        assert bool(ok) == want_ok, (cap, used, sizes)
+        if want_ok:
+            np.testing.assert_allclose(np.asarray(add), want_add)
+
+
+# ------------------------------------------------------------ device_match
+
+def test_device_match_media_and_size():
+    cap = jnp.asarray([[100.0, 200.0, 50.0]]) * GI
+    ssd = jnp.asarray([[False, True, False]])
+    taken = jnp.zeros((1, 3), dtype=bool)
+    # 80Gi HDD claim: eligible {0, 2->too small}; tightest = dev 0
+    ok, take = device_match(taken, cap, ssd, jnp.asarray([80.0 * GI]), jnp.asarray([False]))
+    assert bool(ok[0])
+    np.testing.assert_array_equal(np.asarray(take)[0], [True, False, False])
+    # 80Gi SSD claim: only dev 1
+    ok2, take2 = device_match(taken, cap, ssd, jnp.asarray([80.0 * GI]), jnp.asarray([True]))
+    assert bool(ok2[0])
+    np.testing.assert_array_equal(np.asarray(take2)[0], [False, True, False])
+
+
+def test_device_match_tightest_fit_and_exhaustion():
+    cap = jnp.asarray([[100.0, 60.0]]) * GI
+    ssd = jnp.zeros((1, 2), dtype=bool)
+    taken = jnp.zeros((1, 2), dtype=bool)
+    # two 50Gi claims: first takes the 60Gi (tightest), second the 100Gi
+    ok, take = device_match(
+        taken, cap, ssd, jnp.asarray([50.0, 50.0]) * GI, jnp.asarray([False, False])
+    )
+    assert bool(ok[0]) and np.asarray(take)[0].all()
+    # three claims exhaust the node
+    ok3, _ = device_match(
+        taken, cap, ssd, jnp.asarray([50.0, 50.0, 50.0]) * GI,
+        jnp.asarray([False, False, False]),
+    )
+    assert not bool(ok3[0])
+
+
+def test_device_is_exclusive_not_shared():
+    # a taken 200Gi device cannot host a second small claim
+    cap = jnp.asarray([[200.0]]) * GI
+    ssd = jnp.zeros((1, 1), dtype=bool)
+    ok, take = device_match(
+        jnp.zeros((1, 1), dtype=bool), cap, ssd,
+        jnp.asarray([10.0, 10.0]) * GI, jnp.asarray([False, False]),
+    )
+    assert not bool(ok[0])
+
+
+# ------------------------------------------------------- end-to-end corpus
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def test_open_local_example_corpus(capsys):
+    """Hand-computed expectations for examples/openlocal-config.yaml:
+
+    cache (90+40 LVM, 150 SSD) -> store-a (only SSD node); its volumes land
+    in distinct VGs (90 in pool0/100, 40 in pool1/50, leaving 10+10).
+    db-0/db-1 (15 LVM, 80 HDD) -> store-b: store-a's 20Gi aggregate would
+    fit 15Gi but no single VG holds it — per-VG enforcement decides."""
+    from open_simulator_tpu.cli.main import main
+
+    rc = main(["apply", "-f", os.path.join(EXAMPLES, "openlocal-config.yaml")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "no new nodes needed" in out
+    lines = {l.split()[0]: l for l in out.splitlines() if l.startswith("data/")}
+    assert "store-a" in lines["data/cache"]
+    assert "store-b" in lines["data/db-0"]
+    assert "store-b" in lines["data/db-1"]
+
+
+def test_open_local_unschedulable_reason():
+    # a pod whose LVM volume exceeds every VG reports the storage op
+    from open_simulator_tpu.core import AppResource, simulate
+    from open_simulator_tpu.k8s.loader import ClusterResources
+    from open_simulator_tpu.k8s.objects import ANNO_NODE_LOCAL_STORAGE, ANNO_POD_LOCAL_STORAGE
+    from tests.conftest import make_node, make_pod
+
+    import json
+
+    node = make_node("s0", cpu_m=8000)
+    node.meta.annotations[ANNO_NODE_LOCAL_STORAGE] = json.dumps(
+        {"vgs": [{"name": "p0", "capacity": str(20 * GI * 1024 * 1024)}]}
+    )
+    pod = make_pod("big", cpu="100m")
+    pod.meta.annotations[ANNO_POD_LOCAL_STORAGE] = json.dumps(
+        {"volumes": [{"size": str(30 * GI * 1024 * 1024), "kind": "LVM", "scName": "open-local-lvm"}]}
+    )
+    cluster = ClusterResources()
+    cluster.nodes = [node]
+    app = ClusterResources()
+    app.pods = [pod]
+    res = simulate(cluster, [AppResource(name="a", resources=app)])
+    assert len(res.unscheduled_pods) == 1
+    # the aggregate VG column catches it first (30 > 20 total) — the reason
+    # names the open-local vg resource either way
+    assert "open-local" in res.unscheduled_pods[0].reason
+
+
+def test_per_vg_catches_what_aggregate_misses():
+    # two VGs of 10 each: aggregate 20 passes a 15 volume, per-VG rejects
+    from open_simulator_tpu.core import AppResource, simulate
+    from open_simulator_tpu.k8s.loader import ClusterResources
+    from open_simulator_tpu.k8s.objects import ANNO_NODE_LOCAL_STORAGE, ANNO_POD_LOCAL_STORAGE
+    from tests.conftest import make_node, make_pod
+
+    import json
+
+    byte = 1024 * 1024
+    node = make_node("s0", cpu_m=8000)
+    node.meta.annotations[ANNO_NODE_LOCAL_STORAGE] = json.dumps(
+        {"vgs": [{"name": "p0", "capacity": str(10 * GI * byte)},
+                 {"name": "p1", "capacity": str(10 * GI * byte)}]}
+    )
+    pod = make_pod("mid", cpu="100m")
+    pod.meta.annotations[ANNO_POD_LOCAL_STORAGE] = json.dumps(
+        {"volumes": [{"size": str(15 * GI * byte), "kind": "LVM", "scName": "open-local-lvm"}]}
+    )
+    cluster = ClusterResources()
+    cluster.nodes = [node]
+    app = ClusterResources()
+    app.pods = [pod]
+    res = simulate(cluster, [AppResource(name="a", resources=app)])
+    assert len(res.unscheduled_pods) == 1
+    assert "volume group" in res.unscheduled_pods[0].reason
+
+
+def test_sweep_enforces_max_vg_per_vg():
+    # one VG at 90% after placement: MaxVG=80 rejects, MaxVG=95 accepts
+    import json
+
+    from open_simulator_tpu.core import AppResource, build_pod_sequence
+    from open_simulator_tpu.encode.snapshot import encode_cluster
+    from open_simulator_tpu.engine.scheduler import make_config
+    from open_simulator_tpu.k8s.loader import ClusterResources, make_valid_node
+    from open_simulator_tpu.k8s.objects import ANNO_NODE_LOCAL_STORAGE, ANNO_POD_LOCAL_STORAGE
+    from open_simulator_tpu.parallel import SweepThresholds, capacity_sweep
+    from tests.conftest import make_node, make_pod
+
+    byte = 1024 * 1024
+    node = make_node("s0", cpu_m=8000)
+    node.meta.annotations[ANNO_NODE_LOCAL_STORAGE] = json.dumps(
+        {"vgs": [{"name": "p0", "capacity": str(10 * GI * byte)},
+                 {"name": "p1", "capacity": str(100 * GI * byte)}]}
+    )
+    pod = make_pod("v", cpu="100m")
+    pod.meta.annotations[ANNO_POD_LOCAL_STORAGE] = json.dumps(
+        {"volumes": [{"size": str(9 * GI * byte), "kind": "LVM", "scName": "open-local-lvm"}]}
+    )
+    cluster = ClusterResources()
+    cluster.nodes = [node]
+    app = ClusterResources()
+    app.pods = [pod]
+    pods = build_pod_sequence(cluster, [AppResource(name="a", resources=app)])
+    snap = encode_cluster([make_valid_node(n) for n in cluster.nodes], pods)
+    cfg = make_config(snap)
+
+    # the 9Gi volume goes to p1 (most free, 100Gi): p1 at 9%, p0 at 0% -> fine
+    plan = capacity_sweep(snap, cfg, [0], SweepThresholds(max_vg_pct=80.0))
+    assert plan.satisfied == [True]
+
+    # preload p1 via a second pod so the next lands in p0 at 90%
+    pod2 = make_pod("w", cpu="100m")
+    pod2.meta.annotations[ANNO_POD_LOCAL_STORAGE] = json.dumps(
+        {"volumes": [{"size": str(95 * GI * byte), "kind": "LVM", "scName": "open-local-lvm"}]}
+    )
+    app2 = ClusterResources()
+    app2.pods = [pod2, pod]
+    pods2 = build_pod_sequence(cluster, [AppResource(name="a", resources=app2)])
+    snap2 = encode_cluster([make_valid_node(n) for n in cluster.nodes], pods2)
+    plan_lo = capacity_sweep(snap2, make_config(snap2), [0], SweepThresholds(max_vg_pct=80.0))
+    plan_hi = capacity_sweep(snap2, make_config(snap2), [0], SweepThresholds(max_vg_pct=95.0))
+    assert plan_lo.all_scheduled == [True] and plan_lo.satisfied == [False]
+    assert plan_hi.satisfied == [True]
