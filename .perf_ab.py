@@ -1,0 +1,33 @@
+"""Scratch A/B timing harness (not part of the package): best-of-N reps,
+prints one number. Usage: python .perf_ab.py [preset] [reps]"""
+import sys, time, json
+import jax, jax.numpy as jnp
+import __graft_entry__ as ge
+from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
+from open_simulator_tpu.parallel.sweep import active_masks_for_counts
+
+preset = sys.argv[1] if len(sys.argv) > 1 else "default"
+reps = int(sys.argv[2]) if len(sys.argv) > 2 else 15
+shapes = {
+    "default": (1024, 2048, 256, 64),
+    "northstar": (5120, 51200, 64, 64),
+    "ns-small": (5120, 8192, 64, 64),
+}
+n, p, s, max_new = shapes[preset]
+snap = ge._synthetic_snapshot(n_nodes=n, n_pods=p, max_new=max_new)
+cfg = make_config(snap)._replace(fail_reasons=False)
+arrs = device_arrays(snap)
+counts = [min(i % (max_new + 1), max_new) for i in range(s)]
+masks = jnp.asarray(active_masks_for_counts(snap, counts))
+fn = jax.jit(jax.vmap(lambda a: schedule_pods(arrs, a, cfg)))
+out = fn(masks); jax.block_until_ready(out.node)
+best = 1e9
+ts = []
+for _ in range(reps):
+    t0 = time.perf_counter(); out = fn(masks); jax.block_until_ready(out.node)
+    dt = time.perf_counter() - t0
+    ts.append(dt); best = min(best, dt)
+print(json.dumps({"preset": preset, "best_ms": round(best*1e3, 2),
+                  "pods_per_s": round(p*s/best/1e6, 3),
+                  "scen_per_s": round(s/best, 1),
+                  "med_ms": round(sorted(ts)[len(ts)//2]*1e3, 2)}))

@@ -73,6 +73,7 @@ class _Fused(NamedTuple):
     class_na: jnp.ndarray
     class_tt: jnp.ndarray
     topo: jnp.ndarray       # [K1*D, Np]
+    topoT: jnp.ndarray      # [Np, K1*D] (for (LB,Np)@(Np,D) MXU matmuls)
     haskey: jnp.ndarray     # [K, Np]
     req: jnp.ndarray        # [P, R]
     ports: jnp.ndarray      # [P, Pt] f32
@@ -160,6 +161,7 @@ def prepare_fused(arrs: SnapshotArrays) -> _Fused:
         class_na=jnp.asarray(_pad_nodes(a.class_node_aff_score.astype(f32), np_pad)),
         class_tt=jnp.asarray(_pad_nodes(a.class_taint_prefer.astype(f32), np_pad)),
         topo=jnp.asarray(_pad_nodes(topo.astype(f32), np_pad)),
+        topoT=jnp.asarray(_pad_nodes(topo.astype(f32), np_pad).T.copy()),
         haskey=jnp.asarray(_pad_nodes(a.has_key.astype(f32), np_pad)),
         req=jnp.asarray(a.req.astype(f32)),
         ports=jnp.asarray(a.ports.astype(f32)),
@@ -669,5 +671,7 @@ def schedule_pods_fused(
         node=jnp.concatenate(sels, axis=1),
         fail_counts=jnp.concatenate(fails, axis=1),
         feasible=jnp.concatenate(feass, axis=1),
-        gpu_pick=jnp.zeros((L, P, g), jnp.int32), state=state,
+        # width-0 like the scan engine's gpu-disabled path (fused_eligible
+        # excludes gpu configs)
+        gpu_pick=jnp.zeros((L, P, 0), jnp.int32), state=state,
     )

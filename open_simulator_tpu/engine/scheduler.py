@@ -71,6 +71,34 @@ class EngineConfig(NamedTuple):
     # sweep turns it off for the what-if lanes and re-runs only the decoded
     # lane with reasons on (parallel/sweep.py + apply/applier.py).
     fail_reasons: bool = True
+    # Feature gates, autodetected by make_config from the snapshot: an op
+    # whose inputs are empty across the WHOLE pod sequence is compiled out
+    # of the step entirely (the gated op contributes a constant-true mask /
+    # zero score, so results are identical — pay only for what the cluster
+    # uses). Safe because every product path re-encodes the full pod
+    # sequence per scan (simulator._run, core.simulate), so a gate can
+    # never hide state a later pod in the same carry would need.
+    enable_ports: bool = True
+    enable_pod_affinity: bool = True
+    enable_anti_affinity: bool = True
+    # spread splits by whenUnsatisfiable: hard (DoNotSchedule -> filter,
+    # needs per-constraint domain-min) and soft (ScheduleAnyway -> score)
+    enable_spread_hard: bool = True
+    enable_spread_soft: bool = True
+    enable_pref: bool = True
+    enable_node_aff_score: bool = True
+    # all-zero taint-preference rows make taint_toleration_score a uniform
+    # +100 over feasible nodes — argmax-invariant, so the gate skips it
+    enable_taint_score: bool = True
+
+    @property
+    def enable_spread(self) -> bool:
+        return self.enable_spread_hard or self.enable_spread_soft
+
+    @property
+    def needs_group_count(self) -> bool:
+        return (self.enable_pod_affinity or self.enable_anti_affinity
+                or self.enable_spread or self.enable_pref)
 
     @property
     def n_ops(self) -> int:
@@ -151,65 +179,95 @@ def _pod_xs(arrs: SnapshotArrays) -> Dict[str, jnp.ndarray]:
     return xs
 
 
-def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: SimState, x):
+def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
+          hoisted, state: SimState, x):
     n_nodes = arrs.alloc.shape[0]
     f32 = jnp.float32
+    true_v = jnp.ones((n_nodes,), dtype=bool)  # identity-compared below
 
     # compact carry columns are stored bf16; compute in f32 (the casts fuse
     # into the loop body — only the halved carry bytes hit HBM per step)
-    gc = state.group_count.astype(f32)
-    tb = state.term_block.astype(f32)
+    gc = state.group_count.astype(f32) if cfg.needs_group_count else None
+    cid = x["class_id"]
 
-    cm_aff = arrs.class_affinity[x["class_id"]]      # [N]
-    cm_taint = arrs.class_taint[x["class_id"]]
-    na_row = arrs.class_node_aff_score[x["class_id"]]
-    tt_row = arrs.class_taint_prefer[x["class_id"]]
+    cm_aff = arrs.class_affinity[cid]                # [N]
+    cm_taint = arrs.class_taint[cid]
 
     # ---- filter pipeline (ordered; see filter_op_table) ---------------
     ok_unsched = ~arrs.unschedulable
     ok_aff = cm_aff
     ok_taint = cm_taint
-    ok_ports = filters.ports_free(state.ports_used, x["ports"])
+    ok_ports = (filters.ports_free(state.ports_used, x["ports"])
+                if cfg.enable_ports else true_v)
     fit = filters.fit_per_resource(state.used, arrs.alloc, x["req"])   # [N, R]
-    ok_pod_aff = filters.pod_affinity_ok(
+    ok_pod_aff = (filters.pod_affinity_ok(
         gc, arrs.topo_onehot, arrs.has_key,
         x["aff_group"], x["aff_key"], x["aff_valid"], x["aff_self"],
-    )
-    ok_pod_anti = filters.pod_anti_affinity_ok(
-        gc, tb, arrs.topo_onehot, arrs.has_key,
+    ) if cfg.enable_pod_affinity else true_v)
+    ok_pod_anti = (filters.pod_anti_affinity_ok(
+        gc, state.term_block.astype(f32), arrs.topo_onehot, arrs.has_key,
         x["anti_group"], x["anti_key"], x["anti_valid"], x["hit_terms"],
-    )
-    spread_self = x["match_groups"][x["spread_group"]] & x["spread_valid"]
-    ok_spread = filters.topology_spread_ok(
-        gc, arrs.topo_onehot, arrs.has_key,
-        active & cm_aff,
-        x["spread_group"], x["spread_key"], x["spread_skew"],
-        x["spread_hard"], x["spread_valid"], spread_self,
-    )
+    ) if cfg.enable_anti_affinity else true_v)
+
+    # PodTopologySpread: per-constraint domain counts are computed ONCE and
+    # shared between the DoNotSchedule filter (skew check, vendored
+    # filtering.go:285-340) and the ScheduleAnyway score pass 1
+    # (scoring.go:180-260); the eligibility/min side uses the hoisted
+    # loop-invariant stats instead of per-step mat-vecs.
+    spread_raw = jnp.zeros((n_nodes,), f32)
+    spread_node_ok = true_v
+    any_soft = jnp.zeros((), dtype=bool)
+    if cfg.enable_spread:
+        from open_simulator_tpu.ops.domains import domain_count, domain_min_hoisted
+
+        ok_spread = true_v
+        for c in range(x["spread_group"].shape[0]):
+            kid = x["spread_key"][c]
+            vec = gc[:, x["spread_group"][c]]
+            dc = domain_count(vec, kid, arrs.topo_onehot)
+            node_has = arrs.has_key[kid] > 0
+            if cfg.enable_spread_hard:
+                # hard constraint (DoNotSchedule) -> filter
+                min_val = domain_min_hoisted(vec, kid, cid, arrs.topo_onehot, hoisted)
+                self_m = x["match_groups"][x["spread_group"][c]] & x["spread_valid"][c]
+                skew = dc + self_m.astype(dc.dtype) - min_val
+                term_ok = node_has & (skew <= x["spread_skew"][c])
+                applies = x["spread_valid"][c] & x["spread_hard"][c]
+                ok_spread &= jnp.where(applies, term_ok, True)
+            if cfg.enable_spread_soft:
+                # soft constraint -> score pass 1 (topologyNormalizingWeight
+                # + the maxSkew-1 shift of scoreForCount, scoring.go:292)
+                soft = x["spread_valid"][c] & ~x["spread_hard"][c]
+                w = jnp.log(hoisted.dom_counts[kid] + 2.0)
+                spread_raw += jnp.where(soft, dc * w + (x["spread_skew"][c] - 1.0), 0.0)
+                spread_node_ok &= ~soft | node_has
+                any_soft |= soft
+    else:
+        ok_spread = true_v
+
     if cfg.enable_gpu:
         ok_gpu = gpu_share.gpu_fit(
             state.gpu_used, arrs.gpu_cap_mem, arrs.gpu_slot, x["gpu_mem"], x["gpu_cnt"],
             x["gpu_has_forced"],
         )
     else:
-        ok_gpu = jnp.ones((n_nodes,), dtype=bool)
+        ok_gpu = true_v
     if cfg.enable_storage:
         ok_storage, vg_add, sdev_take = storage.storage_fit_and_plan(
             state.vg_used, arrs.vg_cap, state.sdev_taken, arrs.sdev_cap,
             arrs.sdev_ssd, x["lvm_req"], x["sdev_req"], x["sdev_req_ssd"],
         )
     else:
-        ok_storage = jnp.ones((n_nodes,), dtype=bool)
+        ok_storage = true_v
 
     op_masks = [ok_unsched, ok_aff, ok_taint, ok_ports]
     op_masks += [fit[:, r] for r in range(cfg.n_resources)]
     op_masks += [ok_pod_aff, ok_pod_anti, ok_spread, ok_gpu, ok_storage]
-    ops_ok = jnp.stack(op_masks)                     # [OPS, N]
-
-    mask = active & jnp.all(ops_ok, axis=0)          # [N]
 
     # first failing op per node -> per-op failure counts (active nodes only)
     if cfg.fail_reasons:
+        ops_ok = jnp.stack(op_masks)                  # [OPS, N]
+        mask = active & jnp.all(ops_ok, axis=0)       # [N]
         fails = ~ops_ok                               # [OPS, N]
         first_fail = jnp.argmax(fails, axis=0)        # [N]
         any_fail = jnp.any(fails, axis=0)
@@ -217,33 +275,45 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
         onehot_ops = (first_fail[None, :] == jnp.arange(cfg.n_ops)[:, None])  # [OPS, N]
         fail_counts = jnp.sum(onehot_ops & charged[None, :], axis=1).astype(jnp.int32)
     else:
-        # shape [0]: no per-step ys emitted, no [P, OPS] output materialized
+        # shape [0]: no per-step ys emitted, no [P, OPS] output materialized;
+        # gated (constant-true) op rows drop out of the AND entirely
+        mask = active
+        for m in op_masks:
+            if m is not true_v:
+                mask = mask & m
         fail_counts = jnp.zeros((0,), jnp.int32)
 
     # ---- scores (feasible nodes only) ---------------------------------
     score = jnp.zeros((n_nodes,), f32)
-    score += cfg.w_balanced * scores.balanced_allocation_score(
-        state.used, arrs.alloc, x["req"], cfg.cpu_mem_idx)
-    score += cfg.w_least * scores.least_allocated_score(
-        state.used, arrs.alloc, x["req"], cfg.cpu_mem_idx)
+    if cfg.w_balanced:
+        score += cfg.w_balanced * scores.balanced_allocation_score(
+            state.used, arrs.alloc, x["req"], cfg.cpu_mem_idx)
+    if cfg.w_least:
+        score += cfg.w_least * scores.least_allocated_score(
+            state.used, arrs.alloc, x["req"], cfg.cpu_mem_idx)
     if cfg.w_most:
         score += cfg.w_most * scores.most_allocated_score(
             state.used, arrs.alloc, x["req"], cfg.cpu_mem_idx)
-    score += cfg.w_node_aff * scores.node_affinity_score(na_row, mask)
-    score += cfg.w_taint * scores.taint_toleration_score(tt_row, mask)
-    # existing pods' preferred (anti-)affinity toward this pod: one mat-vec
-    # against the weighted domain paint (interpodaffinity/scoring.go's
-    # "existing pod" direction)
-    existing_pref_raw = state.pref_paint @ x["hit_pref"].astype(f32)
-    score += cfg.w_interpod * scores.interpod_preference_score(
-        gc, arrs.topo_onehot, arrs.has_key,
-        x["pref_group"], x["pref_key"], x["pref_weight"], x["pref_valid"], mask,
-        extra_raw=existing_pref_raw)
-    score += cfg.w_spread * scores.topology_spread_score(
-        gc, arrs.topo_onehot, arrs.has_key, active,
-        x["spread_group"], x["spread_key"], x["spread_hard"],
-        x["spread_valid"], mask, spread_skew=x["spread_skew"])
-    score += cfg.w_simon * scores.simon_max_share_score(arrs.alloc, x["req"], mask)
+    if cfg.w_node_aff and cfg.enable_node_aff_score:
+        score += cfg.w_node_aff * scores.node_affinity_score(
+            arrs.class_node_aff_score[cid], mask)
+    if cfg.w_taint and cfg.enable_taint_score:
+        score += cfg.w_taint * scores.taint_toleration_score(
+            arrs.class_taint_prefer[cid], mask)
+    if cfg.w_interpod and cfg.enable_pref:
+        # existing pods' preferred (anti-)affinity toward this pod: one
+        # mat-vec against the weighted domain paint (interpodaffinity/
+        # scoring.go's "existing pod" direction)
+        existing_pref_raw = state.pref_paint @ x["hit_pref"].astype(f32)
+        score += cfg.w_interpod * scores.interpod_preference_score(
+            gc, arrs.topo_onehot, arrs.has_key,
+            x["pref_group"], x["pref_key"], x["pref_weight"], x["pref_valid"], mask,
+            extra_raw=existing_pref_raw)
+    if cfg.w_spread and cfg.enable_spread_soft:
+        score += cfg.w_spread * scores.spread_normalize(
+            spread_raw, spread_node_ok, any_soft, mask)
+    if cfg.w_simon:
+        score += cfg.w_simon * scores.simon_max_share_score(arrs.alloc, x["req"], mask)
     if cfg.enable_gpu:
         score += cfg.w_gpu * gpu_share.gpu_share_score(
             state.gpu_used, arrs.gpu_cap_mem, arrs.gpu_slot, x["gpu_mem"], x["gpu_cnt"], mask)
@@ -290,30 +360,45 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
     onehot_n = jax.nn.one_hot(final_node, n_nodes, dtype=f32)  # -1 -> zeros
     cdt = state.group_count.dtype
     used = state.used + onehot_n[:, None] * x["req"][None, :]
-    group_count = state.group_count + (
-        onehot_n[:, None] * x["match_groups"].astype(f32)[None, :]
-    ).astype(cdt)
-    ports_used = state.ports_used | ((onehot_n[:, None] > 0) & x["ports"][None, :])
+    if cfg.needs_group_count:
+        group_count = state.group_count + (
+            onehot_n[:, None] * x["match_groups"].astype(f32)[None, :]
+        ).astype(cdt)
+    else:
+        group_count = state.group_count  # untouched -> loop-invariant, no copy
+    if cfg.enable_ports:
+        ports_used = state.ports_used | ((onehot_n[:, None] > 0) & x["ports"][None, :])
+    else:
+        ports_used = state.ports_used
 
-    # anti-affinity domain paint for this pod's own terms:
-    # sd_all [K, N] = same-domain masks of the bound node under every key
-    k1 = arrs.topo_onehot.shape[0]
-    sd_list = [onehot_n]  # hostname
-    for kk in range(k1):
-        oh = arrs.topo_onehot[kk]
-        sd_list.append(oh @ oh[safe_node] * bound.astype(f32))
-    sd_all = jnp.stack(sd_list)                       # [K, N]
-    paint = sd_all[arrs.term_key].T * x["own_terms"].astype(f32)[None, :]  # [N, T]
-    term_block = state.term_block + paint.astype(cdt)  # 0/1 values, cast exact
+    # sd_all [K, N] = same-domain masks of the bound node under every key,
+    # feeding the anti-affinity term paint and the preferred-term paint
+    if cfg.enable_anti_affinity or cfg.enable_pref:
+        k1 = arrs.topo_onehot.shape[0]
+        sd_list = [onehot_n]  # hostname
+        for kk in range(k1):
+            oh = arrs.topo_onehot[kk]
+            sd_list.append(oh @ oh[safe_node] * bound.astype(f32))
+        sd_all = jnp.stack(sd_list)                   # [K, N]
 
-    # weighted paint of this pod's own preferred terms (for future pods'
-    # existing-direction score); Ap is tiny and static -> unrolled
-    t2_n = state.pref_paint.shape[1]
-    pref_paint = state.pref_paint
-    for a in range(x["pref_tid"].shape[0]):
-        col = jax.nn.one_hot(x["pref_tid"][a], t2_n, dtype=f32)        # [T2]
-        w = x["pref_weight"][a] * x["pref_valid"][a].astype(f32)
-        pref_paint = pref_paint + sd_all[x["pref_key"][a]][:, None] * col[None, :] * w
+    if cfg.enable_anti_affinity:
+        # anti-affinity domain paint for this pod's own terms
+        paint = sd_all[arrs.term_key].T * x["own_terms"].astype(f32)[None, :]  # [N, T]
+        term_block = state.term_block + paint.astype(cdt)  # 0/1 values, cast exact
+    else:
+        term_block = state.term_block
+
+    if cfg.enable_pref:
+        # weighted paint of this pod's own preferred terms (for future pods'
+        # existing-direction score); Ap is tiny and static -> unrolled
+        t2_n = state.pref_paint.shape[1]
+        pref_paint = state.pref_paint
+        for a in range(x["pref_tid"].shape[0]):
+            col = jax.nn.one_hot(x["pref_tid"][a], t2_n, dtype=f32)    # [T2]
+            w = x["pref_weight"][a] * x["pref_valid"][a].astype(f32)
+            pref_paint = pref_paint + sd_all[x["pref_key"][a]][:, None] * col[None, :] * w
+    else:
+        pref_paint = state.pref_paint
 
     if cfg.enable_gpu:
         pick = gpu_share.gpu_pick_devices(
@@ -325,7 +410,9 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig, state: S
             onehot_n[:, None] * pick.astype(f32)[None, :] * x["gpu_mem"]
         )
     else:
-        pick = jnp.zeros_like(state.gpu_used[0], dtype=jnp.int32)
+        # width-0 row: no [P, G] pick output is materialized per lane
+        # (decode reads gpu_pick only when enable_gpu)
+        pick = jnp.zeros((0,), dtype=jnp.int32)
         gpu_used = state.gpu_used
 
     if cfg.enable_storage:
@@ -368,7 +455,14 @@ def schedule_pods(
     xs["_nominated"] = (
         jnp.full(n_pods, -1, jnp.int32) if nominated is None else nominated.astype(jnp.int32)
     )
-    step = functools.partial(_step, arrs, active, cfg)
+    if cfg.enable_spread:
+        from open_simulator_tpu.ops.domains import hoist_active_stats
+
+        hoisted = hoist_active_stats(
+            arrs.topo_onehot, arrs.has_key, arrs.class_affinity, active)
+    else:
+        hoisted = None
+    step = functools.partial(_step, arrs, active, cfg, hoisted)
     final_state, (nodes, fail_counts, feasible, gpu_pick) = jax.lax.scan(
         step, state, xs, unroll=cfg.scan_unroll
     )
@@ -411,10 +505,21 @@ def make_config(snapshot: ClusterSnapshot, **overrides) -> EngineConfig:
     enable_storage = bool(
         np.any(snapshot.arrays.vg_cap > 0) or np.any(snapshot.arrays.sdev_cap > 0)
     )
+    a = snapshot.arrays
     kw: Dict[str, Any] = dict(
         n_resources=len(res), cpu_mem_idx=cpu_mem, enable_gpu=enable_gpu,
         enable_storage=enable_storage,
         compact_carry=max_per_node < 255,
+        # feature gates: compile out ops whose inputs are empty across the
+        # whole pod sequence (results identical; see EngineConfig docs)
+        enable_ports=bool(np.any(a.ports)),
+        enable_pod_affinity=bool(np.any(a.aff_valid)),
+        enable_anti_affinity=bool(np.any(a.anti_valid) or np.any(a.own_terms)),
+        enable_spread_hard=bool(np.any(a.spread_valid & a.spread_hard)),
+        enable_spread_soft=bool(np.any(a.spread_valid & ~a.spread_hard)),
+        enable_pref=bool(np.any(a.pref_valid) or np.any(a.hit_pref)),
+        enable_node_aff_score=bool(np.any(a.class_node_aff_score != 0)),
+        enable_taint_score=bool(np.any(a.class_taint_prefer != 0)),
     )
     kw.update(overrides)
     return EngineConfig(**kw)

@@ -16,6 +16,8 @@ trace-once under jit (no data-dependent control flow).
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import jax.numpy as jnp
 
 
@@ -49,6 +51,63 @@ def domain_min(count_vec: jnp.ndarray, key_id, topo_onehot: jnp.ndarray, eligibl
     any_elig = jnp.any(eligible)
     min_val = jnp.where(key_id == 0, min_host, min_other)
     return jnp.where(any_elig, min_val, jnp.float32(0.0)), any_elig
+
+
+class ActiveHoist(NamedTuple):
+    """Scan-loop-invariant domain statistics, computed once per (arrs,
+    active) pair before the pod scan instead of per step. `active` never
+    changes inside a scan, so everything derived from it — domain
+    membership of active nodes, per-class eligibility — is hoisted here
+    (the analog of the reference computing its node snapshot once per
+    scheduling cycle, vendored generic_scheduler.go:85)."""
+
+    dom_counts: jnp.ndarray   # [K] f32: #domains holding an active node, per key
+    elig_host: jnp.ndarray    # [C, N] bool: active & class-affinity (hostname elig)
+    domain_has: jnp.ndarray   # [C, K1, D] bool: domain holds an eligible node
+    any_elig: jnp.ndarray     # [C, K] bool: any eligible node exists under key
+
+
+def hoist_active_stats(
+    topo_onehot: jnp.ndarray,   # [K1, N, D]
+    has_key: jnp.ndarray,       # [K, N]
+    class_affinity: jnp.ndarray,  # [C, N] bool
+    active: jnp.ndarray,        # [N] bool
+) -> ActiveHoist:
+    f32 = jnp.float32
+    act = active.astype(f32)
+    k1 = topo_onehot.shape[0]
+    # domains-with-an-active-member per key (hostname = active node count)
+    dom_counts = [jnp.sum(act)]
+    for k in range(k1):
+        present = jnp.any((topo_onehot[k] * act[:, None]) > 0, axis=0)   # [D]
+        dom_counts.append(jnp.sum(present.astype(f32)))
+    # per-class spread eligibility: active & class node-affinity & has-key
+    elig_ck = class_affinity[:, None, :] & active[None, None, :] & (has_key[None, :, :] > 0)  # [C, K, N]
+    domain_has = jnp.stack([
+        (elig_ck[:, k + 1, :].astype(f32) @ topo_onehot[k]) > 0 for k in range(k1)
+    ], axis=1) if k1 else jnp.zeros((class_affinity.shape[0], 0, 0), bool)   # [C, K1, D]
+    return ActiveHoist(
+        dom_counts=jnp.stack(dom_counts),
+        elig_host=elig_ck[:, 0, :],
+        domain_has=domain_has,
+        any_elig=jnp.any(elig_ck, axis=2),
+    )
+
+
+def domain_min_hoisted(
+    count_vec: jnp.ndarray, key_id, class_id, topo_onehot: jnp.ndarray, h: ActiveHoist
+) -> jnp.ndarray:
+    """domain_min with the eligibility side precomputed (ActiveHoist): the
+    in-loop work is one [D, N] mat-vec + a masked min, instead of an extra
+    eligibility mat-vec per constraint per step."""
+    big = jnp.float32(3.4e38)
+    oh = _onehot_for_key(topo_onehot, key_id)
+    per_domain = oh.T @ count_vec                     # [D]
+    dhas = h.domain_has[class_id, jnp.maximum(key_id - 1, 0)]
+    min_other = jnp.min(jnp.where(dhas, per_domain, big))
+    min_host = jnp.min(jnp.where(h.elig_host[class_id], count_vec, big))
+    min_val = jnp.where(key_id == 0, min_host, min_other)
+    return jnp.where(h.any_elig[class_id, key_id], min_val, jnp.float32(0.0))
 
 
 def same_domain(node_id, key_id, topo_onehot: jnp.ndarray, n_nodes: int) -> jnp.ndarray:
