@@ -237,6 +237,19 @@ class Applier:
 
         best_idx = plan.counts.index(plan.best_count)
         result = self._result_for(snapshot, plan, best_idx, cfg)
+        # the reasons/preemption re-run can tie-break differently from the
+        # sweep lane (vmap vs single-lane reduction order); keep the summary
+        # consistent with the per-pod report below by quoting the decoded
+        # result's own count when they diverge
+        sweep_sched = int(np.sum(plan.nodes_per_scenario[best_idx] >= 0))
+        decoded_sched = len(result.scheduled_pods)
+        if decoded_sched != sweep_sched:
+            self._say(
+                f"note: decoded report schedules {decoded_sched} pod(s) vs the "
+                f"sweep lane's {sweep_sched} (the decode re-run applies "
+                f"preemption and can resolve exact ties differently from the "
+                f"batched sweep); the per-pod report below is authoritative"
+            )
         if plan.best_count > 0:
             self._say(
                 f"cluster requires {plan.best_count} new node(s) of the given spec "

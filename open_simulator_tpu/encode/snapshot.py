@@ -15,6 +15,8 @@ import json
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+import logging
+
 import chex
 import numpy as np
 
@@ -28,6 +30,8 @@ from open_simulator_tpu.k8s.selectors import (
     required_node_affinity_match,
     tolerates_taints,
 )
+
+_log = logging.getLogger(__name__)
 
 HOSTNAME_KEY = "kubernetes.io/hostname"
 
@@ -471,6 +475,21 @@ def encode_cluster(
             for tok in str(idx_anno).split("-"):
                 if tok.isdigit() and int(tok) < G:
                     gpu_forced[pi, int(tok)] += 1
+                elif tok.isdigit():
+                    # the reference logs invalid device ids too
+                    # (gpunodeinfo.go:252 "has invalid GPU ID in Annotation")
+                    _log.warning(
+                        "pod %s: gpu-index token %r outside encoded device "
+                        "range [0, %d); its memory debit is dropped — raise "
+                        "EncodeOptions.max_gpus_per_node to cover it",
+                        p.meta.name, tok, G,
+                    )
+                else:
+                    _log.warning(
+                        "pod %s: malformed gpu-index token %r (not a device "
+                        "id); its memory debit is dropped",
+                        p.meta.name, tok,
+                    )
 
     # ---- gpu node arrays ----------------------------------------------
     gpu_count = np.zeros(N, dtype=np.float32)

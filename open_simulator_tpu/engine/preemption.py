@@ -266,12 +266,24 @@ def _select_victims_on_node(
             return False
         mem, cnt = float(arrs.gpu_mem[i]), int(arrs.gpu_cnt[i])
         if mem > 0 and cnt > 0:
-            free = (arrs.gpu_cap_mem[n] - gp) * arrs.gpu_slot[n]
-            # two-pointer feasibility: one device holds floor(idle/mem) of
-            # the requested GPUs (gpu_share._slots_per_device host mirror)
-            slots = np.floor(np.clip(free + 1e-6, 0.0, None) / mem)
-            if int(np.sum(slots)) < cnt:
+            # capacity precheck + device presence apply to ALL GPU pods
+            # (gpu_fit applies them to pinned pods too — skipping them here
+            # would plan preemptions the rescan always rejects, permanently
+            # blocking the preemptor)
+            n_dev = float(np.sum(arrs.gpu_slot[n]))
+            if n_dev <= 0 or float(arrs.gpu_cap_mem[n]) * n_dev < mem:
                 return False
+            # pinned (gpu-index) preemptors bypass only the two-pointer
+            # allocation-feasibility check, mirroring gpu_fit's pinned
+            # bypass (AllocateGpuId early return) — otherwise the host
+            # model denies preemptions the rescan would admit
+            if not bool(arrs.gpu_has_forced[i]):
+                free = (arrs.gpu_cap_mem[n] - gp) * arrs.gpu_slot[n]
+                # two-pointer feasibility: one device holds floor(idle/mem)
+                # of the requested GPUs (gpu_share._slots_per_device mirror)
+                slots = np.floor(np.clip(free + 1e-6, 0.0, None) / mem)
+                if int(np.sum(slots)) < cnt:
+                    return False
         return True
 
     if not fits(base_used, base_ports, base_gpu):

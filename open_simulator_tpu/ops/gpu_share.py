@@ -20,8 +20,9 @@ Allocation parity with AllocateGpuId (gpunodeinfo.go:232-290):
     without capacity checks, gpunodeinfo.go:247-253).
 
 Filter parity (open-gpu-share.go:51-81): no-GPU pods pass; otherwise the
-node's TOTAL GPU capacity must cover mem*cnt and AllocateGpuId must
-succeed (pinned pods therefore auto-pass the second check).
+node's TOTAL GPU capacity must cover the pod's per-GPU memory (the
+reference compares against GetGpuMemoryFromPodAnnotation, NOT mem*cnt)
+and AllocateGpuId must succeed (pinned pods auto-pass that second check).
 """
 
 from __future__ import annotations
@@ -51,16 +52,27 @@ def gpu_fit(
     cnt_p: jnp.ndarray,     # scalar: device count request
     has_forced_p: jnp.ndarray = False,  # scalar bool: pre-pinned gpu-index
 ) -> jnp.ndarray:
-    """[N] bool: GPU-share Filter. Total capacity must cover mem*cnt and
-    the two-pointer allocation must succeed: sum_d floor(idle_d/mem) >= cnt
-    (for cnt == 1 this reduces to "some device has idle >= mem"). Pods
-    without a GPU request pass everywhere; pinned pods skip the
-    allocation-feasibility check like the reference's early return."""
+    """[N] bool: GPU-share Filter. The capacity precheck mirrors the
+    reference exactly: node TOTAL GPU memory >= the pod's per-GPU memory
+    (open-gpu-share.go:64-67 compares GetTotalGpuMemory against
+    GetGpuMemoryFromPodAnnotation — NOT mem*count), then the two-pointer
+    allocation must succeed: sum_d floor(idle_d/mem) >= cnt (for cnt == 1
+    this reduces to "some device has idle >= mem"). Pods without a GPU
+    request pass everywhere; pinned (gpu-index) pods skip the
+    allocation-feasibility check like AllocateGpuId's early return
+    (gpunodeinfo.go:247-253), so for them only the capacity precheck and
+    device presence apply. A pin to a device id the node does not have is
+    accepted here exactly like the reference accepts it: its cache drops
+    the unknown id with a warning (gpunodeinfo.go:129-134, "failed to find
+    the GPU ID"), so the pod holds no memory there — our debit lands on a
+    gpu_slot=0 column, which _slots_per_device ignores, giving identical
+    downstream placements."""
+    has_dev = jnp.sum(gpu_slot, axis=1) > 0
     total_cap = gpu_cap * jnp.sum(gpu_slot, axis=1)
-    cap_ok = total_cap >= mem_p * cnt_p
+    cap_ok = total_cap >= mem_p
     slots = _slots_per_device(gpu_used, gpu_cap[:, None], gpu_slot, mem_p)  # [N, G]
     alloc_ok = jnp.sum(slots, axis=1) >= cnt_p
-    ok = cap_ok & (alloc_ok | jnp.asarray(has_forced_p, dtype=bool))
+    ok = cap_ok & has_dev & (alloc_ok | jnp.asarray(has_forced_p, dtype=bool))
     return jnp.where(cnt_p > 0, ok, True)
 
 

@@ -56,3 +56,132 @@ def test_gpu_resource_form_participates_in_fit():
     res = simulate(cluster, [AppResource(name="a", resources=app)])
     assert len(res.unscheduled_pods) == 1
     assert "Insufficient alibabacloud.com/gpu-mem" in res.unscheduled_pods[0].reason
+
+
+def test_pinned_multi_gpu_filter_matches_reference_capacity_check():
+    """ADVICE r2: the Filter capacity precheck is total-node-GPU-mem >= the
+    pod's PER-GPU mem (open-gpu-share.go:64-67), not mem*count — a pinned
+    multi-GPU pod whose total request exceeds node capacity still passes the
+    reference Filter (AllocateGpuId returns the pinned id verbatim,
+    gpunodeinfo.go:247-253)."""
+    from open_simulator_tpu.core import AppResource, simulate
+    from open_simulator_tpu.k8s.loader import ClusterResources
+    from open_simulator_tpu.k8s.objects import ANNO_GPU_INDEX
+    from tests.test_gpu_share import gpu_node, gpu_pod
+
+    # node total GPU mem = 2*8 = 16 >= per-GPU mem 10, but < mem*cnt = 30
+    pinned = gpu_pod("pinned3", mem=10, count=3)
+    pinned.meta.annotations[ANNO_GPU_INDEX] = "0-0-1"
+    cluster = ClusterResources()
+    cluster.nodes = [gpu_node("g0", gpus=2, mem_per_gpu=8)]
+    app = ClusterResources()
+    app.pods = [pinned]
+    res = simulate(cluster, [AppResource(name="a", resources=app)])
+    assert not res.unscheduled_pods
+    assert res.placements()["default/pinned3"] == "g0"
+
+
+def test_unpinned_multi_gpu_still_requires_allocation_feasibility():
+    """The relaxed capacity precheck must not leak: an UNPINNED pod with the
+    same shape still fails (two-pointer allocation infeasible)."""
+    from open_simulator_tpu.core import AppResource, simulate
+    from open_simulator_tpu.k8s.loader import ClusterResources
+    from tests.test_gpu_share import gpu_node, gpu_pod
+
+    cluster = ClusterResources()
+    cluster.nodes = [gpu_node("g0", gpus=2, mem_per_gpu=8)]
+    app = ClusterResources()
+    app.pods = [gpu_pod("wants3", mem=10, count=3)]
+    res = simulate(cluster, [AppResource(name="a", resources=app)])
+    assert len(res.unscheduled_pods) == 1
+
+
+def test_preemption_host_model_honors_pinned_gpu_bypass():
+    """ADVICE r2: the victim-selection fits() must mirror gpu_fit's pinned
+    bypass — a pinned preemptor whose two-pointer allocation is infeasible
+    (but whose pinned id the scan admits) must still win its preemption."""
+    from open_simulator_tpu.core import AppResource, simulate
+    from open_simulator_tpu.k8s.loader import ClusterResources
+    from open_simulator_tpu.k8s.objects import ANNO_GPU_INDEX, PriorityClass
+    from tests.test_gpu_share import gpu_node, gpu_pod
+    from tests.conftest import make_pod
+
+    cluster = ClusterResources()
+    # 1 device x 16 GiB; cpu sized so high+low cannot coexist
+    cluster.nodes = [gpu_node("g0", gpus=1, mem_per_gpu=16)]
+    cluster.nodes[0].allocatable["cpu"] = 2000.0
+    cluster.priority_classes = [PriorityClass.from_dict({
+        "apiVersion": "scheduling.k8s.io/v1", "kind": "PriorityClass",
+        "metadata": {"name": "crit"}, "value": 1000,
+    })]
+    app1 = ClusterResources()
+    app1.pods = [make_pod("low", cpu="1500m")]
+    app2 = ClusterResources()
+    # cnt=2 x mem=10: slots = floor(16/10) = 1 < 2 -> two-pointer infeasible,
+    # but the pinned gpu-index bypasses that check in gpu_fit
+    high = gpu_pod("high", mem=10, count=2, cpu="1500m")
+    high.meta.annotations[ANNO_GPU_INDEX] = "0-0"
+    high.priority_class_name = "crit"
+    app2.pods = [high]
+    res = simulate(
+        cluster,
+        [AppResource(name="a", resources=app1), AppResource(name="b", resources=app2)],
+    )
+    assert res.placements().get("default/high") == "g0"
+    assert any(p.pod.meta.name == "low" and "preempted" in p.reason
+               for p in res.unscheduled_pods)
+
+
+def test_out_of_range_gpu_index_pin_warns(caplog):
+    """ADVICE r2: a gpu-index token >= max_gpus_per_node used to be silently
+    dropped; the encoder now logs the drop like the reference's invalid-id
+    warning (gpunodeinfo.go:252)."""
+    import logging
+
+    from open_simulator_tpu.encode.snapshot import encode_cluster
+    from open_simulator_tpu.k8s.objects import ANNO_GPU_INDEX
+    from tests.test_gpu_share import gpu_node, gpu_pod
+
+    pinned = gpu_pod("pin-high", mem=4)
+    pinned.meta.annotations[ANNO_GPU_INDEX] = "9"  # default G = 8
+    with caplog.at_level(logging.WARNING, logger="open_simulator_tpu.encode.snapshot"):
+        encode_cluster([gpu_node("g0", gpus=2, mem_per_gpu=16)], [pinned])
+    assert any("gpu-index" in r.message and "'9'" in r.message for r in caplog.records)
+
+
+def test_pinned_gpu_preemptor_not_planned_onto_gpuless_node():
+    """Review follow-up: the pinned bypass must NOT skip the capacity/device
+    precheck — otherwise the host model plans a preemption on a GPU-less
+    node that the rescan's gpu_fit always rejects, permanently blocking the
+    preemptor from the viable GPU node."""
+    from open_simulator_tpu.core import AppResource, simulate
+    from open_simulator_tpu.k8s.loader import ClusterResources
+    from open_simulator_tpu.k8s.objects import ANNO_GPU_INDEX, PriorityClass
+    from tests.test_gpu_share import gpu_node, gpu_pod
+    from tests.conftest import make_pod
+
+    cluster = ClusterResources()
+    from tests.conftest import make_node
+    # node A: no GPUs, cheap victim; node B: has the GPU but pricier victim
+    node_a = make_node("a0", cpu_m=2000)
+    node_b = gpu_node("b0", gpus=1, mem_per_gpu=16)
+    node_b.allocatable["cpu"] = 2000.0
+    cluster.nodes = [node_a, node_b]
+    cluster.priority_classes = [PriorityClass.from_dict({
+        "apiVersion": "scheduling.k8s.io/v1", "kind": "PriorityClass",
+        "metadata": {"name": "crit"}, "value": 1000,
+    })]
+    app1 = ClusterResources()
+    low_a = make_pod("low-a", cpu="1500m", node_name="a0")
+    low_b = make_pod("low-b", cpu="1500m", node_selector={"gpu": "true"})
+    app1.pods = [low_a, low_b]
+    app2 = ClusterResources()
+    high = gpu_pod("high", mem=10, count=2, cpu="1500m")
+    high.meta.annotations[ANNO_GPU_INDEX] = "0-0"
+    high.priority_class_name = "crit"
+    app2.pods = [high]
+    res = simulate(
+        cluster,
+        [AppResource(name="a", resources=app1), AppResource(name="b", resources=app2)],
+    )
+    assert res.placements().get("default/high") == "b0"
