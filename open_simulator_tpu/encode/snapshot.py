@@ -83,6 +83,10 @@ class SnapshotArrays:
 
     # node axis
     alloc: np.ndarray          # [N, R] f32
+    spec_id: np.ndarray        # [N] i32 index into spec_alloc (distinct alloc rows)
+    spec_alloc: np.ndarray     # [U, R] f32 distinct node allocatable rows; clusters
+                               # have few node specs, so per-spec static score
+                               # tables collapse O(N*R) per-step work to O(U*R)+gather
     active: np.ndarray         # [N] bool  (default activation; sweeps override)
     is_new_node: np.ndarray    # [N] bool
     topo_onehot: np.ndarray    # [K1, N, D] f32
@@ -560,8 +564,13 @@ def encode_cluster(
         Ap, np.int64(0),
     )
 
+    # distinct node specs: the Simon score depends only on (req, alloc row),
+    # so the per-step [N, R] share computation runs on [U, R] and gathers
+    spec_alloc, spec_inv = np.unique(alloc, axis=0, return_inverse=True)
     arrays = SnapshotArrays(
         alloc=alloc,
+        spec_id=spec_inv.reshape(-1).astype(np.int64),
+        spec_alloc=spec_alloc.astype(np.float32),
         active=active,
         is_new_node=is_new,
         topo_onehot=topo_onehot,

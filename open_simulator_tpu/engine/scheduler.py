@@ -56,9 +56,10 @@ class EngineConfig(NamedTuple):
     # scores (generic_scheduler.go:144-168). 0 = deterministic lowest index;
     # nonzero seeds a stateless per-pod jitter that only breaks exact ties.
     tie_break_seed: int = 0
-    # lax.scan unroll (retuned on v5e with compact_carry + fail_reasons off;
-    # the driver-captured number is the number, per-round BENCH_r*.json).
-    scan_unroll: int = 3
+    # lax.scan unroll (retuned on v5e round 4 after the dom_count-carry +
+    # spec-table + variadic-reduce restructure; 6 beat 3/8/12 at the
+    # north-star shape and ties them at the default shape).
+    scan_unroll: int = 6
     # Carry compaction: group_count/term_block hold small integer counts;
     # storing them bfloat16 (native on the VPU; integer-exact to 256) halves
     # their carry bytes. make_config disables this if any node could hold
@@ -90,6 +91,17 @@ class EngineConfig(NamedTuple):
     # all-zero taint-preference rows make taint_toleration_score a uniform
     # +100 over feasible nodes — argmax-invariant, so the gate skips it
     enable_taint_score: bool = True
+    # True when any valid spread constraint uses the hostname key (key 0):
+    # hostname domains are per-node, so the filter/score need the per-node
+    # group_count carry; non-hostname constraints read the tiny per-domain
+    # dom_count carry instead (make_config autodetects)
+    spread_hostname: bool = True
+    # trivially-true filter rows compiled out (autodetected): no node is
+    # unschedulable / every class matches every node / every taint is
+    # tolerated by every class
+    enable_unsched: bool = True
+    enable_class_aff: bool = True
+    enable_class_taint: bool = True
 
     @property
     def enable_spread(self) -> bool:
@@ -97,8 +109,14 @@ class EngineConfig(NamedTuple):
 
     @property
     def needs_group_count(self) -> bool:
+        # The [N, S] per-node count carry is needed by the pod-(anti-)
+        # affinity and preference ops, and by spread only when a hostname-
+        # key constraint exists; pure non-hostname spread runs entirely off
+        # the [K1, D, S] dom_count carry (O(D) instead of O(N) aggregation
+        # state per step).
         return (self.enable_pod_affinity or self.enable_anti_affinity
-                or self.enable_spread or self.enable_pref)
+                or self.enable_pref
+                or (self.enable_spread and self.spread_hostname))
 
     @property
     def n_ops(self) -> int:
@@ -124,6 +142,11 @@ class SimState(NamedTuple):
     gpu_used: jnp.ndarray     # [N, G] f32
     vg_used: jnp.ndarray      # [N, V] f32 open-local volume-group MiB
     sdev_taken: jnp.ndarray   # [N, E] bool exclusive devices claimed
+    # per-(key, domain) match-group counts: the same integers a column-sum
+    # of group_count through topo_onehot yields, maintained incrementally so
+    # the spread ops read an O(D)-wide table instead of doing two [N, D]
+    # mat-vec reductions per constraint per step
+    dom_count: jnp.ndarray    # [K1, D, S] f32
 
 
 class ScheduleOutput(NamedTuple):
@@ -150,6 +173,7 @@ def init_state(arrs: SnapshotArrays, cfg: "EngineConfig | None" = None) -> SimSt
     f32 = jnp.float32
     # no cfg -> f32: only make_config knows whether bf16 counts stay exact
     cdt = jnp.bfloat16 if (cfg is not None and cfg.compact_carry) else f32
+    k1, _, d = arrs.topo_onehot.shape
     return SimState(
         used=jnp.zeros((n, r), f32),
         group_count=jnp.zeros((n, s), cdt),
@@ -159,6 +183,7 @@ def init_state(arrs: SnapshotArrays, cfg: "EngineConfig | None" = None) -> SimSt
         gpu_used=jnp.zeros((n, g), f32),
         vg_used=jnp.zeros((n, arrs.vg_cap.shape[1]), f32),
         sdev_taken=jnp.zeros((n, arrs.sdev_cap.shape[1]), dtype=bool),
+        dom_count=jnp.zeros((k1, d, s), f32),
     )
 
 
@@ -180,7 +205,7 @@ def _pod_xs(arrs: SnapshotArrays) -> Dict[str, jnp.ndarray]:
 
 
 def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
-          hoisted, state: SimState, x):
+          hoisted, inv_alloc, state: SimState, x):
     n_nodes = arrs.alloc.shape[0]
     f32 = jnp.float32
     true_v = jnp.ones((n_nodes,), dtype=bool)  # identity-compared below
@@ -190,15 +215,19 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     gc = state.group_count.astype(f32) if cfg.needs_group_count else None
     cid = x["class_id"]
 
-    cm_aff = arrs.class_affinity[cid]                # [N]
-    cm_taint = arrs.class_taint[cid]
+    cm_aff = arrs.class_affinity[cid] if cfg.enable_class_aff else true_v  # [N]
+    cm_taint = arrs.class_taint[cid] if cfg.enable_class_taint else true_v
 
     # ---- filter pipeline (ordered; see filter_op_table) ---------------
-    ok_unsched = ~arrs.unschedulable
+    ok_unsched = ~arrs.unschedulable if cfg.enable_unsched else true_v
     ok_aff = cm_aff
     ok_taint = cm_taint
     ok_ports = (filters.ports_free(state.ports_used, x["ports"])
                 if cfg.enable_ports else true_v)
+    # NOTE(perf): restricting fit to the requested-resource columns
+    # (used[:, :ra] slicing) was measured ~12% SLOWER at 5120n x 64 lanes
+    # — the carry slice defeats XLA's in-place carry update and forces a
+    # copy. Full width it is; never-requested columns cost one compare.
     fit = filters.fit_per_resource(state.used, arrs.alloc, x["req"])   # [N, R]
     ok_pod_aff = (filters.pod_affinity_ok(
         gc, arrs.topo_onehot, arrs.has_key,
@@ -212,24 +241,48 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     # PodTopologySpread: per-constraint domain counts are computed ONCE and
     # shared between the DoNotSchedule filter (skew check, vendored
     # filtering.go:285-340) and the ScheduleAnyway score pass 1
-    # (scoring.go:180-260); the eligibility/min side uses the hoisted
-    # loop-invariant stats instead of per-step mat-vecs.
+    # (scoring.go:180-260). Non-hostname constraints read the tiny
+    # [K1, D, S] dom_count carry (values identical to summing group_count
+    # through topo_onehot — both accumulate the same 0/1 increments in
+    # f32); hostname constraints (per-node domains) fall back to the
+    # per-node gc, which needs_group_count keeps alive for them.
     spread_raw = jnp.zeros((n_nodes,), f32)
     spread_node_ok = true_v
     any_soft = jnp.zeros((), dtype=bool)
     if cfg.enable_spread:
-        from open_simulator_tpu.ops.domains import domain_count, domain_min_hoisted
-
+        big = jnp.float32(3.4e38)
         ok_spread = true_v
+        k1_static = arrs.topo_onehot.shape[0]
         for c in range(x["spread_group"].shape[0]):
             kid = x["spread_key"][c]
-            vec = gc[:, x["spread_group"][c]]
-            dc = domain_count(vec, kid, arrs.topo_onehot)
+            g = x["spread_group"][c]
+            k1i = jnp.maximum(kid - 1, 0)
+            if k1_static == 1:  # single non-hostname key: no dynamic gather
+                dcol = state.dom_count[0, :, g]        # [D]
+                oh = arrs.topo_onehot[0]               # [N, D]
+            else:
+                dcol = state.dom_count[k1i, :, g]
+                oh = arrs.topo_onehot[k1i]
+            dc_nonhost = oh @ dcol                     # broadcast, no N-reduction
+            if gc is not None:
+                dc = jnp.where(kid == 0, gc[:, g], dc_nonhost)
+            else:
+                dc = dc_nonhost  # spread_hostname gate: no hostname terms
             node_has = arrs.has_key[kid] > 0
             if cfg.enable_spread_hard:
-                # hard constraint (DoNotSchedule) -> filter
-                min_val = domain_min_hoisted(vec, kid, cid, arrs.topo_onehot, hoisted)
-                self_m = x["match_groups"][x["spread_group"][c]] & x["spread_valid"][c]
+                # hard constraint (DoNotSchedule) -> filter; minMatchNum
+                # over domains holding an eligible node (filtering.go)
+                dhas = (hoisted.domain_has[cid, 0] if k1_static == 1
+                        else hoisted.domain_has[cid, k1i])   # [D]
+                min_other = jnp.min(jnp.where(dhas, dcol, big))
+                if gc is not None:
+                    min_host = jnp.min(
+                        jnp.where(hoisted.elig_host[cid], gc[:, g], big))
+                    min_val = jnp.where(kid == 0, min_host, min_other)
+                else:
+                    min_val = min_other
+                min_val = jnp.where(hoisted.any_elig[cid, kid], min_val, 0.0)
+                self_m = x["match_groups"][g] & x["spread_valid"][c]
                 skew = dc + self_m.astype(dc.dtype) - min_val
                 term_ok = node_has & (skew <= x["spread_skew"][c])
                 applies = x["spread_valid"][c] & x["spread_hard"][c]
@@ -238,7 +291,7 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
                 # soft constraint -> score pass 1 (topologyNormalizingWeight
                 # + the maxSkew-1 shift of scoreForCount, scoring.go:292)
                 soft = x["spread_valid"][c] & ~x["spread_hard"][c]
-                w = jnp.log(hoisted.dom_counts[kid] + 2.0)
+                w = hoisted.log_dom[kid]
                 spread_raw += jnp.where(soft, dc * w + (x["spread_skew"][c] - 1.0), 0.0)
                 spread_node_ok &= ~soft | node_has
                 any_soft |= soft
@@ -284,39 +337,87 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         fail_counts = jnp.zeros((0,), jnp.int32)
 
     # ---- scores (feasible nodes only) ---------------------------------
-    score = jnp.zeros((n_nodes,), f32)
-    if cfg.w_balanced:
-        score += cfg.w_balanced * scores.balanced_allocation_score(
-            state.used, arrs.alloc, x["req"], cfg.cpu_mem_idx)
-    if cfg.w_least:
-        score += cfg.w_least * scores.least_allocated_score(
-            state.used, arrs.alloc, x["req"], cfg.cpu_mem_idx)
-    if cfg.w_most:
-        score += cfg.w_most * scores.most_allocated_score(
-            state.used, arrs.alloc, x["req"], cfg.cpu_mem_idx)
+    # Every normalizer's min/max — and the any-feasible probe — ride ONE
+    # stacked min-reduction (maxes via negation). Per-op reductions each
+    # cost a kernel launch; at 50k scan steps the launches dominate the
+    # step, so Q rows x one reduce beats Q reduces. Values are identical
+    # to the standalone minmax_normalize/max_normalize/spread_normalize.
+    big = jnp.float32(3.4e38)
+    score = scores.resource_scores_fused(
+        state.used, arrs.alloc, inv_alloc, x["req"], cfg.cpu_mem_idx,
+        cfg.w_balanced, cfg.w_least, cfg.w_most)
+
+    # row 0: any-feasible probe (min == 0 iff some node is feasible).
+    # Rides the variadic min so selectHost can use plain jnp.argmax — a
+    # custom (max, index) tuple-reduce was measured 2.4x slower than XLA's
+    # optimized argmax lowering (generic comparator path, see ROADMAP).
+    red_rows = [jnp.where(mask, 0.0, big)]
+
+    def add_row(vec):
+        red_rows.append(vec)
+        return len(red_rows) - 1
+
     if cfg.w_node_aff and cfg.enable_node_aff_score:
-        score += cfg.w_node_aff * scores.node_affinity_score(
-            arrs.class_node_aff_score[cid], mask)
+        raw_na = arrs.class_node_aff_score[cid]
+        i_na = add_row(jnp.where(mask, -raw_na, 0.0))    # -max(where(m, raw, 0))
     if cfg.w_taint and cfg.enable_taint_score:
-        score += cfg.w_taint * scores.taint_toleration_score(
-            arrs.class_taint_prefer[cid], mask)
+        raw_tt = arrs.class_taint_prefer[cid]
+        i_tt = add_row(jnp.where(mask, -raw_tt, 0.0))
     if cfg.w_interpod and cfg.enable_pref:
         # existing pods' preferred (anti-)affinity toward this pod: one
         # mat-vec against the weighted domain paint (interpodaffinity/
         # scoring.go's "existing pod" direction)
         existing_pref_raw = state.pref_paint @ x["hit_pref"].astype(f32)
-        score += cfg.w_interpod * scores.interpod_preference_score(
+        raw_ip = scores.interpod_preference_raw(
             gc, arrs.topo_onehot, arrs.has_key,
-            x["pref_group"], x["pref_key"], x["pref_weight"], x["pref_valid"], mask,
+            x["pref_group"], x["pref_key"], x["pref_weight"], x["pref_valid"],
             extra_raw=existing_pref_raw)
+        i_ip_lo = add_row(jnp.where(mask, raw_ip, big))
+        i_ip_hi = add_row(jnp.where(mask, -raw_ip, big))
     if cfg.w_spread and cfg.enable_spread_soft:
-        score += cfg.w_spread * scores.spread_normalize(
-            spread_raw, spread_node_ok, any_soft, mask)
+        sp_scored = mask & spread_node_ok
+        i_sp_lo = add_row(jnp.where(sp_scored, spread_raw, big))
+        i_sp_hi = add_row(jnp.where(sp_scored, -spread_raw, big))
     if cfg.w_simon:
-        score += cfg.w_simon * scores.simon_max_share_score(arrs.alloc, x["req"], mask)
+        # static-alloc score: compute the share table per distinct node
+        # spec ([U, R], U = few) and gather — identical floats to the
+        # per-node form, minus ~R*8 full-width ops per step
+        raw_si = scores.simon_max_share_raw(arrs.spec_alloc, x["req"])[arrs.spec_id]
+        i_si_lo = add_row(jnp.where(mask, raw_si, big))
+        i_si_hi = add_row(jnp.where(mask, -raw_si, big))
     if cfg.enable_gpu:
-        score += cfg.w_gpu * gpu_share.gpu_share_score(
-            state.gpu_used, arrs.gpu_cap_mem, arrs.gpu_slot, x["gpu_mem"], x["gpu_cnt"], mask)
+        raw_gp = gpu_share.gpu_share_raw(
+            state.gpu_used, arrs.gpu_cap_mem, arrs.gpu_slot, x["gpu_mem"], x["gpu_cnt"])
+        i_gp_lo = add_row(jnp.where(mask, raw_gp, big))
+        i_gp_hi = add_row(jnp.where(mask, -raw_gp, big))
+
+    # variadic reduce: one fused pass, no stacked [Q, N] materialization (a
+    # jnp.stack would write ~Q*N floats to HBM per step just to read them
+    # back in the reduce)
+    reds = jax.lax.reduce(
+        tuple(red_rows), tuple(jnp.float32(big) for _ in red_rows),
+        lambda a, b: tuple(jnp.minimum(x, y) for x, y in zip(a, b)),
+        (0,),
+    )
+    any_feasible = reds[0] < big
+
+    if cfg.w_node_aff and cfg.enable_node_aff_score:
+        score += cfg.w_node_aff * scores.max_apply(raw_na, -reds[i_na])
+    if cfg.w_taint and cfg.enable_taint_score:
+        score += cfg.w_taint * scores.max_apply(raw_tt, -reds[i_tt], reverse=True)
+    if cfg.w_interpod and cfg.enable_pref:
+        score += cfg.w_interpod * scores.minmax_apply(
+            raw_ip, reds[i_ip_lo], -reds[i_ip_hi])
+    if cfg.w_spread and cfg.enable_spread_soft:
+        score += cfg.w_spread * scores.spread_apply(
+            spread_raw, reds[i_sp_lo], -reds[i_sp_hi], spread_node_ok, any_soft)
+    if cfg.w_simon:
+        score += cfg.w_simon * scores.minmax_apply(
+            raw_si, reds[i_si_lo], -reds[i_si_hi])
+    if cfg.enable_gpu:
+        # cnt==0 pods score 0 on the GPU dimension (scalar factor)
+        score += (cfg.w_gpu * (x["gpu_cnt"] > 0)) * scores.minmax_apply(
+            raw_gp, reds[i_gp_lo], -reds[i_gp_hi])
 
     # Preemption retry: a nominated node (status.nominatedNodeName analog,
     # defaultpreemption PostFilter) restricts the pick to that node while it
@@ -324,7 +425,10 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     # full feasible set like the vendored retry does.
     nom = x["_nominated"]
     nom_row = jax.nn.one_hot(nom, n_nodes, dtype=bool)  # -1 -> all-zero row
-    use_nom = (nom >= 0) & jnp.any(mask & nom_row)
+    # "nominated node still feasible" is a scalar gather, not an N-reduce;
+    # the explicit range check keeps out-of-range nominations falling back
+    # to the full feasible set (a clamped gather would read mask[n-1])
+    use_nom = (nom >= 0) & (nom < n_nodes) & mask[jnp.clip(nom, 0, n_nodes - 1)]
     mask = jnp.where(use_nom, mask & nom_row, mask)
 
     neg_inf = jnp.float32(-3.4e38)
@@ -335,8 +439,13 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         jitter = jax.random.uniform(key, (n_nodes,), minval=0.0, maxval=0.5)
         score = jnp.round(score) + jitter
     sel_node = jnp.argmax(jnp.where(mask, score, neg_inf)).astype(jnp.int32)
-    feasible_n = jnp.sum(mask.astype(jnp.int32))
-    any_feasible = feasible_n > 0
+    if cfg.fail_reasons:
+        feasible_n = jnp.sum(mask.astype(jnp.int32))
+    else:
+        # like fail_counts: the diagnostic count is not materialized on the
+        # sweep path (nothing consumes it there); the output contract keeps
+        # the [P] shape via zeros in schedule_pods
+        feasible_n = jnp.zeros((), jnp.int32)
 
     forced = x["forced_node"]
     do_schedule = forced == -1
@@ -366,6 +475,16 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         ).astype(cdt)
     else:
         group_count = state.group_count  # untouched -> loop-invariant, no copy
+    if cfg.enable_spread:
+        # per-domain mirror of the group_count increment: the bound node's
+        # [K1, D] domain rows (a gather, not a reduction) outer the match
+        # vector — K1*D*S adds on a table that stays tiny
+        dom_row = arrs.topo_onehot[:, safe_node, :] * bound.astype(f32)  # [K1, D]
+        dom_count = state.dom_count + (
+            dom_row[:, :, None] * x["match_groups"].astype(f32)[None, None, :]
+        )
+    else:
+        dom_count = state.dom_count
     if cfg.enable_ports:
         ports_used = state.ports_used | ((onehot_n[:, None] > 0) & x["ports"][None, :])
     else:
@@ -427,7 +546,7 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         sdev_taken = state.sdev_taken
 
     new_state = SimState(used, group_count, term_block, pref_paint, ports_used,
-                         gpu_used, vg_used, sdev_taken)
+                         gpu_used, vg_used, sdev_taken, dom_count)
     return new_state, (final_node, fail_counts, feasible_n, pick)
 
 
@@ -462,7 +581,10 @@ def schedule_pods(
             arrs.topo_onehot, arrs.has_key, arrs.class_affinity, active)
     else:
         hoisted = None
-    step = functools.partial(_step, arrs, active, cfg, hoisted)
+    # loop-invariant reciprocal: the per-step resource-score divides become
+    # multiplies (inv = 0 encodes the cap<=0 -> fraction 0 convention)
+    inv_alloc = jnp.where(arrs.alloc > 0, 1.0 / jnp.where(arrs.alloc > 0, arrs.alloc, 1.0), 0.0)
+    step = functools.partial(_step, arrs, active, cfg, hoisted, inv_alloc)
     final_state, (nodes, fail_counts, feasible, gpu_pick) = jax.lax.scan(
         step, state, xs, unroll=cfg.scan_unroll
     )
@@ -517,9 +639,13 @@ def make_config(snapshot: ClusterSnapshot, **overrides) -> EngineConfig:
         enable_anti_affinity=bool(np.any(a.anti_valid) or np.any(a.own_terms)),
         enable_spread_hard=bool(np.any(a.spread_valid & a.spread_hard)),
         enable_spread_soft=bool(np.any(a.spread_valid & ~a.spread_hard)),
+        spread_hostname=bool(np.any(a.spread_valid & (a.spread_key == 0))),
         enable_pref=bool(np.any(a.pref_valid) or np.any(a.hit_pref)),
         enable_node_aff_score=bool(np.any(a.class_node_aff_score != 0)),
         enable_taint_score=bool(np.any(a.class_taint_prefer != 0)),
+        enable_unsched=bool(np.any(a.unschedulable)),
+        enable_class_aff=bool(not np.all(a.class_affinity)),
+        enable_class_taint=bool(not np.all(a.class_taint)),
     )
     kw.update(overrides)
     return EngineConfig(**kw)

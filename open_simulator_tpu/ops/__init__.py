@@ -16,13 +16,17 @@ Plugin -> op map (reference file in parens):
   NodeResourcesFit             -> fit_per_resource (filters.py; noderesources/fit.go)
   InterPodAffinity             -> pod_affinity_ok / pod_anti_affinity_ok
                                   (filters.py; interpodaffinity/filtering.go)
-  PodTopologySpread            -> topology_spread_ok (filters.py;
-                                  podtopologyspread/filtering.go)
-  NodeResourcesBalancedAlloc   -> balanced_allocation_score (scores.py)
-  NodeResourcesFit(LeastAlloc) -> least_allocated_score (scores.py)
-  InterPodAffinity score       -> interpod_preference_score (scores.py)
-  PodTopologySpread score      -> topology_spread_score (scores.py)
-  Simon max-share              -> simon_max_share_score (scores.py; plugin/simon.go:45-68)
+  PodTopologySpread            -> inline filter pass in engine/scheduler._step
+                                  over the dom_count carry (domains.py
+                                  primitives; podtopologyspread/filtering.go)
+  NodeResourcesBalancedAlloc   -> resource_scores_fused / balanced_allocation_score
+  NodeResourcesFit(LeastAlloc) -> resource_scores_fused / least_allocated_score
+  InterPodAffinity score       -> interpod_preference_raw + minmax (scores.py)
+  PodTopologySpread score      -> inline pass 1 in _step + spread_apply
+                                  (scores.py; oracle-tested end to end in
+                                  tests/test_engine_spread_oracle.py)
+  Simon max-share              -> simon_max_share_raw/_score (scores.py;
+                                  plugin/simon.go:45-68)
   Open-Gpu-Share               -> gpu_share.py (plugin/open-gpu-share.go)
 """
 

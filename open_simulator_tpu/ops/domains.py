@@ -62,6 +62,8 @@ class ActiveHoist(NamedTuple):
     scheduling cycle, vendored generic_scheduler.go:85)."""
 
     dom_counts: jnp.ndarray   # [K] f32: #domains holding an active node, per key
+    log_dom: jnp.ndarray      # [K] f32: log(dom_counts + 2) — the spread
+                              # topologyNormalizingWeight, hoisted
     elig_host: jnp.ndarray    # [C, N] bool: active & class-affinity (hostname elig)
     domain_has: jnp.ndarray   # [C, K1, D] bool: domain holds an eligible node
     any_elig: jnp.ndarray     # [C, K] bool: any eligible node exists under key
@@ -86,8 +88,10 @@ def hoist_active_stats(
     domain_has = jnp.stack([
         (elig_ck[:, k + 1, :].astype(f32) @ topo_onehot[k]) > 0 for k in range(k1)
     ], axis=1) if k1 else jnp.zeros((class_affinity.shape[0], 0, 0), bool)   # [C, K1, D]
+    stacked = jnp.stack(dom_counts)
     return ActiveHoist(
-        dom_counts=jnp.stack(dom_counts),
+        dom_counts=stacked,
+        log_dom=jnp.log(stacked + 2.0),
         elig_host=elig_ck[:, 0, :],
         domain_has=domain_has,
         any_elig=jnp.any(elig_ck, axis=2),

@@ -87,16 +87,28 @@ def gpu_share_score(
     """Score mirrors the plugin's max-share formula on the GPU dimension
     (open-gpu-share.go:85-110): prefer nodes where the request consumes a
     larger share of remaining GPU memory (defragmentation bias)."""
-    free_total = jnp.sum(jnp.where(gpu_slot > 0, gpu_cap[:, None] - gpu_used, 0.0), axis=1)
-    want = mem_p * cnt_p
-    avail = free_total - want
-    share = jnp.where(avail > 0, want / jnp.where(avail > 0, avail, 1.0), jnp.where(want > 0, 1.0, 0.0))
-    raw = jnp.clip(share, 0.0, 1.0) * 100.0
+    raw = gpu_share_raw(gpu_used, gpu_cap, gpu_slot, mem_p, cnt_p)
     lo = jnp.min(jnp.where(feasible, raw, _BIG))
     hi = jnp.max(jnp.where(feasible, raw, -_BIG))
     rng = hi - lo
     out = jnp.where(rng > 0, (raw - lo) * 100.0 / jnp.where(rng > 0, rng, 1.0), 0.0)
     return jnp.where(cnt_p > 0, jnp.where(feasible, out, 0.0), 0.0)
+
+
+def gpu_share_raw(
+    gpu_used: jnp.ndarray,
+    gpu_cap: jnp.ndarray,
+    gpu_slot: jnp.ndarray,
+    mem_p: jnp.ndarray,
+    cnt_p: jnp.ndarray,
+) -> jnp.ndarray:
+    """Pre-normalize raw of gpu_share_score (the engine folds the min/max
+    into its single stacked per-step reduction)."""
+    free_total = jnp.sum(jnp.where(gpu_slot > 0, gpu_cap[:, None] - gpu_used, 0.0), axis=1)
+    want = mem_p * cnt_p
+    avail = free_total - want
+    share = jnp.where(avail > 0, want / jnp.where(avail > 0, avail, 1.0), jnp.where(want > 0, 1.0, 0.0))
+    return jnp.clip(share, 0.0, 1.0) * 100.0
 
 
 def gpu_pick_devices(

@@ -82,13 +82,48 @@ def balanced_allocation_score(
     return (1.0 - std) * MAX_SCORE
 
 
-def simon_max_share_score(alloc: jnp.ndarray, req_p: jnp.ndarray, feasible: jnp.ndarray) -> jnp.ndarray:
-    """Simon plugin Score (plugin/simon.go:45-68): bin-packing preference.
-    raw = max over resources of share(req_r, alloc_r - req_r), where
-    share(a, t) = a/t, with 0/0 = 0 and a/0 = 1 (pkg/algo/greed.go Share).
-    Note the reference reads *static* node allocatable (the fake apiserver
-    never decrements it), so this score is deliberately usage-independent.
-    Min-max normalized like the plugin's NormalizeScore."""
+def resource_scores_fused(
+    used: jnp.ndarray,        # [N, R]
+    alloc: jnp.ndarray,       # [N, R]
+    inv_alloc: jnp.ndarray,   # [N, R] = 1/alloc where alloc > 0 else 0
+    req_p: jnp.ndarray,       # [R]
+    cpu_mem_idx,
+    w_balanced: float,
+    w_least: float,
+    w_most: float,
+) -> jnp.ndarray:
+    """Balanced + Least(+Most)Allocated in one pass over shared request
+    fractions — the scan engine's hot-path form of the three functions
+    above. The per-step divides become multiplies by the loop-invariant
+    inv_alloc, and the 2-point std collapses to |a-b|/2 (algebraically
+    identical; float rounding differs at the ulp level, which only
+    reorders ties that were already rounding-level)."""
+    ci, mi = cpu_mem_idx
+    want_c = used[:, ci] + req_p[ci]
+    want_m = used[:, mi] + req_p[mi]
+    a_c = want_c * inv_alloc[:, ci]
+    a_m = want_m * inv_alloc[:, mi]
+    out = jnp.zeros(used.shape[:1], dtype=jnp.float32)
+    if w_balanced:
+        out = out + w_balanced * ((1.0 - jnp.abs(a_c - a_m) * 0.5) * MAX_SCORE)
+    if w_least:
+        free_c = jnp.maximum(alloc[:, ci] - want_c, 0.0) * inv_alloc[:, ci]
+        free_m = jnp.maximum(alloc[:, mi] - want_m, 0.0) * inv_alloc[:, mi]
+        out = out + w_least * ((free_c + free_m) * (MAX_SCORE / 2.0))
+    if w_most:
+        out = out + w_most * (
+            (jnp.clip(a_c, 0.0, 1.0) + jnp.clip(a_m, 0.0, 1.0)) * (MAX_SCORE / 2.0)
+        )
+    return out
+
+
+def simon_max_share_raw(alloc: jnp.ndarray, req_p: jnp.ndarray) -> jnp.ndarray:
+    """Simon plugin raw Score (plugin/simon.go:45-68): bin-packing
+    preference. raw = max over resources of share(req_r, alloc_r - req_r),
+    where share(a, t) = a/t, with 0/0 = 0 and a/0 = 1 (pkg/algo/greed.go
+    Share). Note the reference reads *static* node allocatable (the fake
+    apiserver never decrements it), so this score is deliberately
+    usage-independent."""
     avail = alloc - req_p[None, :]
     requested = jnp.broadcast_to(req_p[None, :], alloc.shape)
     share = jnp.where(
@@ -97,8 +132,50 @@ def simon_max_share_score(alloc: jnp.ndarray, req_p: jnp.ndarray, feasible: jnp.
         jnp.where(requested != 0, 1.0, 0.0),
     )
     share = jnp.where(requested > 0, jnp.clip(share, 0.0, 1.0), 0.0)
-    raw = jnp.max(share, axis=1) * MAX_SCORE
-    return minmax_normalize(raw, feasible)
+    return jnp.max(share, axis=1) * MAX_SCORE
+
+
+def simon_max_share_score(alloc: jnp.ndarray, req_p: jnp.ndarray, feasible: jnp.ndarray) -> jnp.ndarray:
+    """simon_max_share_raw + the plugin's min-max NormalizeScore."""
+    return minmax_normalize(simon_max_share_raw(alloc, req_p), feasible)
+
+
+# ---- "from-reduced" normalizers ---------------------------------------
+# The scan engine computes every normalizer's min/max in ONE variadic
+# reduction per step; these helpers apply the normalize formulas given the
+# already-reduced lo/hi scalars. Two deliberate hot-path transforms vs the
+# standalone functions (both argmax-preserving):
+#   * wide divide -> scalar reciprocal + wide multiply (x*100/rng and
+#     x*(100/rng) differ at the ulp level; equal raws still map to equal
+#     scores, so exact ties are preserved);
+#   * no feasibility masking — infeasible nodes get whatever the formula
+#     yields (finite), and selectHost masks them to -inf before the argmax,
+#     so their score values are never observable.
+
+
+def minmax_apply(raw: jnp.ndarray, lo, hi) -> jnp.ndarray:
+    rng = hi - lo
+    inv = jnp.where(rng > 0, MAX_SCORE / jnp.where(rng > 0, rng, 1.0), 0.0)
+    return (raw - lo) * inv
+
+
+def max_apply(raw: jnp.ndarray, hi, reverse: bool = False) -> jnp.ndarray:
+    inv = jnp.where(hi > 0, MAX_SCORE / jnp.where(hi > 0, hi, 1.0), 0.0)
+    return MAX_SCORE - raw * inv if reverse else raw * inv
+
+
+def spread_apply(raw: jnp.ndarray, s_min, s_max, node_ok: jnp.ndarray,
+                 any_soft: jnp.ndarray) -> jnp.ndarray:
+    """score = 100*(max+min-raw)/max when max>0 else 100, but as one wide
+    FMA: base + (c1 - raw)*inv with scalar (base, c1, inv); nodes missing a
+    constraint key score 0 (the only wide select kept), and any_soft folds
+    into the scalars."""
+    pos = s_max > 0
+    soft = any_soft.astype(jnp.float32)
+    inv = jnp.where(pos, 100.0 / jnp.maximum(s_max, 1e-9), 0.0) * soft
+    base = jnp.where(pos, 0.0, 100.0) * soft
+    c1 = s_max + s_min
+    return jnp.where(node_ok, base + (c1 - raw) * inv, 0.0)
 
 
 def node_affinity_score(class_na_row: jnp.ndarray, feasible: jnp.ndarray) -> jnp.ndarray:
@@ -130,6 +207,23 @@ def interpod_preference_score(
     weight x (#matching pods in the node's domain); `extra_raw` carries the
     existing-pods direction (their weighted preferred-term domain paint
     matched against this pod). Min-max normalized over the sum."""
+    raw = interpod_preference_raw(
+        group_count, topo_onehot, has_key, pref_group, pref_key, pref_weight,
+        pref_valid, extra_raw)
+    return minmax_normalize(raw, feasible)
+
+
+def interpod_preference_raw(
+    group_count: jnp.ndarray,
+    topo_onehot: jnp.ndarray,
+    has_key: jnp.ndarray,
+    pref_group: jnp.ndarray,
+    pref_key: jnp.ndarray,
+    pref_weight: jnp.ndarray,
+    pref_valid: jnp.ndarray,
+    extra_raw: jnp.ndarray = None,
+) -> jnp.ndarray:
+    """Pass 1 of interpod_preference_score (pre-normalize raw sums)."""
     n = group_count.shape[0]
     raw = jnp.zeros((n,), dtype=jnp.float32) if extra_raw is None else extra_raw
     for a in range(pref_group.shape[0]):
@@ -137,7 +231,7 @@ def interpod_preference_score(
         dc = domain_count(vec, pref_key[a], topo_onehot)
         contrib = pref_weight[a] * dc * (has_key[pref_key[a]] > 0)
         raw = raw + jnp.where(pref_valid[a], contrib, 0.0)
-    return minmax_normalize(raw, feasible)
+    return raw
 
 
 def spread_normalize(
@@ -160,56 +254,8 @@ def spread_normalize(
     return jnp.where(any_soft, score, 0.0)
 
 
-def topology_spread_score(
-    group_count: jnp.ndarray,
-    topo_onehot: jnp.ndarray,
-    has_key: jnp.ndarray,
-    active: jnp.ndarray,
-    spread_group: jnp.ndarray,
-    spread_key: jnp.ndarray,
-    spread_hard: jnp.ndarray,
-    spread_valid: jnp.ndarray,
-    feasible: jnp.ndarray,
-    spread_skew: jnp.ndarray = None,
-) -> jnp.ndarray:
-    """PodTopologySpread score, the vendored two-pass shape
-    (podtopologyspread/scoring.go:180-260):
-
-    1. raw(node) = Σ_c matching-pods-in-node's-domain × log(#domains_c + 2)
-       + (maxSkew_c − 1) over the pod's *soft* (ScheduleAnyway) constraints
-       only — the topologyNormalizingWeight keeps a 3-zone spread comparable
-       to a 100-host spread, and the maxSkew−1 shift (scoreForCount,
-       scoring.go:292) waters down domain differences at higher tolerances
-       (the shift matters because pass 2 is not shift-invariant);
-    2. NormalizeScore: 100 × (max + min − raw) / max over feasible nodes
-       (fewer matching pods ⇒ higher score).
-    """
-    n = group_count.shape[0]
-    act = active.astype(jnp.float32)
-    # domains per key under the active node set: hostname = active count,
-    # other keys = number of domain columns with an active member
-    dom_counts = [jnp.sum(act)]
-    for kk in range(topo_onehot.shape[0]):
-        present = jnp.any((topo_onehot[kk] * act[:, None]) > 0, axis=0)   # [D]
-        dom_counts.append(jnp.sum(present.astype(jnp.float32)))
-    dom_counts = jnp.stack(dom_counts)                                    # [K]
-
-    raw = jnp.zeros((n,), dtype=jnp.float32)
-    any_valid = jnp.zeros((), dtype=bool)
-    node_ok = jnp.ones((n,), dtype=bool)  # vendored IgnoredNodes: a node
-    for c in range(spread_group.shape[0]):  # missing any key scores 0
-        soft = spread_valid[c] & ~spread_hard[c]
-        vec = group_count[:, spread_group[c]]
-        dc = domain_count(vec, spread_key[c], topo_onehot)
-        w = jnp.log(dom_counts[spread_key[c]] + 2.0)
-        shift = 0.0 if spread_skew is None else spread_skew[c] - 1.0
-        raw = raw + jnp.where(soft, dc * w + shift, 0.0)
-        node_ok &= ~soft | (has_key[spread_key[c]] > 0)
-        any_valid |= soft
-    big = jnp.float32(3.4e38)
-    scored = feasible & node_ok
-    s_max = jnp.max(jnp.where(scored, raw, -big))
-    s_min = jnp.min(jnp.where(scored, raw, big))
-    score = jnp.where(s_max > 0, 100.0 * (s_max + s_min - raw) / jnp.maximum(s_max, 1e-9), 100.0)
-    score = jnp.where(scored, score, 0.0)
-    return jnp.where(any_valid, score, 0.0)
+# NOTE: the standalone topology_spread_score op was removed with the fused
+# kernel: the scan engine inlines spread pass 1 (sharing per-constraint
+# domain counts with the DoNotSchedule filter via the dom_count carry) and
+# calls spread_normalize for pass 2. The inline path is oracle-tested at
+# the engine level in tests/test_engine_spread_oracle.py.

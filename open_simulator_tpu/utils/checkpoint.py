@@ -69,10 +69,39 @@ def load_simulation(path: str) -> Tuple[SimState, Optional[np.ndarray], dict]:
         # the exact state they would have carried)
         n = fields["used"].shape[0] if "used" in fields else 0
         for name in SimState._fields:
-            if name not in fields:
+            if name in fields:
+                continue
+            if name == "dom_count":
+                # [K1, D, S] per-domain counts; a pre-round-4 checkpoint
+                # carried only the per-node group_count. Resuming such a
+                # file needs the snapshot's topology to rebuild the exact
+                # table (dom_count[k,d,s] = sum_n topo_onehot[k,n,d] *
+                # group_count[n,s]) — resume_state() below does that; here
+                # fill a [1, 1, S]-shaped zero so shape-free consumers
+                # (reports, plain loads) keep working.
+                s_cols = fields.get("group_count", np.zeros((n, 1))).shape[1]
+                fields[name] = np.zeros((1, 1, s_cols), dtype=np.float32)
+            else:
                 fields[name] = np.zeros(
                     (n, 1), dtype=bool if name == "sdev_taken" else np.float32
                 )
         state = SimState(**fields)
         node_assign = z["node_assign"] if "node_assign" in z.files else None
     return state, node_assign, meta
+
+
+def resume_state(state: SimState, arrs) -> SimState:
+    """Make a loaded state resumable against its snapshot arrays: rebuild
+    any back-compat-filled dom_count from the per-node group_count
+    (dom_count[k,d,s] = sum_n topo_onehot[k,n,d] * group_count[n,s] — the
+    same 0/1 increments summed in a different order, so integer-exact).
+    Call before passing a loaded state back into schedule_pods."""
+    k1, _, d = arrs.topo_onehot.shape
+    s = np.asarray(state.group_count).shape[1]
+    dom = np.asarray(state.dom_count)
+    if dom.shape == (k1, d, s):
+        return state
+    gc = np.asarray(state.group_count).astype(np.float32)
+    topo = np.asarray(arrs.topo_onehot)
+    rebuilt = np.einsum("knd,ns->kds", topo, gc).astype(np.float32)
+    return state._replace(dom_count=rebuilt)

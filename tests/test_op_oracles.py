@@ -145,105 +145,109 @@ def test_minmax_and_max_normalize_edges():
     np.testing.assert_allclose(out2[:2], [100.0, 100.0])  # no taints anywhere -> all max
 
 
-@pytest.mark.parametrize("seed", range(3))
-def test_topology_spread_score_oracle(seed):
-    # vendored two-pass: raw = sum_c domain-count * log(#domains_c + 2) over
-    # soft constraints; normalize 100*(max+min-raw)/max over feasible nodes
+# (The standalone topology_spread_score op and its oracles moved: the scan
+# engine inlines spread pass 1; the live inline path is oracle-tested end to
+# end in tests/test_engine_spread_oracle.py.)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_hoist_active_stats_oracle(seed):
+    """ActiveHoist vs a direct numpy recount: domains-with-an-active-member
+    per key, per-class eligibility, and the hoisted log weights."""
+    from open_simulator_tpu.ops.domains import hoist_active_stats
+
     rng = np.random.RandomState(seed)
-    n, d, s = 11, 3, 4
+    n, d, c = 13, 4, 3
     onehot, ids = random_topology(rng, n, d)
-    group_count = rng.randint(0, 5, size=(n, s)).astype(np.float32)
     has_key = np.ones((2, n), dtype=np.float32)
-    active = np.ones(n, dtype=bool)
-    feasible = rng.rand(n) > 0.2
-    if not feasible.any():
-        feasible[0] = True
-    spread_group = np.array([rng.randint(0, s), rng.randint(0, s)], dtype=np.int32)
-    spread_key = np.array([0, 1], dtype=np.int32)      # hostname + zone
-    spread_hard = np.array([False, False])
-    spread_valid = np.array([True, True])
+    has_key[1] = (ids >= 0).astype(np.float32)
+    class_aff = rng.rand(c, n) > 0.3
+    active = rng.rand(n) > 0.25
 
-    got = np.asarray(scores.topology_spread_score(
-        jnp.asarray(group_count), jnp.asarray(onehot), jnp.asarray(has_key),
-        jnp.asarray(active), jnp.asarray(spread_group), jnp.asarray(spread_key),
-        jnp.asarray(spread_hard), jnp.asarray(spread_valid), jnp.asarray(feasible),
-    ))
+    h = hoist_active_stats(
+        jnp.asarray(onehot), jnp.asarray(has_key), jnp.asarray(class_aff),
+        jnp.asarray(active))
 
-    # numpy oracle
-    n_domains = [float(n), float(len({v for v in ids if v >= 0}))]
-    raw = np.zeros(n)
-    for c in range(2):
-        vec = group_count[:, spread_group[c]]
-        if spread_key[c] == 0:
-            dc = vec
-        else:
-            per_dom = onehot[0].T @ vec
-            dc = onehot[0] @ per_dom
-        raw += dc * np.log(n_domains[spread_key[c]] + 2.0)
-    mx = raw[feasible].max()
-    mn = raw[feasible].min()
-    want = 100.0 * (mx + mn - raw) / max(mx, 1e-9) if mx > 0 else np.full(n, 100.0)
-    want = np.where(feasible, want, 0.0)
-    np.testing.assert_allclose(got, want, rtol=2e-4)
+    want_dom = [float(active.sum()),
+                float(len({ids[i] for i in range(n) if active[i] and ids[i] >= 0}))]
+    np.testing.assert_allclose(np.asarray(h.dom_counts), want_dom)
+    np.testing.assert_allclose(np.asarray(h.log_dom), np.log(np.array(want_dom) + 2.0))
+
+    elig = class_aff & active[None, :] & (has_key[None, 1] > 0)  # key 1
+    for ci in range(c):
+        want_has = np.zeros(d, dtype=bool)
+        for i in range(n):
+            if elig[ci, i] and ids[i] >= 0:
+                want_has[ids[i]] = True
+        np.testing.assert_array_equal(np.asarray(h.domain_has)[ci, 0], want_has)
+        # hostname eligibility ignores has_key (every node is its own domain)
+        np.testing.assert_array_equal(
+            np.asarray(h.elig_host)[ci], class_aff[ci] & active)
+    np.testing.assert_array_equal(
+        np.asarray(h.any_elig)[:, 0], (class_aff & active[None, :]).any(axis=1))
+    np.testing.assert_array_equal(np.asarray(h.any_elig)[:, 1], elig.any(axis=1))
 
 
-def test_topology_spread_score_hard_constraints_excluded():
-    # DoNotSchedule constraints do not contribute to the score (vendored
-    # PreScore filters to ScheduleAnyway)
-    n, d, s = 5, 2, 1
-    onehot = np.zeros((1, n, d), dtype=np.float32)
-    group_count = np.arange(n, dtype=np.float32).reshape(n, 1)
-    got = np.asarray(scores.topology_spread_score(
-        jnp.asarray(group_count), jnp.asarray(onehot),
-        jnp.ones((2, n), dtype=np.float32), jnp.ones(n, dtype=bool),
-        jnp.array([0], dtype=np.int32), jnp.array([0], dtype=np.int32),
-        jnp.array([True]), jnp.array([True]), jnp.ones(n, dtype=bool),
-    ))
-    np.testing.assert_allclose(got, np.zeros(n))
+@pytest.mark.parametrize("seed", range(4))
+def test_domain_min_hoisted_oracle(seed):
+    """domain_min_hoisted vs a recount of the vendored minMatchNum: min of
+    per-domain totals over domains holding an eligible node."""
+    from open_simulator_tpu.ops.domains import domain_min_hoisted, hoist_active_stats
 
-
-def test_topology_spread_score_ignores_nodes_missing_key():
-    # vendored IgnoredNodes: a node without the constraint's topology key
-    # scores 0, not best
-    n, d = 4, 2
-    onehot = np.zeros((1, n, d), dtype=np.float32)
-    onehot[0, 0, 0] = onehot[0, 1, 0] = onehot[0, 2, 1] = 1.0  # node 3 lacks key
+    rng = np.random.RandomState(seed + 100)
+    n, d = 11, 3
+    onehot, ids = random_topology(rng, n, d)
     has_key = np.ones((2, n), dtype=np.float32)
-    has_key[1, 3] = 0.0
-    group_count = np.array([[2.0], [2.0], [1.0], [0.0]])
-    got = np.asarray(scores.topology_spread_score(
-        jnp.asarray(group_count), jnp.asarray(onehot), jnp.asarray(has_key),
-        jnp.ones(n, dtype=bool),
-        jnp.array([0], dtype=np.int32), jnp.array([1], dtype=np.int32),
-        jnp.array([False]), jnp.array([True]), jnp.ones(n, dtype=bool),
-    ))
-    assert got[3] == 0.0
-    assert got[2] > got[0] == got[1] > 0.0
+    class_aff = (rng.rand(1, n) > 0.3)
+    active = rng.rand(n) > 0.2
+    counts = rng.randint(0, 6, size=n).astype(np.float32)
+
+    h = hoist_active_stats(
+        jnp.asarray(onehot), jnp.asarray(has_key), jnp.asarray(class_aff),
+        jnp.asarray(active))
+    got = float(domain_min_hoisted(
+        jnp.asarray(counts), 1, 0, jnp.asarray(onehot), h))
+
+    elig = class_aff[0] & active
+    elig_domains = {ids[i] for i in range(n) if elig[i] and ids[i] >= 0}
+    if elig.any():
+        if elig_domains:
+            want = min(
+                sum(counts[j] for j in range(n) if ids[j] == dom)
+                for dom in elig_domains
+            )
+            assert got == want
+    else:
+        assert got == 0.0
+    # hostname: min over eligible nodes' own counts
+    got_h = float(domain_min_hoisted(jnp.asarray(counts), 0, 0, jnp.asarray(onehot), h))
+    if elig.any():
+        assert got_h == counts[elig].min()
+    else:
+        assert got_h == 0.0
 
 
-def test_topology_spread_score_max_skew_shift():
-    # scoreForCount adds maxSkew-1 to raw before the normalize pass
-    # (podtopologyspread/scoring.go:292); the (max+min-raw)/max pass is not
-    # shift-invariant, so maxSkew > 1 must change the normalized scores.
-    n, d = 4, 2
-    onehot = np.zeros((1, n, d), dtype=np.float32)
-    onehot[0, 0, 0] = onehot[0, 1, 0] = onehot[0, 2, 1] = onehot[0, 3, 1] = 1.0
-    group_count = np.array([[3.0], [3.0], [1.0], [1.0]], dtype=np.float32)
-
-    def run(skew):
-        return np.asarray(scores.topology_spread_score(
-            jnp.asarray(group_count), jnp.asarray(onehot),
-            jnp.ones((2, n), dtype=np.float32), jnp.ones(n, dtype=bool),
-            jnp.array([0], dtype=np.int32), jnp.array([1], dtype=np.int32),
-            jnp.array([False]), jnp.array([True]), jnp.ones(n, dtype=bool),
-            spread_skew=jnp.array([skew], dtype=np.float32),
-        ))
-
-    # numpy oracle: dc = per-domain matching totals, w = log(#domains + 2)
-    w = np.log(2 + 2.0)
-    for skew in (1.0, 5.0):
-        raw = np.array([6.0, 6.0, 2.0, 2.0]) * w + (skew - 1.0)
-        mx, mn = raw.max(), raw.min()
-        want = 100.0 * (mx + mn - raw) / mx
-        np.testing.assert_allclose(run(skew), want, rtol=2e-4)
-    assert run(5.0)[0] > run(1.0)[0]  # the shift waters down the spread penalty
+@pytest.mark.parametrize("seed", range(4))
+def test_resource_scores_fused_matches_component_ops(seed):
+    """The scan engine's fused Balanced+Least+Most must match the three
+    component score ops (which are themselves oracle-tested above)."""
+    rng = np.random.RandomState(seed)
+    n, r = 12, 4
+    alloc = rng.randint(1, 100, size=(n, r)).astype(np.float32)
+    alloc[0, 0] = 0.0  # cap<=0 -> fraction 0 convention
+    used = (alloc * rng.rand(n, r)).astype(np.float32)
+    req = rng.randint(0, 30, size=r).astype(np.float32)
+    inv = np.where(alloc > 0, 1.0 / np.where(alloc > 0, alloc, 1.0), 0.0)
+    for wb, wl, wm in [(1.0, 1.0, 0.0), (1.0, 0.0, 2.0), (0.5, 1.5, 1.0)]:
+        got = np.asarray(scores.resource_scores_fused(
+            jnp.asarray(used), jnp.asarray(alloc), jnp.asarray(inv),
+            jnp.asarray(req), (0, 1), wb, wl, wm))
+        want = (
+            wb * np.asarray(scores.balanced_allocation_score(
+                jnp.asarray(used), jnp.asarray(alloc), jnp.asarray(req), (0, 1)))
+            + wl * np.asarray(scores.least_allocated_score(
+                jnp.asarray(used), jnp.asarray(alloc), jnp.asarray(req), (0, 1)))
+            + wm * np.asarray(scores.most_allocated_score(
+                jnp.asarray(used), jnp.asarray(alloc), jnp.asarray(req), (0, 1)))
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)

@@ -60,3 +60,85 @@ def test_sweep_on_device_mesh_matches_single_device():
     plan_single = capacity_sweep(snap, cfg, counts=counts)
     assert plan_mesh.best_count == plan_single.best_count
     np.testing.assert_array_equal(plan_mesh.nodes_per_scenario, plan_single.nodes_per_scenario)
+
+
+def test_node_axis_sharding_bit_equal_across_meshes():
+    """VERDICT r3: the node-axis sharding claim had no equality test. The
+    same snapshot swept on mesh shapes 1x1, 4x2, and 2x4 (scenario x node)
+    must produce bit-identical picks and fail counts — GSPMD resharding of
+    the node-state arrays cannot be allowed to change a single argmax."""
+    from open_simulator_tpu.engine.scheduler import device_arrays
+    from open_simulator_tpu.parallel.sweep import (
+        active_masks_for_counts,
+        batched_schedule,
+        shard_arrays,
+    )
+    import jax.numpy as jnp
+
+    snap = _snapshot(n_pods=16, max_new=7)  # 8 total nodes: divisible by 2 and 4
+    cfg = make_config(snap)
+    counts = [0, 2, 4, 7] * 2               # 8 lanes
+    masks = jnp.asarray(active_masks_for_counts(snap, counts))
+
+    results = []
+    for n_scen, n_node in [(1, 1), (4, 2), (2, 4)]:
+        mesh = make_mesh(n_scenario=n_scen, n_node=n_node)
+        arrs = shard_arrays(device_arrays(snap), mesh)
+        out = batched_schedule(arrs, masks, cfg, mesh=mesh)
+        results.append((np.asarray(out.node), np.asarray(out.fail_counts),
+                        np.asarray(out.state.used)))
+    base = results[0]
+    for got in results[1:]:
+        np.testing.assert_array_equal(got[0], base[0])
+        np.testing.assert_array_equal(got[1], base[1])
+        np.testing.assert_allclose(got[2], base[2], rtol=0, atol=0)
+
+
+def test_node_axis_sharding_with_spread_constraints():
+    """Node-sharded lanes with zone spread: the dom_count carry and hoisted
+    domain stats must survive node-axis partitioning bit-for-bit."""
+    from open_simulator_tpu.engine.scheduler import device_arrays
+    from open_simulator_tpu.parallel.sweep import (
+        active_masks_for_counts,
+        batched_schedule,
+        shard_arrays,
+    )
+    import jax.numpy as jnp
+
+    cluster = ClusterResources()
+    cluster.nodes = [
+        make_node(f"real-{i}", cpu_m=4000, mem_mib=8192,
+                  labels={"topology.kubernetes.io/zone": f"z{i % 2}"})
+        for i in range(4)
+    ]
+    app = ClusterResources()
+    spread = [{
+        "maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+        "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"app": "a0"}},
+    }]
+    app.pods = [
+        make_pod(f"p{i}", cpu="900m", mem="256Mi", labels={"app": "a0"},
+                 spread=spread)
+        for i in range(10)
+    ]
+    pods = build_pod_sequence(cluster, [AppResource(name="a", resources=app)])
+    template = make_node("template", cpu_m=4000, mem_mib=8192,
+                         labels={"topology.kubernetes.io/zone": "z0"})
+    snap = encode_cluster(
+        [make_valid_node(n) for n in cluster.nodes], pods,
+        EncodeOptions(max_new_nodes=4, new_node_template=template),
+    )
+    cfg = make_config(snap)
+    assert cfg.enable_spread_hard
+    counts = [0, 1, 2, 4]
+    masks = jnp.asarray(active_masks_for_counts(snap, counts))
+
+    results = []
+    for n_scen, n_node in [(1, 1), (4, 2), (2, 4)]:
+        mesh = make_mesh(n_scenario=n_scen, n_node=n_node)
+        arrs = shard_arrays(device_arrays(snap), mesh)
+        out = batched_schedule(arrs, masks, cfg, mesh=mesh)
+        results.append(np.asarray(out.node))
+    np.testing.assert_array_equal(results[1], results[0])
+    np.testing.assert_array_equal(results[2], results[0])
