@@ -24,11 +24,12 @@ import sys
 import time
 
 
-def build(n_nodes: int, n_pods: int, max_new: int):
+def build(n_nodes: int, n_pods: int, max_new: int, rich: bool = False):
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import __graft_entry__ as ge
 
-    return ge._synthetic_snapshot(n_nodes=n_nodes, n_pods=n_pods, max_new=max_new)
+    return ge._synthetic_snapshot(
+        n_nodes=n_nodes, n_pods=n_pods, max_new=max_new, rich=rich)
 
 
 def run_batched(snapshot, n_scenarios: int, fail_reasons: bool = False):
@@ -60,7 +61,7 @@ def run_batched(snapshot, n_scenarios: int, fail_reasons: bool = False):
     return best
 
 
-def cpu_baseline_rate(n_nodes: int) -> float:
+def cpu_baseline_rate(n_nodes: int, rich: bool = False) -> float:
     """Single-scenario pods/sec on XLA:CPU (subprocess; own jax init)."""
     code = f"""
 import json, time, os, sys
@@ -69,7 +70,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 import __graft_entry__ as ge
 from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
-snap = ge._synthetic_snapshot(n_nodes={n_nodes}, n_pods=512, max_new=0)
+snap = ge._synthetic_snapshot(n_nodes={n_nodes}, n_pods=512, max_new=0, rich={rich})
 cfg = make_config(snap)
 arrs = device_arrays(snap)
 out = schedule_pods(arrs, arrs.active, cfg); jax.block_until_ready(out.node)
@@ -94,13 +95,25 @@ print(json.dumps({{"rate": 512 / dt}}))
 
 # BASELINE.md config presets (the reference publishes no numbers; these are
 # the shapes the repo tracks round over round).
+#
+# Workload honesty (VERDICT r3): `rich=True` presets use the all-ops-on
+# synthetic workload (ports, required pod-affinity, anti-affinity, hard +
+# hostname spread, preferred affinities, taints/selectors) so every
+# make_config feature gate stays ON — a gate can never hide a regression in
+# the tracked number. `gated` keeps the old easy workload to show the
+# gating win separately. `northstar` also keeps the easy workload so its
+# scenarios/s/chip stays directly comparable to the rounds 1-3 series
+# (BENCH_r0*.json / VERDICT r3's 65/s); `northstar-rich` is the all-ops-on
+# variant of the same shape.
 PRESETS = {
     "demo": dict(nodes=10, pods=128, scenarios=8, max_new=8),          # config 1 analog
     "fit1k": dict(nodes=1024, pods=10240, scenarios=64, max_new=64),   # config 2
-    "affinity1k": dict(nodes=1024, pods=10240, scenarios=64, max_new=64),  # config 3 (synthetic pods carry spread constraints already)
+    "affinity1k": dict(nodes=1024, pods=10240, scenarios=64, max_new=64, rich=True),  # config 3
     "sweep": dict(nodes=1024, pods=2048, scenarios=512, max_new=512),  # config 4
     "northstar": dict(nodes=5120, pods=51200, scenarios=64, max_new=64),  # BASELINE.md north star shape (single chip)
-    "default": dict(nodes=1024, pods=2048, scenarios=256, max_new=64),
+    "northstar-rich": dict(nodes=5120, pods=51200, scenarios=64, max_new=64, rich=True),
+    "gated": dict(nodes=1024, pods=2048, scenarios=256, max_new=64),
+    "default": dict(nodes=1024, pods=2048, scenarios=256, max_new=64, rich=True),
 }
 
 
@@ -122,17 +135,19 @@ def main():
     for k in ("nodes", "pods", "scenarios", "max_new"):
         if getattr(args, k) is None:
             setattr(args, k, preset[k])
+    rich = preset.get("rich", False)
 
-    snapshot = build(args.nodes, args.pods, args.max_new)
+    snapshot = build(args.nodes, args.pods, args.max_new, rich=rich)
     dt = run_batched(snapshot, args.scenarios, fail_reasons=args.fail_reasons)
     pods_per_sec = args.pods * args.scenarios / dt
     scenarios_per_sec = args.scenarios / dt
 
-    base_rate = 0.0 if args.skip_baseline else cpu_baseline_rate(args.nodes)
+    base_rate = 0.0 if args.skip_baseline else cpu_baseline_rate(args.nodes, rich=rich)
     vs = pods_per_sec / base_rate if base_rate > 0 else 0.0
 
-    print(json.dumps({
-        "metric": f"pods_scheduled_per_sec@{args.nodes}n_x{args.pods}p_x{args.scenarios}s",
+    out = {
+        "metric": f"pods_scheduled_per_sec@{args.nodes}n_x{args.pods}p_x{args.scenarios}s"
+                  + ("_allops" if rich else ""),
         "value": round(pods_per_sec, 1),
         "unit": "pods/s",
         "vs_baseline": round(vs, 2),
@@ -143,7 +158,17 @@ def main():
         "baseline": "xla_cpu_single_lane_same_engine",
         "scenarios_per_sec": round(scenarios_per_sec, 2),
         "preset": args.preset,
-    }))
+    }
+    if args.preset == "default":
+        # the driver runs bench.py bare: record the BASELINE.md north-star
+        # number (scenarios/s/chip at 5120n x 51200p, rounds-1..3-comparable
+        # workload) in the same JSON line every round
+        ns = PRESETS["northstar"]
+        ns_snap = build(ns["nodes"], ns["pods"], ns["max_new"])
+        ns_dt = run_batched(ns_snap, ns["scenarios"], fail_reasons=args.fail_reasons)
+        out["northstar_scenarios_per_sec_per_chip"] = round(ns["scenarios"] / ns_dt, 1)
+        out["northstar_shape"] = f"{ns['nodes']}n_x{ns['pods']}p_x{ns['scenarios']}s"
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -341,7 +341,7 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     # stacked min-reduction (maxes via negation). Per-op reductions each
     # cost a kernel launch; at 50k scan steps the launches dominate the
     # step, so Q rows x one reduce beats Q reduces. Values are identical
-    # to the standalone minmax_normalize/max_normalize/spread_normalize.
+    # to the standalone minmax_normalize/max_normalize formulas.
     big = jnp.float32(3.4e38)
     score = scores.resource_scores_fused(
         state.used, arrs.alloc, inv_alloc, x["req"], cfg.cpu_mem_idx,

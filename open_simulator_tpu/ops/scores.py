@@ -234,28 +234,9 @@ def interpod_preference_raw(
     return raw
 
 
-def spread_normalize(
-    raw: jnp.ndarray,        # [N] pass-1 weighted match counts (soft terms)
-    node_ok: jnp.ndarray,    # [N] bool: node has every soft constraint's key
-    any_soft: jnp.ndarray,   # [] bool: pod has >=1 soft constraint
-    feasible: jnp.ndarray,
-) -> jnp.ndarray:
-    """Pass 2 of the vendored PodTopologySpread score
-    (podtopologyspread/scoring.go NormalizeScore):
-    100 x (max + min - raw) / max over feasible nodes. Split out so the
-    scan engine can share pass-1's per-constraint domain counts with the
-    spread *filter* instead of recomputing them."""
-    big = jnp.float32(3.4e38)
-    scored = feasible & node_ok
-    s_max = jnp.max(jnp.where(scored, raw, -big))
-    s_min = jnp.min(jnp.where(scored, raw, big))
-    score = jnp.where(s_max > 0, 100.0 * (s_max + s_min - raw) / jnp.maximum(s_max, 1e-9), 100.0)
-    score = jnp.where(scored, score, 0.0)
-    return jnp.where(any_soft, score, 0.0)
-
-
-# NOTE: the standalone topology_spread_score op was removed with the fused
-# kernel: the scan engine inlines spread pass 1 (sharing per-constraint
-# domain counts with the DoNotSchedule filter via the dom_count carry) and
-# calls spread_normalize for pass 2. The inline path is oracle-tested at
-# the engine level in tests/test_engine_spread_oracle.py.
+# NOTE: the standalone topology_spread_score / spread_normalize ops were
+# removed with the fused kernel: the scan engine inlines spread pass 1
+# (sharing per-constraint domain counts with the DoNotSchedule filter via
+# the dom_count carry) and applies pass 2 via spread_apply below. The
+# inline path is oracle-tested at the engine level in
+# tests/test_engine_spread_oracle.py.

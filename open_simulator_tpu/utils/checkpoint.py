@@ -76,11 +76,12 @@ def load_simulation(path: str) -> Tuple[SimState, Optional[np.ndarray], dict]:
                 # carried only the per-node group_count. Resuming such a
                 # file needs the snapshot's topology to rebuild the exact
                 # table (dom_count[k,d,s] = sum_n topo_onehot[k,n,d] *
-                # group_count[n,s]) — resume_state() below does that; here
-                # fill a [1, 1, S]-shaped zero so shape-free consumers
-                # (reports, plain loads) keep working.
+                # group_count[n,s]) — resume_state() below does that. The
+                # fill uses the impossible sentinel shape (0, 0, S) so the
+                # rebuild can never be skipped by colliding with a real
+                # (k1=1, d=1) snapshot shape.
                 s_cols = fields.get("group_count", np.zeros((n, 1))).shape[1]
-                fields[name] = np.zeros((1, 1, s_cols), dtype=np.float32)
+                fields[name] = np.zeros((0, 0, s_cols), dtype=np.float32)
             else:
                 fields[name] = np.zeros(
                     (n, 1), dtype=bool if name == "sdev_taken" else np.float32
