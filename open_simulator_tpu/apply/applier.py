@@ -207,10 +207,15 @@ class Applier:
         self._pdbs = list(cluster.pdbs) + [p for a in apps for p in a.resources.pdbs]
         pods = build_pod_sequence(cluster, apps, use_greed=self.opts.use_greed)
         max_new = self.opts.max_new_nodes if template is not None else 0
+        from open_simulator_tpu.core import with_volume_objects
+
         snapshot = encode_cluster(
             cluster.nodes,
             pods,
-            EncodeOptions(max_new_nodes=max_new, new_node_template=template),
+            with_volume_objects(
+                EncodeOptions(max_new_nodes=max_new, new_node_template=template),
+                cluster, apps,
+            ),
         )
         overrides = {}
         if self.opts.default_scheduler_config:
@@ -307,6 +312,7 @@ class Applier:
                 elapsed_s=time.perf_counter() - t0,
                 gpu_pick=np.asarray(out.gpu_pick) if cfg.enable_gpu else None,
                 preempted_by=pre.preempted_by,
+                vol_pick=np.asarray(out.vol_pick) if cfg.enable_pv_match else None,
             )
         if lane_has_unscheduled and cfg is not None:
             # The sweep lanes run with fail_reasons off (EngineConfig); the
@@ -327,6 +333,7 @@ class Applier:
                 np.asarray(out.fail_counts),
                 masks[idx],
                 gpu_pick=np.asarray(out.gpu_pick) if cfg.enable_gpu else None,
+                vol_pick=np.asarray(out.vol_pick) if cfg.enable_pv_match else None,
             )
         return decode_result(
             snapshot,
@@ -334,6 +341,7 @@ class Applier:
             plan.fail_counts[idx],
             masks[idx],
             gpu_pick=plan.gpu_pick[idx] if plan.gpu_pick is not None else None,
+            vol_pick=plan.vol_pick[idx] if plan.vol_pick is not None else None,
         )
 
     def _device_arrays_for(self, snapshot):

@@ -57,6 +57,9 @@ class SimulateResult:
     node_status: List[NodeStatus]
     elapsed_s: float = 0.0
     snapshot: Optional[ClusterSnapshot] = None
+    # WaitForFirstConsumer claim -> PV name chosen at bind (the PreBind
+    # PVC.spec.volumeName write the reference's binder would do)
+    volume_bindings: Dict[str, str] = field(default_factory=dict)
 
     def placements(self) -> Dict[str, str]:
         return {sp.pod.key: sp.node_name for sp in self.scheduled_pods}
@@ -81,15 +84,24 @@ def decode_result(
     elapsed_s: float = 0.0,
     gpu_pick: Optional[np.ndarray] = None,
     preempted_by: Optional[Dict[int, int]] = None,
+    vol_pick: Optional[np.ndarray] = None,
 ) -> SimulateResult:
     n_active = int(np.sum(active))
     scheduled: List[ScheduledPod] = []
     unscheduled: List[UnscheduledPod] = []
     pods_by_node: Dict[int, List[Pod]] = {}
+    volume_bindings: Dict[str, str] = {}
     forced = snapshot.arrays.forced_node
     for i, pod in enumerate(snapshot.pods):
         ni = int(node_assign[i])
         if ni >= 0:
+            if vol_pick is not None and i < len(snapshot.wfc_claim_keys):
+                # claim -> PV binding the engine's Reserve chose (PreBind
+                # would write PVC.spec.volumeName)
+                for j, claim_key in enumerate(snapshot.wfc_claim_keys[i]):
+                    if j < vol_pick.shape[1] and int(vol_pick[i, j]) >= 0:
+                        volume_bindings[claim_key] = (
+                            snapshot.pv_names[int(vol_pick[i, j])])
             if gpu_pick is not None and pod.gpu_request()[0] > 0:
                 if bool(snapshot.arrays.gpu_has_forced[i]):
                     # user-pinned gpu-index is honored verbatim (the check
@@ -114,6 +126,11 @@ def decode_result(
                 # victim of DefaultPreemption: deleted to admit the preemptor
                 pre = snapshot.pods[preempted_by[i]]
                 reason = f'preempted to admit higher-priority pod "{pre.key}"'
+            elif i in snapshot.pre_reasons:
+                # unschedulable before any node was considered (PreFilter
+                # UnschedulableAndUnresolvable — missing / Lost / unbound
+                # immediate PVCs, volume_binding.go PreFilter)
+                reason = snapshot.pre_reasons[i]
             elif int(forced[i]) == -2:  # nodeName pointed at a node that doesn't exist
                 reason = f'node "{pod.node_name}" not found'
             else:
@@ -130,6 +147,7 @@ def decode_result(
         node_status=node_status,
         elapsed_s=elapsed_s,
         snapshot=snapshot,
+        volume_bindings=volume_bindings,
     )
 
 
@@ -151,6 +169,29 @@ def _resolve_priorities(pods: List[Pod], cluster: ClusterResources, apps: List[A
             p.priority = classes.get(p.priority_class_name, default)
         else:
             p.priority = default
+
+
+def with_volume_objects(
+    encode_options: Optional[EncodeOptions],
+    cluster: ClusterResources,
+    apps: List[AppResource],
+) -> EncodeOptions:
+    """Fill EncodeOptions with the PVC/PV/StorageClass objects from the
+    cluster and every app (the reference creates app SCs in the fake
+    clientset per app, simulator.go:244-258) so the VolumeBinding /
+    VolumeZone ops see the full volume world. Caller-supplied objects on
+    the options are kept and extended, not replaced."""
+    import dataclasses
+
+    opts = encode_options or EncodeOptions()
+    srcs = [cluster] + [a.resources for a in apps]
+    return dataclasses.replace(
+        opts,
+        pvcs=list(opts.pvcs) + [p for s in srcs for p in s.pvcs],
+        pvs=list(opts.pvs) + [p for s in srcs for p in s.pvs],
+        storage_classes=(list(opts.storage_classes)
+                         + [p for s in srcs for p in s.storage_classes]),
+    )
 
 
 def _priority_sort(pods: List[Pod]) -> List[Pod]:
@@ -205,6 +246,7 @@ def simulate(
     nodes = [make_valid_node(n) for n in cluster.nodes]
     cluster = _with_nodes(cluster, nodes)
     pods = build_pod_sequence(cluster, apps, use_greed=use_greed)
+    encode_options = with_volume_objects(encode_options, cluster, apps)
     snapshot = encode_cluster(nodes, pods, encode_options)
     cfg = make_config(snapshot, **(config_overrides or {}))
     arrs = device_arrays(snapshot)
@@ -230,6 +272,7 @@ def simulate(
     return decode_result(
         snapshot, node_assign, fail_counts, active_np, elapsed, gpu_pick,
         preempted_by=preempted_by,
+        vol_pick=np.asarray(out.vol_pick) if cfg.enable_pv_match else None,
     )
 
 

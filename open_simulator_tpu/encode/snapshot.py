@@ -59,6 +59,12 @@ def filter_op_table(resources: Sequence[str]) -> List[str]:
         "node(s) didn't match pod topology spread constraints",
         "Insufficient GPU memory in one or more devices",
         "node(s) had no volume group / free device for the pod's local volumes",
+        # VolumeBinding / VolumeZone (vendored reason strings:
+        # binder.go:67-72, volume_zone.go:52)
+        "node(s) had volume node affinity conflict",
+        "node(s) had no available volume zone",
+        "node(s) didn't find available persistent volumes to bind",
+        "node(s) unavailable due to one or more pvc(s) bound to non-existent pv(s)",
     ]
     return ops
 
@@ -71,6 +77,13 @@ class EncodeOptions:
     # Upper bound on distinct non-hostname topology domains (zones etc.).
     # Raised automatically if the cluster has more.
     min_domain_pad: int = 4
+    # Volume world for the VolumeBinding/VolumeZone ops (k8s/volumes.py).
+    # The reference neuters these (MakeValidPod rewrites PVC volumes to
+    # hostPath, pkg/utils/utils.go:393-399); passing the cluster's
+    # PVCs/PVs/StorageClasses here schedules them for real.
+    pvcs: list = field(default_factory=list)
+    pvs: list = field(default_factory=list)
+    storage_classes: list = field(default_factory=list)
 
 
 @chex.dataclass(frozen=True)
@@ -140,6 +153,18 @@ class SnapshotArrays:
     lvm_req: np.ndarray        # [P, Lv] f32 MiB LVM volume sizes, descending
     sdev_req: np.ndarray       # [P, Ev] f32 MiB exclusive-device claims, descending
     sdev_req_ssd: np.ndarray   # [P, Ev] bool wants-ssd per claim
+    # VolumeBinding/VolumeZone (k8s/volumes.py); Npv PVs capacity-ascending,
+    # Cv volume classes, Cc claim classes, Lw WaitForFirstConsumer claim
+    # slots per pod
+    pv_node_ok: np.ndarray     # [Npv, N] bool PV nodeAffinity admits node
+    pv_cand: np.ndarray        # [Cc, Npv] bool claim-class candidate PVs
+    vol_cid: np.ndarray        # [P] i64 into class_vol_* rows
+    class_vol_node: np.ndarray  # [Cv, N] bool bound-PV node-affinity
+    class_vol_zone: np.ndarray  # [Cv, N] bool bound-PV zone labels
+    class_vol_bind: np.ndarray  # [Cv, N] bool provision allowedTopologies
+    vol_pv_missing: np.ndarray  # [P] bool bound claim -> non-existent PV
+    wfc_ccid: np.ndarray       # [P, Lw] i64 claim-class per WFC slot
+    wfc_valid: np.ndarray      # [P, Lw] bool
 
 
 @dataclass
@@ -153,6 +178,14 @@ class ClusterSnapshot:
     group_desc: List[str]
     op_names: List[str]
     n_real_nodes: int
+    # PreFilter-style unschedulable-before-any-node verdicts (missing or
+    # unbound-immediate PVCs, volume_binding.go PreFilter); decode prints
+    # these verbatim instead of per-op counts
+    pre_reasons: Dict[int, str] = field(default_factory=dict)
+    # PV names in pv axis order + per-pod WFC claim keys per slot — decode
+    # turns vol_pick ids into claim -> PV binding reports
+    pv_names: List[str] = field(default_factory=list)
+    wfc_claim_keys: List[List[str]] = field(default_factory=list)
 
     @property
     def n_nodes(self) -> int:
@@ -537,6 +570,38 @@ def encode_cluster(
             sdev_req[pi, j] = float(size)
             sdev_req_ssd[pi, j] = wants_ssd
 
+    # ---- VolumeBinding / VolumeZone arrays ----------------------------
+    from open_simulator_tpu.k8s.volumes import analyze_volumes, build_volume_masks
+
+    vol_model = analyze_volumes(pods, opts.pvcs, opts.pvs, opts.storage_classes)
+    sc_by_name = {s.meta.name: s for s in opts.storage_classes}
+    vol_cid, class_vol_node, class_vol_zone, class_vol_bind, pv_node_ok = (
+        build_volume_masks(vol_model, all_nodes, sc_by_name))
+    n_pv = vol_model.n_pvs
+    Lw = max([len(i.wfc_claim_ids) for i in vol_model.pod_volumes] + [0])
+    Cc = max(len(vol_model.claim_cand), 1)
+    pv_cand = np.zeros((Cc, n_pv), dtype=bool)
+    for ci, row in enumerate(vol_model.claim_cand):
+        pv_cand[ci] = row
+    vol_pv_missing = np.zeros(P, dtype=bool)
+    wfc_ccid = np.zeros((P, Lw), dtype=np.int64)
+    wfc_valid = np.zeros((P, Lw), dtype=bool)
+    pre_reasons: Dict[int, str] = {}
+    for pi, info in enumerate(vol_model.pod_volumes):
+        vol_pv_missing[pi] = info.missing_pv
+        for j, cid_w in enumerate(info.wfc_claim_ids[:Lw]):
+            wfc_ccid[pi, j] = cid_w
+            wfc_valid[pi, j] = True
+        if info.pre_reason and forced[pi] == -1:
+            # -4: unschedulable before any node is considered (PreFilter
+            # UnschedulableAndUnresolvable); the engine treats any negative
+            # non--1 forced value as bind-nothing/schedule-nothing. Pods
+            # with a preset nodeName keep their forced binding — real k8s
+            # never re-schedules assigned pods, so a broken volume ref must
+            # not evict them or drop their resource charge.
+            pre_reasons[pi] = info.pre_reason
+            forced[pi] = -4
+
     # ---- ragged term arrays -> padded ---------------------------------
     A = max((len(t) for t in pod_aff_terms), default=0)
     B = max((len(t) for t in pod_anti_terms), default=0)
@@ -620,6 +685,15 @@ def encode_cluster(
         lvm_req=lvm_req,
         sdev_req=sdev_req,
         sdev_req_ssd=sdev_req_ssd,
+        pv_node_ok=pv_node_ok,
+        pv_cand=pv_cand,
+        vol_cid=vol_cid,
+        class_vol_node=class_vol_node,
+        class_vol_zone=class_vol_zone,
+        class_vol_bind=class_vol_bind,
+        vol_pv_missing=vol_pv_missing,
+        wfc_ccid=wfc_ccid,
+        wfc_valid=wfc_valid,
     )
 
     group_desc = [f"group#{i}" for i in range(S)]
@@ -633,4 +707,7 @@ def encode_cluster(
         group_desc=group_desc,
         op_names=filter_op_table(res_vocab),
         n_real_nodes=n_real,
+        pre_reasons=pre_reasons,
+        pv_names=[p.meta.name for p in vol_model.pvs],
+        wfc_claim_keys=[list(i.wfc_claim_keys) for i in vol_model.pod_volumes],
     )

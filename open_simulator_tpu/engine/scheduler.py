@@ -102,6 +102,10 @@ class EngineConfig(NamedTuple):
     enable_unsched: bool = True
     enable_class_aff: bool = True
     enable_class_taint: bool = True
+    # VolumeBinding/VolumeZone: static bound-PV/provision masks and the
+    # dynamic WaitForFirstConsumer PV matching (ops/volumes.py)
+    enable_vol_static: bool = False
+    enable_pv_match: bool = False
 
     @property
     def enable_spread(self) -> bool:
@@ -121,8 +125,9 @@ class EngineConfig(NamedTuple):
     @property
     def n_ops(self) -> int:
         # 4 pre-fit masks + R fit rows + [pod-aff, anti-aff, spread, gpu,
-        # storage] (filter_op_table order)
-        return OP_FIT_BASE + self.n_resources + 5
+        # storage, vol-node-aff, vol-zone, vol-bind, vol-pv-missing]
+        # (filter_op_table order)
+        return OP_FIT_BASE + self.n_resources + 9
 
 
 class SimState(NamedTuple):
@@ -147,6 +152,9 @@ class SimState(NamedTuple):
     # the spread ops read an O(D)-wide table instead of doing two [N, D]
     # mat-vec reductions per constraint per step
     dom_count: jnp.ndarray    # [K1, D, S] f32
+    # PVs consumed by earlier pods' WaitForFirstConsumer matches
+    # (AssumePodVolumes analog)
+    pv_taken: jnp.ndarray     # [Npv] bool
 
 
 class ScheduleOutput(NamedTuple):
@@ -154,6 +162,7 @@ class ScheduleOutput(NamedTuple):
     fail_counts: jnp.ndarray  # [P, OPS] i32
     feasible: jnp.ndarray     # [P] i32 feasible-node count
     gpu_pick: jnp.ndarray     # [P, G] i32 per-device GPU multiplicities on the bound node
+    vol_pick: jnp.ndarray     # [P, Lw] i32 PV id bound per WFC claim slot (-1 none)
     state: SimState
 
 
@@ -184,6 +193,7 @@ def init_state(arrs: SnapshotArrays, cfg: "EngineConfig | None" = None) -> SimSt
         vg_used=jnp.zeros((n, arrs.vg_cap.shape[1]), f32),
         sdev_taken=jnp.zeros((n, arrs.sdev_cap.shape[1]), dtype=bool),
         dom_count=jnp.zeros((k1, d, s), f32),
+        pv_taken=jnp.zeros((arrs.pv_node_ok.shape[0],), dtype=bool),
     )
 
 
@@ -198,6 +208,7 @@ def _pod_xs(arrs: SnapshotArrays) -> Dict[str, jnp.ndarray]:
         "pref_group", "pref_key", "pref_weight", "pref_valid", "pref_tid", "hit_pref",
         "gpu_mem", "gpu_cnt", "gpu_forced", "gpu_has_forced",
         "lvm_req", "sdev_req", "sdev_req_ssd",
+        "vol_cid", "vol_pv_missing", "wfc_ccid", "wfc_valid",
     ]
     xs = {k: getattr(arrs, k) for k in names}
     xs["_pod_index"] = jnp.arange(arrs.req.shape[0], dtype=jnp.int32)
@@ -313,9 +324,29 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     else:
         ok_storage = true_v
 
+    # VolumeBinding/VolumeZone: static class masks (bound-PV node affinity,
+    # bound-PV zone labels, dynamic-provision allowedTopologies) + the
+    # dynamic WaitForFirstConsumer claim -> PV matching over pv_taken
+    if cfg.enable_vol_static:
+        vcid = x["vol_cid"]
+        ok_vol_node = arrs.class_vol_node[vcid]
+        ok_vol_zone = arrs.class_vol_zone[vcid]
+        ok_vol_bind = arrs.class_vol_bind[vcid]
+        ok_pv_exist = true_v & ~x["vol_pv_missing"]
+    else:
+        ok_vol_node = ok_vol_zone = ok_vol_bind = ok_pv_exist = true_v
+    if cfg.enable_pv_match:
+        from open_simulator_tpu.ops import volumes as vol_ops
+
+        wfc_ok = vol_ops.wfc_claims_ok(
+            state.pv_taken, arrs.pv_cand, arrs.pv_node_ok,
+            x["wfc_ccid"], x["wfc_valid"])
+        ok_vol_bind = ok_vol_bind & wfc_ok if ok_vol_bind is not true_v else wfc_ok
+
     op_masks = [ok_unsched, ok_aff, ok_taint, ok_ports]
     op_masks += [fit[:, r] for r in range(cfg.n_resources)]
-    op_masks += [ok_pod_aff, ok_pod_anti, ok_spread, ok_gpu, ok_storage]
+    op_masks += [ok_pod_aff, ok_pod_anti, ok_spread, ok_gpu, ok_storage,
+                 ok_vol_node, ok_vol_zone, ok_vol_bind, ok_pv_exist]
 
     # first failing op per node -> per-op failure counts (active nodes only)
     if cfg.fail_reasons:
@@ -545,9 +576,19 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         vg_used = state.vg_used
         sdev_taken = state.sdev_taken
 
+    if cfg.enable_pv_match:
+        from open_simulator_tpu.ops import volumes as vol_ops
+
+        pv_taken, vol_pick = vol_ops.wfc_pick_for_node(
+            state.pv_taken, arrs.pv_cand, arrs.pv_node_ok[:, safe_node],
+            x["wfc_ccid"], x["wfc_valid"], bound)
+    else:
+        pv_taken = state.pv_taken
+        vol_pick = jnp.zeros((0,), dtype=jnp.int32)
+
     new_state = SimState(used, group_count, term_block, pref_paint, ports_used,
-                         gpu_used, vg_used, sdev_taken, dom_count)
-    return new_state, (final_node, fail_counts, feasible_n, pick)
+                         gpu_used, vg_used, sdev_taken, dom_count, pv_taken)
+    return new_state, (final_node, fail_counts, feasible_n, pick, vol_pick)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -585,7 +626,7 @@ def schedule_pods(
     # multiplies (inv = 0 encodes the cap<=0 -> fraction 0 convention)
     inv_alloc = jnp.where(arrs.alloc > 0, 1.0 / jnp.where(arrs.alloc > 0, arrs.alloc, 1.0), 0.0)
     step = functools.partial(_step, arrs, active, cfg, hoisted, inv_alloc)
-    final_state, (nodes, fail_counts, feasible, gpu_pick) = jax.lax.scan(
+    final_state, (nodes, fail_counts, feasible, gpu_pick, vol_pick) = jax.lax.scan(
         step, state, xs, unroll=cfg.scan_unroll
     )
     if not cfg.fail_reasons:
@@ -594,7 +635,7 @@ def schedule_pods(
         fail_counts = jnp.zeros((n_pods, cfg.n_ops), jnp.int32)
     return ScheduleOutput(
         node=nodes, fail_counts=fail_counts, feasible=feasible, gpu_pick=gpu_pick,
-        state=final_state,
+        vol_pick=vol_pick, state=final_state,
     )
 
 
@@ -646,6 +687,11 @@ def make_config(snapshot: ClusterSnapshot, **overrides) -> EngineConfig:
         enable_unsched=bool(np.any(a.unschedulable)),
         enable_class_aff=bool(not np.all(a.class_affinity)),
         enable_class_taint=bool(not np.all(a.class_taint)),
+        enable_vol_static=bool(
+            not np.all(a.class_vol_node) or not np.all(a.class_vol_zone)
+            or not np.all(a.class_vol_bind) or np.any(a.vol_pv_missing)
+        ),
+        enable_pv_match=bool(np.any(a.wfc_valid)),
     )
     kw.update(overrides)
     return EngineConfig(**kw)

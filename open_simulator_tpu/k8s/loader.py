@@ -39,6 +39,7 @@ _KIND_MAP = {
     "PodDisruptionBudget": k8s.PodDisruptionBudget,
     "StorageClass": k8s.StorageClass,
     "PersistentVolumeClaim": k8s.PersistentVolumeClaim,
+    "PersistentVolume": k8s.PersistentVolume,
     "ConfigMap": k8s.ConfigMap,
 }
 
@@ -60,6 +61,7 @@ class ClusterResources:
     pdbs: List[k8s.PodDisruptionBudget] = field(default_factory=list)
     storage_classes: List[k8s.StorageClass] = field(default_factory=list)
     pvcs: List[k8s.PersistentVolumeClaim] = field(default_factory=list)
+    pvs: List[k8s.PersistentVolume] = field(default_factory=list)
     config_maps: List[k8s.ConfigMap] = field(default_factory=list)
     priority_classes: List[k8s.PriorityClass] = field(default_factory=list)
 
@@ -76,6 +78,7 @@ class ClusterResources:
         "PodDisruptionBudget": "pdbs",
         "StorageClass": "storage_classes",
         "PersistentVolumeClaim": "pvcs",
+        "PersistentVolume": "pvs",
         "ConfigMap": "config_maps",
         "PriorityClass": "priority_classes",
     }

@@ -489,9 +489,126 @@ class PodDisruptionBudget(_Passthrough):
 class StorageClass(_Passthrough):
     KIND = "StorageClass"
 
+    @property
+    def provisioner(self) -> str:
+        return self.raw.get("provisioner", "") or ""
+
+    @property
+    def volume_binding_mode(self) -> str:
+        # k8s defaults to Immediate when unset
+        return self.raw.get("volumeBindingMode", "Immediate") or "Immediate"
+
+    @property
+    def is_wait_for_first_consumer(self) -> bool:
+        return self.volume_binding_mode == "WaitForFirstConsumer"
+
+    @property
+    def allowed_topologies(self) -> List[Dict[str, Any]]:
+        return list(self.raw.get("allowedTopologies") or [])
+
 
 class PersistentVolumeClaim(_Passthrough):
     KIND = "PersistentVolumeClaim"
+
+    @property
+    def spec(self) -> Dict[str, Any]:
+        return self.raw.get("spec") or {}
+
+    @property
+    def volume_name(self) -> str:
+        return self.spec.get("volumeName", "") or ""
+
+    @property
+    def storage_class_name(self) -> Optional[str]:
+        # None (absent) and "" both mean "no class" for binding-mode
+        # purposes; the distinction only matters to the default-class
+        # admission controller, which a snapshot has already applied
+        return self.spec.get("storageClassName")
+
+    @property
+    def access_modes(self) -> List[str]:
+        return list(self.spec.get("accessModes") or [])
+
+    @property
+    def request_mib(self) -> float:
+        from open_simulator_tpu.k8s.quantity import parse_quantity
+
+        req = ((self.spec.get("resources") or {}).get("requests") or {})
+        v = req.get("storage")
+        return float(parse_quantity(v)) / (1024.0 * 1024.0) if v is not None else 0.0
+
+    @property
+    def selector(self) -> Optional[Dict[str, Any]]:
+        return self.spec.get("selector")
+
+    @property
+    def phase(self) -> str:
+        return ((self.raw.get("status") or {}).get("phase")) or "Pending"
+
+
+class PersistentVolume(_Passthrough):
+    """PersistentVolume, interpreted: capacity/class/affinity drive the
+    VolumeBinding/VolumeZone tensor ops (the reference vendors these
+    plugins but neuters them — MakeValidPod rewrites every PVC volume to
+    hostPath, pkg/utils/utils.go:393-399 'todo: handle pvc'; this
+    framework schedules PVCs for real, see ops docs)."""
+
+    KIND = "PersistentVolume"
+
+    @property
+    def spec(self) -> Dict[str, Any]:
+        return self.raw.get("spec") or {}
+
+    @property
+    def capacity_mib(self) -> float:
+        from open_simulator_tpu.k8s.quantity import parse_quantity
+
+        v = (self.spec.get("capacity") or {}).get("storage")
+        return float(parse_quantity(v)) / (1024.0 * 1024.0) if v is not None else 0.0
+
+    @property
+    def storage_class_name(self) -> str:
+        return self.spec.get("storageClassName", "") or ""
+
+    @property
+    def access_modes(self) -> List[str]:
+        return list(self.spec.get("accessModes") or [])
+
+    @property
+    def claim_ref(self) -> Optional[str]:
+        ref = self.spec.get("claimRef")
+        if not ref:
+            return None
+        return f"{ref.get('namespace', 'default')}/{ref.get('name', '')}"
+
+    @property
+    def node_affinity_terms(self) -> Optional[List[Dict[str, Any]]]:
+        req = ((self.spec.get("nodeAffinity") or {}).get("required") or {})
+        terms = req.get("nodeSelectorTerms")
+        return list(terms) if terms else None
+
+    @property
+    def phase(self) -> str:
+        return ((self.raw.get("status") or {}).get("phase")) or "Available"
+
+    def zone_labels(self) -> Dict[str, set]:
+        """PV topology labels the VolumeZone plugin checks (zone/region in
+        both the beta and GA forms); values may be comma-separated sets
+        (volume_zone.go LabelZonesToSet)."""
+        keys = (
+            "topology.kubernetes.io/zone",
+            "topology.kubernetes.io/region",
+            "failure-domain.beta.kubernetes.io/zone",
+            "failure-domain.beta.kubernetes.io/region",
+        )
+        out: Dict[str, set] = {}
+        for k in keys:
+            v = self.meta.labels.get(k)
+            if v:
+                # "__" is the legacy multi-zone separator
+                # (volumehelpers.LabelZonesToSet)
+                out[k] = {tok for tok in str(v).split("__") if tok}
+        return out
 
 
 class ConfigMap(_Passthrough):

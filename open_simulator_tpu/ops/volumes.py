@@ -1,0 +1,68 @@
+"""VolumeBinding tensor ops: WaitForFirstConsumer claim -> PV matching.
+
+Re-expresses the vendored findMatchingVolumes (volumebinding/binder.go) on
+dense arrays: the PV axis is capacity-ascending (encode), so "first
+available candidate" is exactly FindMatchingVolume's smallest-satisfying
+pick; claims are walked in pod-volume order and must land on DISJOINT PVs
+(the chosenPVs exclusion). The scan carries pv_taken so a PV assumed by an
+earlier pod is unavailable to later ones (AssumePodVolumes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def wfc_claims_ok(
+    pv_taken: jnp.ndarray,    # [Npv] bool carry
+    pv_cand: jnp.ndarray,     # [Cc, Npv] bool static candidates per claim class
+    pv_node_ok: jnp.ndarray,  # [Npv, N] bool static PV nodeAffinity
+    wfc_ccid: jnp.ndarray,    # [Lw] i64 claim-class ids of this pod
+    wfc_valid: jnp.ndarray,   # [Lw] bool
+) -> jnp.ndarray:
+    """[N] bool: every valid claim finds its own PV on the node (greedy
+    smallest-first with disjointness, per node)."""
+    n_pv, n_nodes = pv_node_ok.shape
+    if n_pv == 0:
+        # no PVs at all: every valid claim is unmatchable on every node
+        return ~jnp.any(wfc_valid) & jnp.ones((n_nodes,), dtype=bool)
+    ok = jnp.ones((n_nodes,), dtype=bool)
+    chosen = jnp.zeros((n_pv, n_nodes), dtype=bool)
+    for j in range(wfc_ccid.shape[0]):
+        cand = pv_cand[wfc_ccid[j]] & ~pv_taken            # [Npv]
+        avail = cand[:, None] & pv_node_ok & ~chosen       # [Npv, N]
+        found = jnp.any(avail, axis=0)                     # [N]
+        # first True along the capacity-ascending PV axis = smallest fit
+        pick = jnp.argmax(avail, axis=0)                   # [N]
+        pick_rows = jax.nn.one_hot(pick, n_pv, axis=0, dtype=bool)  # [Npv, N]
+        chosen = chosen | (pick_rows & found[None, :])
+        ok = ok & (found | ~wfc_valid[j])
+    return ok
+
+
+def wfc_pick_for_node(
+    pv_taken: jnp.ndarray,     # [Npv] bool
+    pv_cand: jnp.ndarray,      # [Cc, Npv]
+    pv_node_col: jnp.ndarray,  # [Npv] bool: pv_node_ok[:, bound_node]
+    wfc_ccid: jnp.ndarray,     # [Lw]
+    wfc_valid: jnp.ndarray,    # [Lw]
+    bound: jnp.ndarray,        # scalar bool: pod actually bound
+):
+    """(new_pv_taken [Npv], picks [Lw] i32): commit the bound node's greedy
+    match into the carry; picks are PV ids (-1 = none/invalid)."""
+    n_pv = pv_taken.shape[0]
+    if n_pv == 0:
+        return pv_taken, jnp.full((wfc_ccid.shape[0],), -1, dtype=jnp.int32)
+    taken = pv_taken
+    picks = []
+    for j in range(wfc_ccid.shape[0]):
+        avail = pv_cand[wfc_ccid[j]] & ~taken & pv_node_col  # [Npv]
+        found = jnp.any(avail)
+        idx = jnp.argmax(avail)
+        take = found & wfc_valid[j] & bound
+        taken = taken | (jax.nn.one_hot(idx, n_pv, dtype=bool) & take)
+        picks.append(jnp.where(take, idx, -1).astype(jnp.int32))
+    picks_arr = (jnp.stack(picks) if picks
+                 else jnp.zeros((0,), dtype=jnp.int32))
+    return taken, picks_arr

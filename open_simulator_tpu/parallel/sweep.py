@@ -52,6 +52,7 @@ class CapacityPlan:
     nodes_per_scenario: np.ndarray = field(repr=False, default=None)  # [S, P]
     fail_counts: np.ndarray = field(repr=False, default=None)         # [S, P, OPS]
     gpu_pick: Optional[np.ndarray] = field(repr=False, default=None)  # [S, P, G]
+    vol_pick: Optional[np.ndarray] = field(repr=False, default=None)  # [S, P, Lw]
 
 
 def make_mesh(
@@ -99,6 +100,7 @@ def batched_schedule(
             in_shardings=(NamedSharding(mesh, P("scenario", None)),),
             out_shardings=ScheduleOutput(
                 node=lane, fail_counts=lane, feasible=lane, gpu_pick=lane,
+                vol_pick=lane,
                 state=jax.tree_util.tree_map(lambda _: lane, _state_proto(arrs)),
             ),
         )
@@ -126,7 +128,9 @@ def shard_arrays(arrs, mesh: Mesh):
     node_first = {"alloc", "active", "is_new_node", "gpu_cap_mem", "gpu_count", "gpu_slot",
                   "unschedulable", "vg_cap", "sdev_cap", "sdev_ssd"}
     node_second = {"topo_onehot", "has_key", "class_affinity", "class_taint",
-                   "class_node_aff_score", "class_taint_prefer"}
+                   "class_node_aff_score", "class_taint_prefer",
+                   "pv_node_ok", "class_vol_node", "class_vol_zone",
+                   "class_vol_bind"}
 
     def spec_for(name: str, x) -> P:
         if name in node_first:
@@ -241,4 +245,5 @@ def capacity_sweep(
         nodes_per_scenario=nodes,
         fail_counts=fail,
         gpu_pick=np.asarray(out.gpu_pick) if cfg.enable_gpu else None,
+        vol_pick=np.asarray(out.vol_pick) if cfg.enable_pv_match else None,
     )

@@ -91,7 +91,10 @@ class Simulator:
 
     # -- internals -------------------------------------------------------
     def _run(self, select_app: Optional[str]) -> SimulateResult:
-        snapshot = encode_cluster(self.cluster.nodes, self._pods, self._encode_options)
+        from open_simulator_tpu.core import with_volume_objects
+
+        opts = with_volume_objects(self._encode_options, self.cluster, self._apps)
+        snapshot = encode_cluster(self.cluster.nodes, self._pods, opts)
         cfg = make_config(snapshot, **self._overrides)
         arrs = device_arrays(snapshot)
         preempted_by = None
@@ -126,6 +129,7 @@ class Simulator:
             np.asarray(arrs.active),
             gpu_pick=np.asarray(out.gpu_pick) if cfg.enable_gpu else None,
             preempted_by=preempted_by,
+            vol_pick=np.asarray(out.vol_pick) if cfg.enable_pv_match else None,
         )
         self._last = result
         if select_app is None:

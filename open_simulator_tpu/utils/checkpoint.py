@@ -82,6 +82,9 @@ def load_simulation(path: str) -> Tuple[SimState, Optional[np.ndarray], dict]:
                 # (k1=1, d=1) snapshot shape.
                 s_cols = fields.get("group_count", np.zeros((n, 1))).shape[1]
                 fields[name] = np.zeros((0, 0, s_cols), dtype=np.float32)
+            elif name == "pv_taken":
+                # pre-volume-ops checkpoints had no PV axis
+                fields[name] = np.zeros((0,), dtype=bool)
             else:
                 fields[name] = np.zeros(
                     (n, 1), dtype=bool if name == "sdev_taken" else np.float32
