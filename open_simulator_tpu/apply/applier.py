@@ -222,6 +222,7 @@ class Applier:
             from open_simulator_tpu.engine.profile import weight_overrides_from_file
 
             overrides = weight_overrides_from_file(self.opts.default_scheduler_config)
+        self._preemption = not overrides.pop("_disable_preemption", False)
         cfg = make_config(snapshot, **overrides)
         thresholds = self._thresholds()
 
@@ -281,6 +282,7 @@ class Applier:
         if (
             cfg is not None
             and lane_has_unscheduled
+            and getattr(self, "_preemption", True)
             and len({p.priority for p in snapshot.pods}) > 1
         ):
             # The chosen lane's placements and reasons should reflect the

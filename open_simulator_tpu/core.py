@@ -85,7 +85,9 @@ def decode_result(
     gpu_pick: Optional[np.ndarray] = None,
     preempted_by: Optional[Dict[int, int]] = None,
     vol_pick: Optional[np.ndarray] = None,
+    extra_op_names: Optional[List[str]] = None,
 ) -> SimulateResult:
+    op_names = snapshot.op_names + list(extra_op_names or [])
     n_active = int(np.sum(active))
     scheduled: List[ScheduledPod] = []
     unscheduled: List[UnscheduledPod] = []
@@ -134,7 +136,7 @@ def decode_result(
             elif int(forced[i]) == -2:  # nodeName pointed at a node that doesn't exist
                 reason = f'node "{pod.node_name}" not found'
             else:
-                reason = format_failure_reason(fail_counts[i], snapshot.op_names, n_active)
+                reason = format_failure_reason(fail_counts[i], op_names, n_active)
             unscheduled.append(UnscheduledPod(pod=pod, reason=reason))
     node_status = [
         NodeStatus(node=snapshot.nodes[ni], pods=pods_by_node.get(ni, []))
@@ -243,12 +245,14 @@ def simulate(
     unless some pod carries a nonzero priority, so the default costs nothing
     on priority-free clusters — the reference's own fixtures are such)."""
     t0 = time.perf_counter()
+    config_overrides = dict(config_overrides or {})
+    preemption = preemption and not config_overrides.pop("_disable_preemption", False)
     nodes = [make_valid_node(n) for n in cluster.nodes]
     cluster = _with_nodes(cluster, nodes)
     pods = build_pod_sequence(cluster, apps, use_greed=use_greed)
     encode_options = with_volume_objects(encode_options, cluster, apps)
     snapshot = encode_cluster(nodes, pods, encode_options)
-    cfg = make_config(snapshot, **(config_overrides or {}))
+    cfg = make_config(snapshot, **config_overrides)
     arrs = device_arrays(snapshot)
     active_np = np.asarray(arrs.active)
     preempted_by: Optional[Dict[int, int]] = None
@@ -273,6 +277,7 @@ def simulate(
         snapshot, node_assign, fail_counts, active_np, elapsed, gpu_pick,
         preempted_by=preempted_by,
         vol_pick=np.asarray(out.vol_pick) if cfg.enable_pv_match else None,
+        extra_op_names=list(cfg.extension_op_names),
     )
 
 

@@ -1,0 +1,56 @@
+"""Out-of-tree extension ops — the WithFrameworkOutOfTreeRegistry analog.
+
+The reference registers custom plugins into the vendored scheduler's
+out-of-tree registry (pkg/simulator/simulator.go:188-195: Simon and
+optionally Open-Gpu-Share are themselves out-of-tree plugins). Here the
+extension point is tensor-shaped: an ExtensionOp contributes
+
+  filter_fn(state, arrs, x) -> [N] bool   a feasibility mask, ANDed after
+                                          the built-in filter pipeline and
+                                          charged in the reason table under
+                                          `name`;
+  score_fn(state, arrs, x)  -> [N] f32    a raw score, weighted into the
+                                          node ranking; `normalize` picks
+                                          the framework NormalizeScore
+                                          treatment ("none" | "minmax" |
+                                          "max"), riding the engine's
+                                          single per-step variadic
+                                          reduction.
+
+Arguments mirror what the built-in ops see: `state` is the SimState carry,
+`arrs` the device SnapshotArrays, `x` the per-pod slice (engine/scheduler
+._pod_xs keys). Functions must be jax-traceable (no Python control flow on
+traced values) — they run inside the jitted scan exactly like built-ins.
+
+Usage:
+
+    from open_simulator_tpu.engine.extensions import ExtensionOp
+    ext = ExtensionOp(name="node(s) failed the even-index policy",
+                      filter_fn=lambda state, arrs, x: even_mask)
+    cfg = make_config(snapshot, extensions=(ext,))
+
+Reuse the same ExtensionOp instances across calls — EngineConfig is the
+jit static argument, so a fresh tuple of fresh closures recompiles.
+simulate()/Simulator accept them via config_overrides={"extensions": ...}.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+
+class ExtensionOp(NamedTuple):
+    name: str
+    filter_fn: Optional[Callable] = None
+    score_fn: Optional[Callable] = None
+    weight: float = 1.0
+    normalize: str = "none"   # "none" (already 0..100) | "minmax" | "max"
+
+    def validate(self) -> "ExtensionOp":
+        if self.normalize not in ("none", "minmax", "max"):
+            raise ValueError(f"ExtensionOp {self.name}: unknown normalize "
+                             f"{self.normalize!r}")
+        if self.filter_fn is None and self.score_fn is None:
+            raise ValueError(f"ExtensionOp {self.name}: needs filter_fn "
+                             f"and/or score_fn")
+        return self

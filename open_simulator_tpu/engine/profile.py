@@ -1,11 +1,15 @@
-"""KubeSchedulerConfiguration -> engine weight overrides.
+"""KubeSchedulerConfiguration -> engine overrides.
 
 The reference accepts a scheduler config file via --default-scheduler-config
 and merges it over the v1beta2 defaults (GetAndSetSchedulerConfig,
 pkg/simulator/utils.go:325-356). Here the file's Score plugin
-enable/disable/weight lists map onto EngineConfig weight fields; Filter
-plugins are always-on tensor ops (disabling filters would change parity,
-and the reference never disables them either).
+enable/disable/weight lists map onto EngineConfig weight fields, and
+Filter/PreFilter plugin DISABLES map onto the engine's feature gates (the
+same compile-the-op-out switches make_config autodetects; a disabled
+filter op contributes a constant-true mask, exactly like the vendored
+framework skipping a de-registered plugin). Out-of-tree plugins have a
+tensor-shaped registry of their own — engine/extensions.ExtensionOp
+(config_overrides={"extensions": (...)}).
 """
 
 from __future__ import annotations
@@ -30,6 +34,21 @@ _SCORE_PLUGIN_FIELDS = {
     "Open-Gpu-Share": "w_gpu",
 }
 
+# filter/preFilter plugin name -> EngineConfig gate(s) a DISABLE turns off.
+# NodeResourcesFit/NodeName have no gate (fit and forced binds are the
+# engine's substrate) — disables of those warn and are ignored.
+_FILTER_PLUGIN_GATES = {
+    "NodeUnschedulable": ("enable_unsched",),
+    "NodeAffinity": ("enable_class_aff",),
+    "TaintToleration": ("enable_class_taint",),
+    "NodePorts": ("enable_ports",),
+    "InterPodAffinity": ("enable_pod_affinity", "enable_anti_affinity"),
+    "PodTopologySpread": ("enable_spread_hard",),
+    "VolumeBinding": ("enable_vol_static", "enable_pv_match"),
+    "VolumeZone": (),   # folded into the vol_static masks; warn below
+    "Open-Gpu-Share": ("enable_gpu",),
+}
+
 
 class SchedulerConfigError(ValueError):
     pass
@@ -46,18 +65,44 @@ def weight_overrides_from_file(path: str) -> Dict[str, float]:
     if not profiles:
         return {}
     plugins = (profiles[0] or {}).get("plugins") or {}
-    for point in ("filter", "preFilter", "postFilter"):
+    overrides: Dict[str, Any] = {}
+    for point in ("filter", "preFilter"):
         section = plugins.get(point) or {}
-        touched = [e.get("name", "?") for e in (section.get("enabled") or [])]
-        touched += [e.get("name", "?") for e in (section.get("disabled") or [])]
-        if touched:
-            log.warning(
-                "%s: %s plugin enable/disable (%s) is ignored — filter ops are "
-                "always-on tensor ops in this engine",
-                path, point, ", ".join(touched),
-            )
+        disabled = section.get("disabled") or []
+        star = any(e.get("name") == "*" for e in disabled)
+        if star:
+            for gates in _FILTER_PLUGIN_GATES.values():
+                for g in gates:
+                    overrides[g] = False
+            # kube semantics: with `disabled: ['*']` the enabled list IS
+            # the plugin set — those gates come back on
+            for entry in section.get("enabled") or []:
+                for g in _FILTER_PLUGIN_GATES.get(entry.get("name", ""), ()):
+                    overrides[g] = True
+        # explicit named disables always win (plain `enabled` entries
+        # without a star merely append to the default set, which is the
+        # autodetected-gate status quo — no override needed)
+        for entry in disabled:
+            name = entry.get("name", "")
+            if name == "*":
+                continue
+            gates = _FILTER_PLUGIN_GATES.get(name)
+            if gates:
+                for g in gates:
+                    overrides[g] = False
+            else:
+                log.warning(
+                    "%s: cannot disable %s plugin %r — it has no engine "
+                    "gate (resource fit and forced binds are the engine's "
+                    "substrate; VolumeZone folds into the VolumeBinding "
+                    "masks)", path, point, name,
+                )
+    for entry in (plugins.get("postFilter") or {}).get("disabled") or []:
+        # DefaultPreemption disable is honored by the callers (simulate /
+        # Simulator / Applier pop this pseudo-override before make_config)
+        if entry.get("name") in ("DefaultPreemption", "*"):
+            overrides["_disable_preemption"] = True
     score = plugins.get("score") or {}
-    overrides: Dict[str, float] = {}
     for entry in score.get("enabled") or []:
         name = entry.get("name", "")
         field = _SCORE_PLUGIN_FIELDS.get(name)

@@ -106,6 +106,12 @@ class EngineConfig(NamedTuple):
     # dynamic WaitForFirstConsumer PV matching (ops/volumes.py)
     enable_vol_static: bool = False
     enable_pv_match: bool = False
+    # Out-of-tree extension ops (engine/extensions.py ExtensionOp tuples) —
+    # the WithFrameworkOutOfTreeRegistry analog
+    # (pkg/simulator/simulator.go:188-195). Filter extensions append reason
+    # rows after the built-in table; score extensions join the weighted sum
+    # (and the shared normalize reduction).
+    extensions: Tuple = ()
 
     @property
     def enable_spread(self) -> bool:
@@ -126,8 +132,13 @@ class EngineConfig(NamedTuple):
     def n_ops(self) -> int:
         # 4 pre-fit masks + R fit rows + [pod-aff, anti-aff, spread, gpu,
         # storage, vol-node-aff, vol-zone, vol-bind, vol-pv-missing]
-        # (filter_op_table order)
-        return OP_FIT_BASE + self.n_resources + 9
+        # (filter_op_table order) + one row per filter extension
+        return (OP_FIT_BASE + self.n_resources + 9
+                + sum(1 for e in self.extensions if e.filter_fn is not None))
+
+    @property
+    def extension_op_names(self) -> Tuple[str, ...]:
+        return tuple(e.name for e in self.extensions if e.filter_fn is not None)
 
 
 class SimState(NamedTuple):
@@ -347,6 +358,11 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     op_masks += [fit[:, r] for r in range(cfg.n_resources)]
     op_masks += [ok_pod_aff, ok_pod_anti, ok_spread, ok_gpu, ok_storage,
                  ok_vol_node, ok_vol_zone, ok_vol_bind, ok_pv_exist]
+    # out-of-tree filter extensions: appended after the built-in pipeline,
+    # each with its own reason row
+    for ext in cfg.extensions:
+        if ext.filter_fn is not None:
+            op_masks.append(ext.filter_fn(state, arrs, x))
 
     # first failing op per node -> per-op failure counts (active nodes only)
     if cfg.fail_reasons:
@@ -421,6 +437,20 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
             state.gpu_used, arrs.gpu_cap_mem, arrs.gpu_slot, x["gpu_mem"], x["gpu_cnt"])
         i_gp_lo = add_row(jnp.where(mask, raw_gp, big))
         i_gp_hi = add_row(jnp.where(mask, -raw_gp, big))
+    ext_scores = []   # (ext, raw, lo_idx, hi_idx)
+    for ext in cfg.extensions:
+        if ext.score_fn is None:
+            continue
+        raw_e = ext.score_fn(state, arrs, x)
+        if ext.normalize == "minmax":
+            ext_scores.append((ext, raw_e,
+                               add_row(jnp.where(mask, raw_e, big)),
+                               add_row(jnp.where(mask, -raw_e, big))))
+        elif ext.normalize == "max":
+            ext_scores.append((ext, raw_e,
+                               None, add_row(jnp.where(mask, -raw_e, 0.0))))
+        else:
+            ext_scores.append((ext, raw_e, None, None))
 
     # variadic reduce: one fused pass, no stacked [Q, N] materialization (a
     # jnp.stack would write ~Q*N floats to HBM per step just to read them
@@ -449,6 +479,13 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         # cnt==0 pods score 0 on the GPU dimension (scalar factor)
         score += (cfg.w_gpu * (x["gpu_cnt"] > 0)) * scores.minmax_apply(
             raw_gp, reds[i_gp_lo], -reds[i_gp_hi])
+    for ext, raw_e, lo_i, hi_i in ext_scores:
+        if lo_i is not None:
+            score += ext.weight * scores.minmax_apply(raw_e, reds[lo_i], -reds[hi_i])
+        elif hi_i is not None:
+            score += ext.weight * scores.max_apply(raw_e, -reds[hi_i])
+        else:
+            score += ext.weight * raw_e
 
     # Preemption retry: a nominated node (status.nominatedNodeName analog,
     # defaultpreemption PostFilter) restricts the pick to that node while it
@@ -694,4 +731,6 @@ def make_config(snapshot: ClusterSnapshot, **overrides) -> EngineConfig:
         enable_pv_match=bool(np.any(a.wfc_valid)),
     )
     kw.update(overrides)
+    if kw.get("extensions"):
+        kw["extensions"] = tuple(e.validate() for e in kw["extensions"])
     return EngineConfig(**kw)

@@ -46,7 +46,9 @@ class Simulator:
         config_overrides: Optional[Dict] = None,
         preemption: bool = True,
     ):
-        self.preemption = preemption
+        self._overrides = dict(config_overrides or {})
+        self.preemption = preemption and not self._overrides.pop(
+            "_disable_preemption", False)
         # preemption state carried across schedule_app calls: victims stay
         # deleted, prior placements stay pinned (kube bound-pods-never-move)
         self._pre_disabled = np.zeros(0, dtype=bool)
@@ -55,7 +57,6 @@ class Simulator:
         self.cluster = cluster
         self.cluster.nodes = [make_valid_node(n) for n in cluster.nodes]
         self._encode_options = encode_options
-        self._overrides = config_overrides or {}
         self._pods: List[Pod] = []
         self._apps: List[AppResource] = []
         self._last: Optional[SimulateResult] = None
@@ -130,6 +131,7 @@ class Simulator:
             gpu_pick=np.asarray(out.gpu_pick) if cfg.enable_gpu else None,
             preempted_by=preempted_by,
             vol_pick=np.asarray(out.vol_pick) if cfg.enable_pv_match else None,
+            extra_op_names=list(cfg.extension_op_names),
         )
         self._last = result
         if select_app is None:

@@ -1,0 +1,133 @@
+"""Out-of-tree extension ops (engine/extensions.ExtensionOp) — the
+WithFrameworkOutOfTreeRegistry analog (pkg/simulator/simulator.go:188-195),
+plus the KubeSchedulerConfiguration filter-disable -> feature-gate mapping
+(VERDICT r3 #5/#6).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from open_simulator_tpu.core import AppResource, simulate
+from open_simulator_tpu.engine.extensions import ExtensionOp
+from open_simulator_tpu.k8s.loader import ClusterResources
+from tests.conftest import make_node, make_pod
+
+
+def _cluster(n_nodes=4):
+    cluster = ClusterResources()
+    cluster.nodes = [make_node(f"n{i}") for i in range(n_nodes)]
+    return cluster
+
+
+def _app(pods):
+    app = ClusterResources()
+    app.pods = pods
+    return app
+
+
+# Worked example 1: a FILTER extension — only even-indexed nodes may host
+# the workload (a stand-in for a real policy like "licensed nodes only").
+# The mask reads the same inputs built-in ops do: snapshot arrays + carry.
+even_nodes_only = ExtensionOp(
+    name="node(s) rejected by the even-index policy",
+    filter_fn=lambda state, arrs, x: (
+        jnp.arange(arrs.alloc.shape[0]) % 2 == 0),
+)
+
+# Worked example 2: a SCORE extension — prefer high-index nodes (a
+# stand-in for e.g. "prefer newest hardware"), framework-normalized and
+# weighted far above the built-in scores.
+prefer_last_node = ExtensionOp(
+    name="prefer-last-node",
+    score_fn=lambda state, arrs, x: jnp.arange(
+        arrs.alloc.shape[0], dtype=jnp.float32),
+    normalize="minmax",
+    weight=1000.0,
+)
+
+
+def test_filter_extension_masks_nodes_and_reports_reason():
+    res = simulate(
+        _cluster(), [AppResource(name="a", resources=_app(
+            [make_pod(f"p{i}", cpu="100m") for i in range(8)]))],
+        config_overrides={"extensions": (even_nodes_only,)},
+    )
+    placed_nodes = set(res.placements().values())
+    assert placed_nodes <= {"n0", "n2"}
+    # reason surfaces when nothing else fits: make the even nodes full
+    res2 = simulate(
+        _cluster(2), [AppResource(name="a", resources=_app(
+            [make_pod("big0", cpu="3900m"), make_pod("big1", cpu="3900m")]))],
+        config_overrides={"extensions": (even_nodes_only,)},
+    )
+    assert len(res2.unscheduled_pods) == 1
+    reason = res2.unscheduled_pods[0].reason
+    assert "1 node(s) rejected by the even-index policy" in reason
+    assert "1 Insufficient cpu" in reason
+
+
+def test_score_extension_changes_ranking():
+    # identical empty nodes: the deterministic tie-break sends the first
+    # pod to n0; the heavily-weighted extension flips the ranking to n3
+    pods = [make_pod("p0", cpu="10m", mem="1Mi")]
+    base = simulate(_cluster(), [AppResource(name="a", resources=_app(pods))])
+    ext = simulate(
+        _cluster(), [AppResource(name="a", resources=_app(pods))],
+        config_overrides={"extensions": (prefer_last_node,)},
+    )
+    assert base.placements() == {"default/p0": "n0"}
+    assert ext.placements() == {"default/p0": "n3"}
+
+
+def test_extension_validation():
+    with pytest.raises(ValueError):
+        ExtensionOp(name="bad", score_fn=lambda *a: 0, normalize="zscore").validate()
+    with pytest.raises(ValueError):
+        ExtensionOp(name="empty").validate()
+
+
+def test_profile_filter_disable_maps_to_gates(tmp_path):
+    """A KubeSchedulerConfiguration that disables filter plugins turns the
+    matching engine gates off (the vendored framework would skip the
+    de-registered plugin the same way)."""
+    from open_simulator_tpu.engine.profile import weight_overrides_from_file
+
+    cfg_file = tmp_path / "sched.yaml"
+    cfg_file.write_text("""
+apiVersion: kubescheduler.config.k8s.io/v1beta2
+kind: KubeSchedulerConfiguration
+profiles:
+  - plugins:
+      filter:
+        disabled:
+          - name: NodePorts
+          - name: InterPodAffinity
+          - name: NodeResourcesFit
+      postFilter:
+        disabled:
+          - name: DefaultPreemption
+""")
+    ov = weight_overrides_from_file(str(cfg_file))
+    assert ov["enable_ports"] is False
+    assert ov["enable_pod_affinity"] is False and ov["enable_anti_affinity"] is False
+    assert ov["_disable_preemption"] is True
+    assert "enable_unsched" not in ov  # untouched plugins keep autodetect
+    # NodeResourcesFit has no gate: ignored (warned), not crashed
+    assert not any(k.startswith("enable_fit") for k in ov)
+
+
+def test_disabled_taint_filter_schedules_onto_tainted_node():
+    """End to end: disabling TaintToleration via the profile gate lets a
+    toleration-less pod land on a tainted node."""
+    cluster = _cluster(1)
+    cluster.nodes[0] = make_node(
+        "n0", taints=[{"key": "dedicated", "value": "x", "effect": "NoSchedule"}])
+    app = _app([make_pod("p0", cpu="100m")])
+    blocked = simulate(cluster, [AppResource(name="a", resources=app)])
+    assert len(blocked.unscheduled_pods) == 1
+    allowed = simulate(
+        cluster, [AppResource(name="a", resources=app)],
+        config_overrides={"enable_class_taint": False},
+    )
+    assert allowed.placements() == {"default/p0": "n0"}
