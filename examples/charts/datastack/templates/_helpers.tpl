@@ -1,0 +1,4 @@
+{{- define "datastack.labels" -}}
+app.kubernetes.io/instance: {{ .Release.Name }}
+team: {{ .Values.global.team | quote }}
+{{- end -}}

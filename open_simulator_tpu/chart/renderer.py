@@ -22,6 +22,8 @@ import os
 import re
 import shutil
 import subprocess
+import tarfile
+import tempfile
 from typing import Any, Dict, List
 
 import yaml
@@ -42,28 +44,71 @@ _INSTALL_ORDER = [
 
 
 def process_chart(path: str, release_name: str = "") -> List[Dict[str, Any]]:
-    """Render a chart directory to parsed YAML docs, install-ordered."""
-    if not os.path.isdir(path):
-        raise ChartError(f"chart path {path} is not a directory (.tgz: extract it first)")
-    chart_yaml = os.path.join(path, "Chart.yaml")
-    if not os.path.exists(chart_yaml):
-        raise ChartError(f"{path}: no Chart.yaml — not a helm chart")
-    with open(chart_yaml, "r", encoding="utf-8") as f:
-        chart_meta = yaml.safe_load(f) or {}
-    if chart_meta.get("type", "application") != "application":
-        raise ChartError(f"chart {chart_meta.get('name')}: only application charts are supported")
-    release = release_name or chart_meta.get("name", os.path.basename(path))
+    """Render a chart directory OR .tgz archive to parsed YAML docs,
+    install-ordered, with `charts/` subchart dependencies resolved
+    (reference: ProcessChart loads both forms and processes dependencies,
+    pkg/chart/chart.go:19,31)."""
+    tmpdir = None
+    try:
+        if os.path.isfile(path) and path.endswith((".tgz", ".tar.gz")):
+            tmpdir = tempfile.mkdtemp(prefix="chart-")
+            path = _extract_chart_archive(path, tmpdir)
+        if not os.path.isdir(path):
+            raise ChartError(f"chart path {path} is not a directory or .tgz archive")
+        chart_meta = _load_chart_meta(path)
+        if chart_meta.get("type", "application") != "application":
+            raise ChartError(
+                f"chart {chart_meta.get('name')}: only application charts are supported")
+        release = release_name or chart_meta.get("name", os.path.basename(path))
 
-    if shutil.which("helm"):
-        docs = _render_with_helm(path, release)
-    else:
-        docs = _render_builtin(path, chart_meta, release)
+        if shutil.which("helm"):
+            docs = _render_with_helm(path, release)
+        else:
+            docs = _render_builtin(path, chart_meta, release)
+    finally:
+        if tmpdir:
+            shutil.rmtree(tmpdir, ignore_errors=True)
 
     def order_key(d: Dict[str, Any]) -> int:
         kind = d.get("kind", "")
         return _INSTALL_ORDER.index(kind) if kind in _INSTALL_ORDER else len(_INSTALL_ORDER)
 
     return sorted(docs, key=order_key)
+
+
+def _load_chart_meta(path: str) -> Dict[str, Any]:
+    chart_yaml = os.path.join(path, "Chart.yaml")
+    if not os.path.exists(chart_yaml):
+        raise ChartError(f"{path}: no Chart.yaml — not a helm chart")
+    with open(chart_yaml, "r", encoding="utf-8") as f:
+        return yaml.safe_load(f) or {}
+
+
+def _extract_chart_archive(archive: str, dest: str) -> str:
+    """Safely extract a chart .tgz; returns the chart root (the directory
+    holding Chart.yaml — helm archives nest it under the chart name)."""
+    try:
+        tf = tarfile.open(archive, "r:gz")
+    except (tarfile.TarError, OSError) as e:
+        raise ChartError(f"{archive}: not a readable chart archive: {e}") from e
+    with tf:
+        for member in tf.getmembers():
+            p = member.name
+            if p.startswith("/") or ".." in p.split("/"):
+                raise ChartError(f"{archive}: unsafe path {p!r} in archive")
+            if member.issym() or member.islnk():
+                raise ChartError(f"{archive}: links not allowed in chart archives")
+        try:
+            tf.extractall(dest, filter="data")
+        except TypeError:  # older tarfile without extraction filters
+            tf.extractall(dest)
+    if os.path.exists(os.path.join(dest, "Chart.yaml")):
+        return dest
+    roots = [d for d in sorted(os.listdir(dest))
+             if os.path.exists(os.path.join(dest, d, "Chart.yaml"))]
+    if len(roots) != 1:
+        raise ChartError(f"{archive}: expected one chart root, found {roots}")
+    return os.path.join(dest, roots[0])
 
 
 def _render_with_helm(path: str, release: str) -> List[Dict[str, Any]]:
@@ -477,41 +522,148 @@ def _render_template(text: str, ctx: Dict[str, Any], origin: str,
     return _render_nodes(nodes, sc)
 
 
-def _render_builtin(path: str, chart_meta: Dict[str, Any], release: str) -> List[Dict[str, Any]]:
+def _deep_merge(base: Dict[str, Any], overlay: Dict[str, Any]) -> Dict[str, Any]:
+    """Helm coalesce: overlay wins; dicts merge recursively."""
+    out = dict(base)
+    for k, v in (overlay or {}).items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _chart_values(path: str) -> Dict[str, Any]:
     values_path = os.path.join(path, "values.yaml")
-    values: Dict[str, Any] = {}
     if os.path.exists(values_path):
         with open(values_path, "r", encoding="utf-8") as f:
-            values = yaml.safe_load(f) or {}
+            return yaml.safe_load(f) or {}
+    return {}
+
+
+class _RenderCtx:
+    """Per-render bookkeeping: each .tgz subchart is extracted ONCE (the
+    define pass and the render pass share the cache) and every work dir is
+    removed when the render finishes."""
+
+    def __init__(self) -> None:
+        self.extracted: Dict[str, str] = {}
+        self.workdirs: List[str] = []
+
+    def cleanup(self) -> None:
+        for w in self.workdirs:
+            shutil.rmtree(w, ignore_errors=True)
+
+
+def _subchart_dirs(path: str, rctx: _RenderCtx) -> List[str]:
+    """charts/ entries: unpacked directories and .tgz archives."""
+    charts_dir = os.path.join(path, "charts")
+    if not os.path.isdir(charts_dir):
+        return []
+    out = []
+    for entry in sorted(os.listdir(charts_dir)):
+        full = os.path.join(charts_dir, entry)
+        if os.path.isdir(full) and os.path.exists(os.path.join(full, "Chart.yaml")):
+            out.append(full)
+        elif os.path.isfile(full) and entry.endswith((".tgz", ".tar.gz")):
+            if full not in rctx.extracted:
+                work = tempfile.mkdtemp(prefix="subchart-")
+                rctx.workdirs.append(work)
+                rctx.extracted[full] = _extract_chart_archive(full, work)
+            out.append(rctx.extracted[full])
+    return out
+
+
+def _dependency_enabled(dep: Dict[str, Any], parent_values: Dict[str, Any]) -> bool:
+    """Chart.yaml dependencies[].condition: the first path that resolves in
+    the parent values decides; unresolvable -> enabled (helm semantics)."""
+    cond = dep.get("condition")
+    if not cond:
+        return True
+    for p in str(cond).split(","):
+        v = _lookup_path(parent_values, p.strip())
+        if v is not None:
+            return bool(v)
+    return True
+
+
+def _collect_defines(path: str, defines: Dict[str, list], rctx: _RenderCtx) -> None:
+    """{{ define }} blocks share one namespace across the whole chart tree
+    (helm's template registry), so parents can include subchart helpers.
+    Pre-order + setdefault gives shallower charts precedence: a parent's
+    same-named define overrides a subchart's, like helm's registry."""
+    tmpl_dir = os.path.join(path, "templates")
+    if os.path.isdir(tmpl_dir):
+        for fname in sorted(os.listdir(tmpl_dir)):
+            if fname.startswith("_") and fname.endswith((".tpl", ".yaml", ".yml")):
+                with open(os.path.join(tmpl_dir, fname), "r", encoding="utf-8") as f:
+                    nodes, _, _ = _parse(_tokenize(f.read()), 0, fname)
+                for node in nodes:
+                    if node[0] == "define":
+                        defines.setdefault(node[1], node[2])
+    for sub in _subchart_dirs(path, rctx):
+        _collect_defines(sub, defines, rctx)
+
+
+def _render_one_chart(
+    path: str,
+    chart_meta: Dict[str, Any],
+    values: Dict[str, Any],
+    release: str,
+    defines: Dict[str, list],
+    docs: List[Dict[str, Any]],
+    rctx: _RenderCtx,
+) -> None:
     ctx = {
         "Values": values,
         "Release": {"Name": release, "Namespace": "default", "Service": "Helm"},
         "Chart": {"Name": chart_meta.get("name", ""), "Version": chart_meta.get("version", "")},
     }
-    docs: List[Dict[str, Any]] = []
     tmpl_dir = os.path.join(path, "templates")
-    if not os.path.isdir(tmpl_dir):
-        return docs
-    # pass 1: collect {{ define }} blocks from helper files (_helpers.tpl etc.)
-    defines: Dict[str, list] = {}
-    for fname in sorted(os.listdir(tmpl_dir)):
-        if fname.startswith("_") and fname.endswith((".tpl", ".yaml", ".yml")):
+    if os.path.isdir(tmpl_dir):
+        for fname in sorted(os.listdir(tmpl_dir)):
+            if fname == "NOTES.txt" or fname.startswith("_") or not fname.endswith((".yaml", ".yml")):
+                continue
             with open(os.path.join(tmpl_dir, fname), "r", encoding="utf-8") as f:
-                nodes, _, _ = _parse(_tokenize(f.read()), 0, fname)
-            for node in nodes:
-                if node[0] == "define":
-                    defines[node[1]] = node[2]
-    # pass 2: render manifests with the shared define registry
-    for fname in sorted(os.listdir(tmpl_dir)):
-        if fname == "NOTES.txt" or fname.startswith("_") or not fname.endswith((".yaml", ".yml")):
+                rendered = _render_template(
+                    f.read(), ctx, f"{os.path.basename(path)}/{fname}",
+                    defines=dict(defines),
+                )
+            for doc in yaml.safe_load_all(rendered):
+                if isinstance(doc, dict) and doc.get("kind"):
+                    doc.setdefault("metadata", {}).setdefault("namespace", "default")
+                    docs.append(doc)
+    # dependencies: subchart values = subchart defaults <- parent override
+    # block (parent values key == subchart name), plus merged `global`
+    deps_meta = {d.get("name"): d for d in chart_meta.get("dependencies") or []}
+    for sub in _subchart_dirs(path, rctx):
+        sub_meta = _load_chart_meta(sub)
+        sub_name = sub_meta.get("name", os.path.basename(sub))
+        dep = deps_meta.get(sub_name, {})
+        if sub_name in deps_meta and not _dependency_enabled(dep, values):
             continue
-        fpath = os.path.join(tmpl_dir, fname)
-        with open(fpath, "r", encoding="utf-8") as f:
-            rendered = _render_template(
-                f.read(), ctx, f"{os.path.basename(path)}/{fname}", defines=dict(defines)
-            )
-        for doc in yaml.safe_load_all(rendered):
-            if isinstance(doc, dict) and doc.get("kind"):
-                doc.setdefault("metadata", {}).setdefault("namespace", "default")
-                docs.append(doc)
+        override = values.get(sub_name) or {}
+        if not isinstance(override, dict):
+            raise ChartError(
+                f"chart {chart_meta.get('name')}: values key {sub_name!r} "
+                f"must be a mapping to override subchart values "
+                f"(got {type(override).__name__})")
+        sub_values = _deep_merge(_chart_values(sub), override)
+        merged_global = _deep_merge(sub_values.get("global") or {},
+                                    values.get("global") or {})
+        if merged_global:
+            sub_values["global"] = merged_global
+        _render_one_chart(sub, sub_meta, sub_values, release, defines, docs, rctx)
+
+
+def _render_builtin(path: str, chart_meta: Dict[str, Any], release: str) -> List[Dict[str, Any]]:
+    docs: List[Dict[str, Any]] = []
+    defines: Dict[str, list] = {}
+    rctx = _RenderCtx()
+    try:
+        _collect_defines(path, defines, rctx)
+        _render_one_chart(path, chart_meta, _chart_values(path), release,
+                          defines, docs, rctx)
+    finally:
+        rctx.cleanup()
     return docs

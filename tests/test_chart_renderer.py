@@ -257,3 +257,112 @@ def test_if_block_scopes_variable_declarations(tmp_path):
     path = write_chart(tmp_path, values, {"cm.yaml": tmpl})
     docs = process_chart(path)
     assert docs[0]["metadata"]["name"] == "outer"
+
+
+# ---- round 4: archives + subchart dependencies (ProcessChart parity,
+# pkg/chart/chart.go:19,31) --------------------------------------------
+
+def _datastack_dir():
+    import os
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "examples", "charts", "datastack")
+
+
+def test_subchart_dependencies_values_and_globals():
+    """Parent values block overrides subchart defaults; `global` propagates;
+    a .tgz subchart inside charts/ renders too."""
+    from open_simulator_tpu.chart.renderer import process_chart
+
+    docs = {(d["kind"], d["metadata"]["name"]): d
+            for d in process_chart(_datastack_dir())}
+    sts = docs[("StatefulSet", "datastack-cache")]
+    assert sts["spec"]["replicas"] == 2                       # parent override (default 1)
+    assert sts["metadata"]["labels"]["team"] == "data"        # global propagated
+    assert ("Job", "datastack-worker-jobs") in docs           # .tgz subchart + override
+
+
+def test_chart_tgz_archive_renders_like_directory(tmp_path):
+    import tarfile
+
+    from open_simulator_tpu.chart.renderer import process_chart
+
+    src = _datastack_dir()
+    tgz = tmp_path / "datastack-0.1.0.tgz"
+    with tarfile.open(tgz, "w:gz") as tf:
+        tf.add(src, arcname="datastack")
+    assert process_chart(str(tgz)) == process_chart(src)
+
+
+def test_dependency_condition_disables_subchart(tmp_path):
+    import shutil as sh
+
+    from open_simulator_tpu.chart.renderer import process_chart
+
+    work = tmp_path / "datastack"
+    sh.copytree(_datastack_dir(), work)
+    values = work / "values.yaml"
+    values.write_text(values.read_text().replace(
+        "cache:\n  enabled: true", "cache:\n  enabled: false"))
+    kinds = {d["kind"] for d in process_chart(str(work))}
+    assert "StatefulSet" not in kinds
+    assert "Deployment" in kinds and "Job" in kinds
+
+
+def test_unsafe_archive_rejected(tmp_path):
+    import tarfile
+
+    from open_simulator_tpu.chart.renderer import ChartError, process_chart
+    import pytest as _pytest
+
+    evil = tmp_path / "evil.tgz"
+    payload = tmp_path / "x"
+    payload.write_text("boom")
+    with tarfile.open(evil, "w:gz") as tf:
+        tf.add(payload, arcname="../escape")
+    with _pytest.raises(ChartError, match="unsafe path"):
+        process_chart(str(evil))
+
+
+def test_scalar_subchart_override_is_a_chart_error(tmp_path):
+    import shutil as sh
+
+    import pytest as _pytest
+
+    from open_simulator_tpu.chart.renderer import ChartError, process_chart
+
+    work = tmp_path / "datastack"
+    sh.copytree(_datastack_dir(), work)
+    values = work / "values.yaml"
+    values.write_text(values.read_text().replace(
+        "cache:\n  enabled: true\n  replicas: 2        # overrides the subchart default of 1",
+        "cache: disabled"))
+    with _pytest.raises(ChartError, match="must be a mapping"):
+        process_chart(str(work))
+
+
+def test_corrupt_tgz_is_a_chart_error(tmp_path):
+    import pytest as _pytest
+
+    from open_simulator_tpu.chart.renderer import ChartError, process_chart
+
+    bad = tmp_path / "bad.tgz"
+    bad.write_bytes(b"this is not gzip")
+    with _pytest.raises(ChartError, match="not a readable chart archive"):
+        process_chart(str(bad))
+
+
+def test_no_subchart_tempdir_leak(tmp_path, monkeypatch):
+    """Each render extracts every .tgz subchart exactly once and removes
+    its work dirs afterwards."""
+    import tempfile as _tempfile
+
+    from open_simulator_tpu.chart.renderer import process_chart
+
+    monkeypatch.setenv("TMPDIR", str(tmp_path))
+    _tempfile.tempdir = None  # re-read TMPDIR
+    try:
+        process_chart(_datastack_dir())
+        leftovers = [d for d in tmp_path.iterdir() if d.name.startswith("subchart-")]
+        assert leftovers == []
+    finally:
+        _tempfile.tempdir = None
