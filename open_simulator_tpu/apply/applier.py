@@ -93,15 +93,25 @@ def build_cluster_from_config(config: SimonConfig, base_dir: str) -> ClusterReso
     golden regression tests so both exercise the same assembly path)."""
     cc = config.cluster
     if cc.kube_config:
-        raise ApplyError(
-            "cluster.kubeConfig requires a live Kubernetes API; this "
-            "environment has no cluster access — use cluster.customConfig "
-            "(or the REST server's snapshot request body)"
+        # live-cluster seam: kubeConfig points at a RECORDED API DUMP
+        # (kubectl get ... -o json), replayed with the reference's
+        # CreateClusterResourceFromClient snapshot semantics; an actual
+        # kubeconfig fails with the record-a-dump recipe
+        from open_simulator_tpu.k8s.cluster_source import (
+            ClusterSourceError,
+            resolve_cluster_source,
         )
-    path = os.path.join(base_dir, cc.custom_config)
-    cluster = load_resources_from_directory(path, strict=False)
+
+        path = os.path.join(base_dir, cc.kube_config)
+        try:
+            cluster = resolve_cluster_source(path).load()
+        except ClusterSourceError as e:
+            raise ApplyError(str(e)) from e
+    else:
+        path = os.path.join(base_dir, cc.custom_config)
+        cluster = load_resources_from_directory(path, strict=False)
     if not cluster.nodes:
-        raise ApplyError(f"cluster customConfig {path} contains no nodes")
+        raise ApplyError(f"cluster source {path} contains no nodes")
     cluster.nodes = [make_valid_node(n) for n in cluster.nodes]
     return cluster
 

@@ -587,22 +587,67 @@ def _dependency_enabled(dep: Dict[str, Any], parent_values: Dict[str, Any]) -> b
     return True
 
 
-def _collect_defines(path: str, defines: Dict[str, list], rctx: _RenderCtx) -> None:
-    """{{ define }} blocks share one namespace across the whole chart tree
-    (helm's template registry), so parents can include subchart helpers.
-    Pre-order + setdefault gives shallower charts precedence: a parent's
-    same-named define overrides a subchart's, like helm's registry."""
-    tmpl_dir = os.path.join(path, "templates")
-    if os.path.isdir(tmpl_dir):
-        for fname in sorted(os.listdir(tmpl_dir)):
-            if fname.startswith("_") and fname.endswith((".tpl", ".yaml", ".yml")):
-                with open(os.path.join(tmpl_dir, fname), "r", encoding="utf-8") as f:
-                    nodes, _, _ = _parse(_tokenize(f.read()), 0, fname)
-                for node in nodes:
-                    if node[0] == "define":
-                        defines.setdefault(node[1], node[2])
+def _chart_tree(
+    path: str,
+    chart_meta: Dict[str, Any],
+    values: Dict[str, Any],
+    rctx: _RenderCtx,
+) -> List[tuple]:
+    """Pre-order (path, meta, values) list of the ENABLED chart tree:
+    dependency conditions are evaluated here, so disabled subcharts
+    contribute neither manifests nor {{ define }} blocks (helm prunes
+    them before loading templates). A dependency declared in Chart.yaml
+    but missing from charts/ is an error, like helm's
+    'found in Chart.yaml, but missing in charts/ directory'."""
+    out = [(path, chart_meta, values)]
+    deps_meta = {d.get("name"): d for d in chart_meta.get("dependencies") or []}
+    found_names = set()
     for sub in _subchart_dirs(path, rctx):
-        _collect_defines(sub, defines, rctx)
+        sub_meta = _load_chart_meta(sub)
+        sub_name = sub_meta.get("name", os.path.basename(sub))
+        found_names.add(sub_name)
+        dep = deps_meta.get(sub_name, {})
+        if sub_name in deps_meta and not _dependency_enabled(dep, values):
+            continue
+        override = values.get(sub_name)
+        if override is not None and not isinstance(override, dict):
+            # helm's coalesce errors on a non-table destination too; this
+            # also catches `cache: false` (use the dependency condition
+            # `cache.enabled` to disable a subchart)
+            raise ChartError(
+                f"chart {chart_meta.get('name')}: values key {sub_name!r} "
+                f"must be a mapping to override subchart values "
+                f"(got {type(override).__name__}); to disable the "
+                f"dependency use its condition, e.g. {sub_name}.enabled")
+        sub_values = _deep_merge(_chart_values(sub), override or {})
+        merged_global = _deep_merge(sub_values.get("global") or {},
+                                    values.get("global") or {})
+        if merged_global:
+            sub_values["global"] = merged_global
+        out.extend(_chart_tree(sub, sub_meta, sub_values, rctx))
+    missing = [n for n, d in deps_meta.items()
+               if n not in found_names and _dependency_enabled(d, values)]
+    if missing:
+        raise ChartError(
+            f"chart {chart_meta.get('name')}: dependencies {missing} found "
+            f"in Chart.yaml, but missing in charts/ directory")
+    return out
+
+
+def _chart_defines(path: str, defines: Dict[str, list]) -> None:
+    """Collect {{ define }} blocks from one chart's helper files into the
+    shared registry (setdefault: pre-order callers give shallower charts
+    precedence, like helm — a parent's same-named define wins)."""
+    tmpl_dir = os.path.join(path, "templates")
+    if not os.path.isdir(tmpl_dir):
+        return
+    for fname in sorted(os.listdir(tmpl_dir)):
+        if fname.startswith("_") and fname.endswith((".tpl", ".yaml", ".yml")):
+            with open(os.path.join(tmpl_dir, fname), "r", encoding="utf-8") as f:
+                nodes, _, _ = _parse(_tokenize(f.read()), 0, fname)
+            for node in nodes:
+                if node[0] == "define":
+                    defines.setdefault(node[1], node[2])
 
 
 def _render_one_chart(
@@ -612,7 +657,6 @@ def _render_one_chart(
     release: str,
     defines: Dict[str, list],
     docs: List[Dict[str, Any]],
-    rctx: _RenderCtx,
 ) -> None:
     ctx = {
         "Values": values,
@@ -620,40 +664,20 @@ def _render_one_chart(
         "Chart": {"Name": chart_meta.get("name", ""), "Version": chart_meta.get("version", "")},
     }
     tmpl_dir = os.path.join(path, "templates")
-    if os.path.isdir(tmpl_dir):
-        for fname in sorted(os.listdir(tmpl_dir)):
-            if fname == "NOTES.txt" or fname.startswith("_") or not fname.endswith((".yaml", ".yml")):
-                continue
-            with open(os.path.join(tmpl_dir, fname), "r", encoding="utf-8") as f:
-                rendered = _render_template(
-                    f.read(), ctx, f"{os.path.basename(path)}/{fname}",
-                    defines=dict(defines),
-                )
-            for doc in yaml.safe_load_all(rendered):
-                if isinstance(doc, dict) and doc.get("kind"):
-                    doc.setdefault("metadata", {}).setdefault("namespace", "default")
-                    docs.append(doc)
-    # dependencies: subchart values = subchart defaults <- parent override
-    # block (parent values key == subchart name), plus merged `global`
-    deps_meta = {d.get("name"): d for d in chart_meta.get("dependencies") or []}
-    for sub in _subchart_dirs(path, rctx):
-        sub_meta = _load_chart_meta(sub)
-        sub_name = sub_meta.get("name", os.path.basename(sub))
-        dep = deps_meta.get(sub_name, {})
-        if sub_name in deps_meta and not _dependency_enabled(dep, values):
+    if not os.path.isdir(tmpl_dir):
+        return
+    for fname in sorted(os.listdir(tmpl_dir)):
+        if fname == "NOTES.txt" or fname.startswith("_") or not fname.endswith((".yaml", ".yml")):
             continue
-        override = values.get(sub_name) or {}
-        if not isinstance(override, dict):
-            raise ChartError(
-                f"chart {chart_meta.get('name')}: values key {sub_name!r} "
-                f"must be a mapping to override subchart values "
-                f"(got {type(override).__name__})")
-        sub_values = _deep_merge(_chart_values(sub), override)
-        merged_global = _deep_merge(sub_values.get("global") or {},
-                                    values.get("global") or {})
-        if merged_global:
-            sub_values["global"] = merged_global
-        _render_one_chart(sub, sub_meta, sub_values, release, defines, docs, rctx)
+        with open(os.path.join(tmpl_dir, fname), "r", encoding="utf-8") as f:
+            rendered = _render_template(
+                f.read(), ctx, f"{os.path.basename(path)}/{fname}",
+                defines=dict(defines),
+            )
+        for doc in yaml.safe_load_all(rendered):
+            if isinstance(doc, dict) and doc.get("kind"):
+                doc.setdefault("metadata", {}).setdefault("namespace", "default")
+                docs.append(doc)
 
 
 def _render_builtin(path: str, chart_meta: Dict[str, Any], release: str) -> List[Dict[str, Any]]:
@@ -661,9 +685,11 @@ def _render_builtin(path: str, chart_meta: Dict[str, Any], release: str) -> List
     defines: Dict[str, list] = {}
     rctx = _RenderCtx()
     try:
-        _collect_defines(path, defines, rctx)
-        _render_one_chart(path, chart_meta, _chart_values(path), release,
-                          defines, docs, rctx)
+        tree = _chart_tree(path, chart_meta, _chart_values(path), rctx)
+        for p, _, _ in tree:
+            _chart_defines(p, defines)
+        for p, meta, vals in tree:
+            _render_one_chart(p, meta, vals, release, defines, docs)
     finally:
         rctx.cleanup()
     return docs

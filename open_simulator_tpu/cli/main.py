@@ -38,7 +38,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("server", help="REST simulation server")
     sp.add_argument("--port", type=int, default=8899)
     sp.add_argument("--address", default="127.0.0.1")
-    sp.add_argument("--kubeconfig", default="", help="(unsupported here: no live cluster access)")
+    sp.add_argument(
+        "--kubeconfig", default="",
+        help="recorded cluster API dump (kubectl get ... -A -o json), "
+             "replayed with the reference's live-snapshot semantics; an "
+             "actual kubeconfig fails with the recording recipe (no live "
+             "cluster access in this environment)")
     sp.add_argument("--master", default="", help="(unsupported here: no live cluster access)")
     sp.add_argument("--cluster-config", default="", help="cluster YAML dir serving as the live-cluster stand-in")
 

@@ -44,8 +44,11 @@ from open_simulator_tpu.k8s.objects import LABEL_APP_NAME, Node
 
 
 class SimulationServer:
-    def __init__(self, cluster_config: str = ""):
+    def __init__(self, cluster_config: str = "", kubeconfig: str = ""):
         self.cluster_config = cluster_config
+        # recorded API dump standing in for the reference's 10 live
+        # informers (pkg/server/server.go:97-137; no cluster access here)
+        self.kubeconfig = kubeconfig
         self._lock = threading.Lock()
 
     # ---- cluster snapshot ---------------------------------------------
@@ -56,9 +59,15 @@ class SimulationServer:
             for doc in parse_yaml_documents(inline["yaml"]):
                 demux_object(doc, res)
             return res
+        if self.kubeconfig:
+            from open_simulator_tpu.k8s.cluster_source import resolve_cluster_source
+
+            return resolve_cluster_source(self.kubeconfig).load()
         if self.cluster_config:
             return load_resources_from_directory(self.cluster_config)
-        raise ValueError("no cluster snapshot: start with --cluster-config or pass request.cluster.yaml")
+        raise ValueError(
+            "no cluster snapshot: start with --cluster-config / --kubeconfig "
+            "(a recorded API dump) or pass request.cluster.yaml")
 
     # ---- handlers ------------------------------------------------------
 
@@ -215,9 +224,12 @@ def _make_handler(server: SimulationServer):
 def serve(address: str = "127.0.0.1", port: int = 8899, cluster_config: str = "",
           kubeconfig: str = "") -> int:
     if kubeconfig:
-        print("warning: --kubeconfig is not supported in this environment "
-              "(no live cluster); using --cluster-config snapshot instead")
-    sim_server = SimulationServer(cluster_config=cluster_config)
+        # validate up front so a real kubeconfig fails fast with the
+        # record-a-dump recipe instead of 500s per request
+        from open_simulator_tpu.k8s.cluster_source import resolve_cluster_source
+
+        resolve_cluster_source(kubeconfig).load()
+    sim_server = SimulationServer(cluster_config=cluster_config, kubeconfig=kubeconfig)
     httpd = ThreadingHTTPServer((address, port), _make_handler(sim_server))
     print(f"simon-tpu server listening on http://{address}:{port}")
     try:

@@ -366,3 +366,66 @@ def test_no_subchart_tempdir_leak(tmp_path, monkeypatch):
         assert leftovers == []
     finally:
         _tempfile.tempdir = None
+
+
+def test_false_scalar_override_also_errors_toward_condition(tmp_path):
+    """`cache: false` (disable intent) must not silently render the
+    subchart with defaults — the error points at the dependency condition."""
+    import shutil as sh
+
+    import pytest as _pytest
+
+    from open_simulator_tpu.chart.renderer import ChartError, process_chart
+
+    work = tmp_path / "datastack"
+    sh.copytree(_datastack_dir(), work)
+    values = work / "values.yaml"
+    values.write_text(values.read_text().replace(
+        "cache:\n  enabled: true\n  replicas: 2        # overrides the subchart default of 1",
+        "cache: false"))
+    with _pytest.raises(ChartError, match="cache.enabled"):
+        process_chart(str(work))
+
+
+def test_missing_vendored_dependency_errors(tmp_path):
+    """A Chart.yaml dependency with no charts/ entry fails like helm's
+    'missing in charts/ directory' instead of silently under-rendering."""
+    import os as _os
+    import shutil as sh
+
+    import pytest as _pytest
+
+    from open_simulator_tpu.chart.renderer import ChartError, process_chart
+
+    work = tmp_path / "datastack"
+    sh.copytree(_datastack_dir(), work)
+    _os.remove(work / "charts" / "worker-0.1.0.tgz")
+    with _pytest.raises(ChartError, match="missing in charts/ directory"):
+        process_chart(str(work))
+    # ...unless the dependency's condition disables it
+    values = work / "values.yaml"
+    values.write_text(values.read_text().replace(
+        "worker:\n  enabled: true", "worker:\n  enabled: false"))
+    kinds = {d["kind"] for d in process_chart(str(work))}
+    assert "Job" not in kinds and "Deployment" in kinds
+
+
+def test_disabled_subchart_defines_do_not_shadow(tmp_path):
+    """A disabled dependency's {{ define }} blocks stay out of the shared
+    registry (helm prunes disabled charts before loading templates)."""
+    import shutil as sh
+
+    from open_simulator_tpu.chart.renderer import process_chart
+
+    work = tmp_path / "datastack"
+    sh.copytree(_datastack_dir(), work)
+    # give the cache subchart a same-named helper that would shadow the
+    # parent's if (wrongly) collected while disabled
+    helper = work / "charts" / "cache" / "templates" / "_helpers.tpl"
+    helper.write_text(
+        '{{- define "datastack.labels" -}}\nteam: "WRONG"\n{{- end -}}\n')
+    values = work / "values.yaml"
+    values.write_text(values.read_text().replace(
+        "cache:\n  enabled: true", "cache:\n  enabled: false"))
+    docs = {d["kind"]: d for d in process_chart(str(work))}
+    assert docs["Deployment"]["metadata"]["labels"]["team"] == "data"
