@@ -112,6 +112,15 @@ class EngineConfig(NamedTuple):
     # rows after the built-in table; score extensions join the weighted sum
     # (and the shared normalize reduction).
     extensions: Tuple = ()
+    # Length of the leading run of forced-bind pods (spec.nodeName) whose
+    # carry contributions are applied as ONE batched scatter before the
+    # scan instead of one scan step each — a live-cluster snapshot starts
+    # with thousands of bound pods, each of which would otherwise pay a
+    # full filter/score/argmax step for a predetermined answer.
+    # make_config autodetects; 0 disables. Only set when the prefix pods
+    # carry no gpu/storage/WFC-volume claims (those picks are
+    # order-dependent within the prefix) and no extensions are registered.
+    forced_prefix: int = 0
 
     @property
     def enable_spread(self) -> bool:
@@ -206,6 +215,89 @@ def init_state(arrs: SnapshotArrays, cfg: "EngineConfig | None" = None) -> SimSt
         dom_count=jnp.zeros((k1, d, s), f32),
         pv_taken=jnp.zeros((arrs.pv_node_ok.shape[0],), dtype=bool),
     )
+
+
+_PREFIX_CHUNK = 4096  # bounds the [chunk, N] work tensors (~84MB at N=5120)
+
+
+def apply_forced_prefix(arrs: SnapshotArrays, cfg: EngineConfig,
+                        state: SimState, k: int) -> SimState:
+    """Fold the first k pods' (all forced-bind) carry contributions into
+    the state with batched scatters — exactly what k scan steps of the
+    forced fast path would do, in one shot.
+
+    Exactness: count carries (group_count/dom_count/term_block/ports) add
+    0/1 increments — order-free, and all matmuls run at Precision.HIGHEST
+    so the MXU does not round f32 operands through bf16. `used` sums
+    float requests; k8s requests are integer-valued in their encoded
+    units (milli-cpu, MiB, counts), so the scatter-add matches the
+    sequential sum bit-for-bit below 2^24 per cell. The
+    gpu/storage/WFC-volume carries are order-DEPENDENT per pod, so
+    make_config only enables the prefix when no prefix pod uses them.
+
+    Memory: the prefix is processed in _PREFIX_CHUNK batches and every
+    intermediate is at most [chunk, N] or [N, T] — no [T, k, N] tensors.
+    """
+    for start in range(0, k, _PREFIX_CHUNK):
+        state = _apply_prefix_chunk(arrs, cfg, state, start,
+                                    min(start + _PREFIX_CHUNK, k))
+    return state
+
+
+def _apply_prefix_chunk(arrs: SnapshotArrays, cfg: EngineConfig,
+                        state: SimState, lo: int, hi: int) -> SimState:
+    f32 = jnp.float32
+    hp = jax.lax.Precision.HIGHEST
+    idx = arrs.forced_node[lo:hi].astype(jnp.int32)       # [c], all >= 0
+    oh = jax.nn.one_hot(idx, arrs.alloc.shape[0], dtype=f32)   # [c, N]
+    used = state.used + jnp.matmul(oh.T, arrs.req[lo:hi], precision=hp)
+    gc = state.group_count
+    match = arrs.match_groups[lo:hi].astype(f32)
+    if cfg.needs_group_count:
+        gc = gc + jnp.matmul(oh.T, match, precision=hp).astype(gc.dtype)
+    dom = state.dom_count
+    if cfg.enable_spread:
+        # dom_row per pod = topo_onehot[:, idx_i, :]  -> [K1, c, D]
+        topo_sel = jnp.take(arrs.topo_onehot, idx, axis=1)
+        dom = dom + jnp.einsum("akd,ks->ads", topo_sel, match, precision=hp)
+    ports = state.ports_used
+    if cfg.enable_ports:
+        ports = ports | (
+            jnp.matmul(oh.T, arrs.ports[lo:hi].astype(f32), precision=hp) > 0)
+    term = state.term_block
+    pref = state.pref_paint
+    if cfg.enable_anti_affinity or cfg.enable_pref:
+        # sd_all[key][pod, node]: nodes sharing pod i's bound node's domain
+        k1 = arrs.topo_onehot.shape[0]
+        sd_all = [oh]  # hostname
+        for kk in range(k1):
+            sd_all.append(jnp.matmul(
+                jnp.take(arrs.topo_onehot[kk], idx, axis=0),
+                arrs.topo_onehot[kk].T, precision=hp))    # [c, N]
+    if cfg.enable_anti_affinity:
+        own = arrs.own_terms[lo:hi].astype(f32)           # [c, T]
+        paint = jnp.zeros((state.used.shape[0], own.shape[1]), f32)
+        for kk in range(len(sd_all)):                     # K is tiny
+            mask_t = (arrs.term_key == kk).astype(f32)    # [T]
+            paint = paint + jnp.matmul(
+                sd_all[kk].T, own * mask_t[None, :], precision=hp)
+        term = term + paint.astype(term.dtype)
+    if cfg.enable_pref:
+        t2_n = state.pref_paint.shape[1]
+        for a in range(arrs.pref_group.shape[1]):         # Ap is tiny
+            w = (arrs.pref_weight[lo:hi, a]
+                 * arrs.pref_valid[lo:hi, a].astype(f32))     # [c]
+            key_a = arrs.pref_key[lo:hi, a]                   # [c]
+            # per-pod same-domain row under this slot's key (selected
+            # without stacking a [K, c, N] tensor)
+            sd_a = jnp.zeros_like(sd_all[0])                  # [c, N]
+            for kk in range(len(sd_all)):
+                sd_a = sd_a + sd_all[kk] * (key_a == kk).astype(f32)[:, None]
+            col = jax.nn.one_hot(arrs.pref_tid[lo:hi, a], t2_n, dtype=f32)
+            pref = pref + jnp.matmul(
+                sd_a.T, col * w[:, None], precision=hp)
+    return SimState(used, gc, term, pref, ports, state.gpu_used,
+                    state.vg_used, state.sdev_taken, dom, state.pv_taken)
 
 
 def _pod_xs(arrs: SnapshotArrays) -> Dict[str, jnp.ndarray]:
@@ -394,10 +486,11 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         state.used, arrs.alloc, inv_alloc, x["req"], cfg.cpu_mem_idx,
         cfg.w_balanced, cfg.w_least, cfg.w_most)
 
-    # row 0: any-feasible probe (min == 0 iff some node is feasible).
-    # Rides the variadic min so selectHost can use plain jnp.argmax — a
-    # custom (max, index) tuple-reduce was measured 2.4x slower than XLA's
-    # optimized argmax lowering (generic comparator path, see ROADMAP).
+    # row 0: any-feasible probe (min == 0 iff some node is feasible),
+    # riding the variadic min. selectHost below is two monoid reduces
+    # (max + min-index-among-maxima); a (max, index) tuple-reduce was
+    # measured ~2.4x a plain min/max (generic comparator path) and plain
+    # jnp.argmax lowers through that same path — see ROADMAP r4 notes.
     red_rows = [jnp.where(mask, 0.0, big)]
 
     def add_row(vec):
@@ -506,7 +599,14 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         key = jax.random.fold_in(jax.random.PRNGKey(cfg.tie_break_seed), x["_pod_index"])
         jitter = jax.random.uniform(key, (n_nodes,), minval=0.0, maxval=0.5)
         score = jnp.round(score) + jitter
-    sel_node = jnp.argmax(jnp.where(mask, score, neg_inf)).astype(jnp.int32)
+    # selectHost as two MONOID reduces (max, then min index among exact
+    # maxima) — XLA lowers jnp.argmax through the generic tuple-comparator
+    # reduce, measured ~2.4x the cost of a plain min/max at [64, 5184]
+    masked_score = jnp.where(mask, score, neg_inf)
+    top = jnp.max(masked_score)
+    sel_node = jnp.min(
+        jnp.where(masked_score == top, jax.lax.iota(jnp.int32, n_nodes), n_nodes)
+    ).astype(jnp.int32)
     if cfg.fail_reasons:
         feasible_n = jnp.sum(mask.astype(jnp.int32))
     else:
@@ -642,15 +742,31 @@ def schedule_pods(
     disabled [P] bool marks preemption victims (treated as deleted);
     nominated [P] i32 is the preemption retry's nominatedNodeName (-1 = none).
     """
+    n_pods = arrs.req.shape[0]
+    # forced-bind prefix hoisting: only from a fresh state with no
+    # preemption columns (victim/nomination indices cover the full
+    # sequence; resumed states already contain their prefix)
+    k = min(cfg.forced_prefix, n_pods)
+    if k and (state is not None or disabled is not None or nominated is not None):
+        k = 0
     if state is None:
         state = init_state(arrs, cfg)
-    xs = _pod_xs(arrs)
-    n_pods = arrs.req.shape[0]
+    if k:
+        state = apply_forced_prefix(arrs, cfg, state, k)
+        scan_arrs = slice_pods(arrs, k, n_pods)
+    else:
+        scan_arrs = arrs
+    xs = _pod_xs(scan_arrs)
+    n_scan = n_pods - k
+    if k:
+        # keep the global pod index (tie_break_seed folds it into the
+        # jitter key; hoisting must not shift it)
+        xs["_pod_index"] = xs["_pod_index"] + k
     xs["_disabled"] = (
-        jnp.zeros(n_pods, dtype=bool) if disabled is None else disabled.astype(bool)
+        jnp.zeros(n_scan, dtype=bool) if disabled is None else disabled.astype(bool)
     )
     xs["_nominated"] = (
-        jnp.full(n_pods, -1, jnp.int32) if nominated is None else nominated.astype(jnp.int32)
+        jnp.full(n_scan, -1, jnp.int32) if nominated is None else nominated.astype(jnp.int32)
     )
     if cfg.enable_spread:
         from open_simulator_tpu.ops.domains import hoist_active_stats
@@ -662,10 +778,21 @@ def schedule_pods(
     # loop-invariant reciprocal: the per-step resource-score divides become
     # multiplies (inv = 0 encodes the cap<=0 -> fraction 0 convention)
     inv_alloc = jnp.where(arrs.alloc > 0, 1.0 / jnp.where(arrs.alloc > 0, arrs.alloc, 1.0), 0.0)
-    step = functools.partial(_step, arrs, active, cfg, hoisted, inv_alloc)
+    step = functools.partial(_step, scan_arrs, active, cfg, hoisted, inv_alloc)
     final_state, (nodes, fail_counts, feasible, gpu_pick, vol_pick) = jax.lax.scan(
         step, state, xs, unroll=cfg.scan_unroll
     )
+    if k:
+        # prepend the prefix's (predetermined) outputs
+        nodes = jnp.concatenate([arrs.forced_node[:k].astype(jnp.int32), nodes])
+        feasible = jnp.concatenate([jnp.zeros(k, jnp.int32), feasible])
+        if cfg.fail_reasons:
+            fail_counts = jnp.concatenate(
+                [jnp.zeros((k, cfg.n_ops), jnp.int32), fail_counts])
+        gpu_pick = jnp.concatenate(
+            [jnp.zeros((k, gpu_pick.shape[1]), jnp.int32), gpu_pick])
+        vol_pick = jnp.concatenate(
+            [jnp.full((k, vol_pick.shape[1]), -1, jnp.int32), vol_pick])
     if not cfg.fail_reasons:
         # keep the output contract ([P, OPS]) without paying a per-step
         # accounting pass or a materialized scan output
@@ -730,7 +857,27 @@ def make_config(snapshot: ClusterSnapshot, **overrides) -> EngineConfig:
         ),
         enable_pv_match=bool(np.any(a.wfc_valid)),
     )
+    # forced-bind prefix: leading run of spec.nodeName pods whose carry
+    # updates are order-free (no gpu/storage/WFC picks within the prefix)
+    fn_arr = np.asarray(a.forced_node)
+    nonneg = fn_arr >= 0
+    fp = int(np.argmin(nonneg)) if not bool(np.all(nonneg)) else len(fn_arr)
+    if fp:
+        if enable_gpu and bool(np.any(np.asarray(a.gpu_cnt)[:fp] > 0)):
+            fp = 0
+        elif enable_storage and bool(
+            np.any(np.asarray(a.lvm_req)[:fp] > 0)
+            or np.any(np.asarray(a.sdev_req)[:fp] > 0)
+        ):
+            fp = 0
+        elif bool(np.any(np.asarray(a.wfc_valid)[:fp])):
+            fp = 0
+    kw["forced_prefix"] = fp
     kw.update(overrides)
     if kw.get("extensions"):
         kw["extensions"] = tuple(e.validate() for e in kw["extensions"])
+        # extension ops may read the carry per pod; keep prefix pods in
+        # the scan unless the caller explicitly overrode forced_prefix
+        if "forced_prefix" not in overrides:
+            kw["forced_prefix"] = 0
     return EngineConfig(**kw)

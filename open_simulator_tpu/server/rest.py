@@ -25,7 +25,9 @@ Request bodies (JSON):
 from __future__ import annotations
 
 import json
+import tempfile
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
@@ -50,6 +52,45 @@ class SimulationServer:
         # informers (pkg/server/server.go:97-137; no cluster access here)
         self.kubeconfig = kubeconfig
         self._lock = threading.Lock()
+        self._stats = {"requests": 0, "simulations": 0, "errors": 0,
+                       "last_elapsed_s": 0.0, "started_at": time.time()}
+        self._profile_dir = ""
+        self._profile_lock = threading.Lock()
+
+    # ---- debug surface (the gin pprof analog, server.go:148-152) -------
+
+    def debug_stats(self) -> Dict[str, Any]:
+        import resource
+
+        import jax
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        return {
+            **self._stats,
+            "uptime_s": round(time.time() - self._stats["started_at"], 1),
+            "max_rss_mib": round(ru.ru_maxrss / 1024.0, 1),
+            "cpu_user_s": round(ru.ru_utime, 2),
+            "devices": [str(d) for d in jax.devices()],
+            "profiling_to": self._profile_dir or None,
+        }
+
+    def toggle_profile(self, trace_dir: str = "") -> Dict[str, Any]:
+        import jax
+
+        # serialized: ThreadingHTTPServer handles GETs concurrently, and
+        # the jax profiler is a process-wide singleton; state is committed
+        # only after the profiler call succeeds so a failure cannot wedge
+        # the toggle
+        with self._profile_lock:
+            if self._profile_dir:
+                jax.profiler.stop_trace()
+                out, self._profile_dir = self._profile_dir, ""
+                return {"profiling": "stopped", "trace_dir": out,
+                        "view": "tensorboard --logdir <trace_dir> (profile plugin)"}
+            target = trace_dir or tempfile.mkdtemp(prefix="simprof-")
+            jax.profiler.start_trace(target)
+            self._profile_dir = target
+            return {"profiling": "started", "trace_dir": self._profile_dir}
 
     # ---- cluster snapshot ---------------------------------------------
 
@@ -72,13 +113,17 @@ class SimulationServer:
     # ---- handlers ------------------------------------------------------
 
     def deploy_apps(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        self._stats["requests"] += 1
         cluster = self.base_cluster(body.get("cluster"))
         cluster.nodes.extend(self._request_new_nodes(body.get("new_nodes")))
         apps = self._request_apps(body)
         result = simulate(cluster, apps)
+        self._stats["simulations"] += 1
+        self._stats["last_elapsed_s"] = round(result.elapsed_s, 3)
         return self._response(result, app_only=True)
 
     def scale_apps(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        self._stats["requests"] += 1
         cluster = self.base_cluster(body.get("cluster"))
         scaled: List[Dict[str, Any]] = body.get("apps") or []
         apps: List[AppResource] = []
@@ -99,6 +144,8 @@ class SimulationServer:
             app_res.add(workload, kind)
             apps.append(AppResource(name=f"scale-{name}", resources=app_res))
         result = simulate(cluster, apps)
+        self._stats["simulations"] += 1
+        self._stats["last_elapsed_s"] = round(result.elapsed_s, 3)
         return self._response(result, app_only=True)
 
     # ---- helpers -------------------------------------------------------
@@ -186,6 +233,26 @@ def _make_handler(server: SimulationServer):
                 self._send(200, {"status": "healthy"})
             elif self.path == "/test":
                 self._send(200, {"message": "simon-tpu server is running"})
+            elif self.path == "/debug/stats":
+                # profiling surface, the gin pprof analog
+                # (/root/reference/pkg/server/server.go:148-152): process +
+                # request counters and device info instead of Go pprof
+                try:
+                    self._send(200, server.debug_stats())
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            elif self.path.startswith("/debug/profile"):
+                # capture a jax profiler trace of the next simulation(s):
+                # /debug/profile?dir=/tmp/simprof starts, a second call
+                # stops and returns the trace directory (view in
+                # TensorBoard's profile plugin)
+                from urllib.parse import parse_qs, urlparse
+
+                q = parse_qs(urlparse(self.path).query)
+                try:
+                    self._send(200, server.toggle_profile((q.get("dir") or [""])[0]))
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
             else:
                 self._send(404, {"error": "not found"})
 
@@ -211,8 +278,10 @@ def _make_handler(server: SimulationServer):
                 else:
                     code, payload = 200, server.scale_apps(body)
             except ValueError as e:
+                server._stats["errors"] += 1
                 code, payload = 400, {"error": str(e)}
             except Exception as e:  # noqa: BLE001 — 500 with message, like gin recovery
+                server._stats["errors"] += 1
                 code, payload = 500, {"error": f"{type(e).__name__}: {e}"}
             finally:
                 server._lock.release()

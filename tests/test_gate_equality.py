@@ -182,3 +182,76 @@ def test_gates_hold_under_inactive_padded_nodes(max_new):
                              new_node_template=_zone_nodes(1)[0])
     snap = encode_cluster(_zone_nodes(6), pods, opts)
     assert_same_result(snap, **ALL_GATES)
+
+
+def test_forced_prefix_hoisting_bit_equal():
+    """A leading run of bound (spec.nodeName) pods applied as one batched
+    scatter must reproduce the sequential scan bit-for-bit — assignments,
+    carry state, and the downstream unbound pods' decisions (which read
+    the carry the prefix built: counts, paints, ports, spread domains)."""
+    rng = np.random.RandomState(11)
+    nodes = _zone_nodes(8)
+    pods = []
+    # 30 bound pods with the full constraint surface painted into the carry
+    for i in range(30):
+        kw = dict(cpu=f"{rng.randint(100, 600)}m", mem="128Mi",
+                  labels={"app": f"a{i % 3}", "anti": f"g{i % 5}"},
+                  node_name=f"n{i % 8}")
+        if i % 3 == 0:
+            kw["host_ports"] = [7000 + i]
+        if i % 4 == 0:
+            kw["affinity"] = {
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [{
+                        "labelSelector": {"matchLabels": {"anti": f"g{i % 5}"}},
+                        "topologyKey": "kubernetes.io/hostname",
+                    }],
+                    "preferredDuringSchedulingIgnoredDuringExecution": [{
+                        "weight": 7,
+                        "podAffinityTerm": {
+                            "labelSelector": {"matchLabels": {"app": f"a{(i + 1) % 3}"}},
+                            "topologyKey": "topology.kubernetes.io/zone",
+                        },
+                    }],
+                },
+            }
+        pods.append(make_pod(f"bound{i}", **kw))
+    # then unbound pods whose decisions depend on the prefix's carry
+    for i in range(24):
+        spread = [{
+            "maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+            "whenUnsatisfiable": "DoNotSchedule" if i % 2 else "ScheduleAnyway",
+            "labelSelector": {"matchLabels": {"app": f"a{i % 3}"}},
+        }]
+        kw = dict(cpu=f"{rng.randint(100, 500)}m", mem="128Mi",
+                  labels={"app": f"a{i % 3}", "anti": f"g{i % 5}"}, spread=spread)
+        if i % 3 == 0:
+            kw["host_ports"] = [7000 + (i % 30)]
+        if i % 4 == 1:
+            kw["affinity"] = {
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [{
+                        "labelSelector": {"matchLabels": {"anti": f"g{i % 5}"}},
+                        "topologyKey": "kubernetes.io/hostname",
+                    }],
+                },
+            }
+        pods.append(make_pod(f"free{i}", **kw))
+    snap = encode_cluster(nodes, pods)
+    cfg_auto = make_config(snap)
+    assert cfg_auto.forced_prefix == 30
+    nodes_h, fails_h, _ = _run(snap)
+    nodes_f, fails_f, _ = _run(snap, forced_prefix=0)
+    np.testing.assert_array_equal(nodes_h, nodes_f)
+    # prefix rows report zero fail counts (their binding is predetermined;
+    # decode never reads fail rows of scheduled pods) — compare the rest
+    np.testing.assert_array_equal(fails_h[30:], fails_f[30:])
+
+    # carry state equality too
+    from open_simulator_tpu.engine.scheduler import device_arrays, schedule_pods
+
+    arrs = device_arrays(snap)
+    out_h = schedule_pods(arrs, arrs.active, cfg_auto)
+    out_f = schedule_pods(arrs, arrs.active, cfg_auto._replace(forced_prefix=0))
+    for a, b in zip(out_h.state, out_f.state):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

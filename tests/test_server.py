@@ -134,3 +134,29 @@ def test_404(server_url):
     with pytest.raises(urllib.error.HTTPError) as ei:
         urllib.request.urlopen(server_url + "/nope")
     assert ei.value.code == 404
+
+
+def test_debug_stats_endpoint():
+    """The gin-pprof analog (server.go:148-152): process + request stats."""
+    from open_simulator_tpu.server.rest import SimulationServer
+
+    srv = SimulationServer()
+    stats = srv.debug_stats()
+    assert stats["requests"] == 0 and stats["simulations"] == 0
+    assert stats["uptime_s"] >= 0 and stats["max_rss_mib"] > 0
+    assert isinstance(stats["devices"], list) and stats["devices"]
+
+    # counters advance with a request
+    body = {
+        "cluster": {"yaml": (
+            "apiVersion: v1\nkind: Node\nmetadata: {name: n0}\n"
+            "status:\n  allocatable: {cpu: '4', memory: 8Gi, pods: '110'}\n")},
+        "apps": [{"name": "a", "yaml": (
+            "apiVersion: v1\nkind: Pod\nmetadata: {name: p, namespace: default}\n"
+            "spec:\n  containers:\n    - name: c\n      resources:\n"
+            "        requests: {cpu: 100m}\n")}],
+    }
+    srv.deploy_apps(body)
+    stats = srv.debug_stats()
+    assert stats["requests"] == 1 and stats["simulations"] == 1
+    assert stats["last_elapsed_s"] > 0
