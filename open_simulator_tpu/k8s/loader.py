@@ -9,6 +9,7 @@ MakeValidNode normalizers (pkg/utils/utils.go:326-456,531-545).
 from __future__ import annotations
 
 import os
+import re
 import random
 import string
 from dataclasses import dataclass, field
@@ -185,18 +186,75 @@ def make_valid_pod(pod: k8s.Pod) -> k8s.Pod:
     p.phase = "Pending" if not p.node_name else "Running"
     if not p.meta.name:
         raise PodValidationError("pod has no name")
+    if not _DNS1123.match(p.meta.name):
+        raise PodValidationError(
+            f"pod name {p.meta.name!r} is not a valid DNS-1123 subdomain")
+    if not _DNS1123_LABEL.match(p.meta.namespace):
+        raise PodValidationError(
+            f"pod {p.meta.name}: namespace {p.meta.namespace!r} is not a "
+            f"valid DNS-1123 label")
     if not p.containers:
         raise PodValidationError(f"pod {p.key} has no containers")
-    for c in p.containers:
+    seen_containers = set()
+    for c in p.containers + p.init_containers:
+        if c.name in seen_containers:
+            raise PodValidationError(
+                f"pod {p.key}: duplicate container name {c.name!r}")
+        seen_containers.add(c.name)
         for name, v in c.requests.items():
             if v < 0:
                 raise PodValidationError(f"pod {p.key} negative request {name}")
             if name in c.limits and c.limits[name] < v:
                 raise PodValidationError(f"pod {p.key} request {name} exceeds limit")
+    restart = (p.raw.get("spec") or {}).get("restartPolicy", "Always")
+    if restart not in ("Always", "OnFailure", "Never"):
+        raise PodValidationError(
+            f"pod {p.key}: invalid restartPolicy {restart!r}")
     for tol in p.tolerations:
         if tol.operator == "Exists" and tol.value:
             raise PodValidationError(f"pod {p.key} toleration: value must be empty when operator is Exists")
+        if tol.operator not in ("", "Exists", "Equal"):
+            raise PodValidationError(
+                f"pod {p.key} toleration: invalid operator {tol.operator!r}")
+    for tc in p.topology_spread:
+        if tc.max_skew <= 0:
+            raise PodValidationError(
+                f"pod {p.key}: topologySpreadConstraint maxSkew must be > 0")
+        if tc.when_unsatisfiable not in ("DoNotSchedule", "ScheduleAnyway"):
+            raise PodValidationError(
+                f"pod {p.key}: invalid whenUnsatisfiable "
+                f"{tc.when_unsatisfiable!r}")
+        if not tc.topology_key:
+            raise PodValidationError(
+                f"pod {p.key}: topologySpreadConstraint needs a topologyKey")
+    _validate_selector_ops(p)
     return p
+
+
+# apiserver ValidatePodCreate subset (the checks this simulator's inputs
+# can actually trip; the reference runs the full vendored validation,
+# pkg/utils/utils.go:408)
+_DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9.]{0,251}[a-z0-9])?$")     # subdomain (names)
+_DNS1123_LABEL = re.compile(r"^[a-z0-9]([-a-z0-9]{0,61}[a-z0-9])?$")  # label (namespaces)
+_SELECTOR_OPS = {"In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"}
+
+
+def _validate_selector_ops(p: k8s.Pod) -> None:
+    aff = (p.raw.get("spec") or {}).get("affinity") or {}
+    node_aff = aff.get("nodeAffinity") or {}
+    req = (node_aff.get("requiredDuringSchedulingIgnoredDuringExecution") or {})
+    for term in req.get("nodeSelectorTerms") or []:
+        for expr in term.get("matchExpressions") or []:
+            op = expr.get("operator", "")
+            if op not in _SELECTOR_OPS:
+                raise PodValidationError(
+                    f"pod {p.key}: invalid nodeAffinity operator {op!r}")
+            if op in ("In", "NotIn") and not expr.get("values"):
+                raise PodValidationError(
+                    f"pod {p.key}: nodeAffinity {op} requires values")
+            if op in ("Exists", "DoesNotExist") and expr.get("values"):
+                raise PodValidationError(
+                    f"pod {p.key}: nodeAffinity {op} must not set values")
 
 
 def make_valid_node(node: k8s.Node) -> k8s.Node:

@@ -83,8 +83,11 @@ class SimulationServer:
         # the toggle
         with self._profile_lock:
             if self._profile_dir:
-                jax.profiler.stop_trace()
+                # clear state BEFORE stopping: if stop_trace raises (disk
+                # full etc.) the toggle resets instead of wedging on the
+                # stop branch forever
                 out, self._profile_dir = self._profile_dir, ""
+                jax.profiler.stop_trace()
                 return {"profiling": "stopped", "trace_dir": out,
                         "view": "tensorboard --logdir <trace_dir> (profile plugin)"}
             target = trace_dir or tempfile.mkdtemp(prefix="simprof-")
@@ -241,7 +244,7 @@ def _make_handler(server: SimulationServer):
                     self._send(200, server.debug_stats())
                 except Exception as e:  # noqa: BLE001
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
-            elif self.path.startswith("/debug/profile"):
+            elif self.path == "/debug/profile" or self.path.startswith("/debug/profile?"):
                 # capture a jax profiler trace of the next simulation(s):
                 # /debug/profile?dir=/tmp/simprof starts, a second call
                 # stops and returns the trace directory (view in

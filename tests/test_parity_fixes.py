@@ -185,3 +185,71 @@ def test_pinned_gpu_preemptor_not_planned_onto_gpuless_node():
         [AppResource(name="a", resources=app1), AppResource(name="b", resources=app2)],
     )
     assert res.placements().get("default/high") == "b0"
+
+
+def test_make_valid_pod_apiserver_validation_subset():
+    """ValidatePodCreate-subset widening (reference runs the full vendored
+    validation, pkg/utils/utils.go:408): DNS names, duplicate containers,
+    restartPolicy/toleration/selector-operator enums, spread shapes."""
+    import pytest
+
+    from open_simulator_tpu.k8s.loader import PodValidationError, make_valid_pod
+    from open_simulator_tpu.k8s.objects import Pod
+
+    def pod(meta=None, spec=None):
+        d = {"metadata": {"name": "ok", **(meta or {})},
+             "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "1"}}}],
+                      **(spec or {})}}
+        return Pod.from_dict(d)
+
+    make_valid_pod(pod())  # baseline valid
+    with pytest.raises(PodValidationError, match="DNS-1123"):
+        make_valid_pod(pod(meta={"name": "Bad_Name"}))
+    with pytest.raises(PodValidationError, match="duplicate container"):
+        make_valid_pod(pod(spec={"containers": [
+            {"name": "c", "resources": {}}, {"name": "c", "resources": {}}]}))
+    with pytest.raises(PodValidationError, match="restartPolicy"):
+        make_valid_pod(pod(spec={"restartPolicy": "Sometimes"}))
+    with pytest.raises(PodValidationError, match="invalid operator"):
+        make_valid_pod(pod(spec={"tolerations": [{"key": "k", "operator": "Matches"}]}))
+    with pytest.raises(PodValidationError, match="maxSkew"):
+        make_valid_pod(pod(spec={"topologySpreadConstraints": [{
+            "maxSkew": 0, "topologyKey": "zone",
+            "whenUnsatisfiable": "DoNotSchedule"}]}))
+    with pytest.raises(PodValidationError, match="whenUnsatisfiable"):
+        make_valid_pod(pod(spec={"topologySpreadConstraints": [{
+            "maxSkew": 1, "topologyKey": "zone",
+            "whenUnsatisfiable": "Perhaps"}]}))
+    with pytest.raises(PodValidationError, match="requires values"):
+        make_valid_pod(pod(spec={"affinity": {"nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [{"matchExpressions": [
+                    {"key": "k", "operator": "In"}]}]}}}}))
+    with pytest.raises(PodValidationError, match="must not set values"):
+        make_valid_pod(pod(spec={"affinity": {"nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [{"matchExpressions": [
+                    {"key": "k", "operator": "Exists", "values": ["x"]}]}]}}}}))
+
+
+def test_namespace_is_dns1123_label_not_subdomain():
+    """Review r4: namespaces are DNS-1123 LABELS (no dots, <=63 chars),
+    stricter than object names (subdomains)."""
+    import pytest
+
+    from open_simulator_tpu.k8s.loader import PodValidationError, make_valid_pod
+    from open_simulator_tpu.k8s.objects import Pod
+
+    def pod(ns):
+        return Pod.from_dict({
+            "metadata": {"name": "ok", "namespace": ns},
+            "spec": {"containers": [{"name": "c", "resources": {}}]}})
+
+    make_valid_pod(pod("prod"))
+    make_valid_pod(Pod.from_dict({
+        "metadata": {"name": "ok.dotted.name", "namespace": "prod"},
+        "spec": {"containers": [{"name": "c", "resources": {}}]}}))  # names may dot
+    with pytest.raises(PodValidationError, match="DNS-1123 label"):
+        make_valid_pod(pod("team.prod"))
+    with pytest.raises(PodValidationError, match="DNS-1123 label"):
+        make_valid_pod(pod("x" * 64))
