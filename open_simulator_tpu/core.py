@@ -193,6 +193,8 @@ def with_volume_objects(
         pvs=list(opts.pvs) + [p for s in srcs for p in s.pvs],
         storage_classes=(list(opts.storage_classes)
                          + [p for s in srcs for p in s.storage_classes]),
+        csi_nodes=(list(opts.csi_nodes)
+                   + [c for s in srcs for c in getattr(s, "csi_nodes", [])]),
     )
 
 

@@ -65,6 +65,8 @@ def filter_op_table(resources: Sequence[str]) -> List[str]:
         "node(s) had no available volume zone",
         "node(s) didn't find available persistent volumes to bind",
         "node(s) unavailable due to one or more pvc(s) bound to non-existent pv(s)",
+        # NodeVolumeLimits (vendored non_csi.go:63 / csi.go:140)
+        "node(s) exceed max volume count",
     ]
     return ops
 
@@ -84,6 +86,7 @@ class EncodeOptions:
     pvcs: list = field(default_factory=list)
     pvs: list = field(default_factory=list)
     storage_classes: list = field(default_factory=list)
+    csi_nodes: list = field(default_factory=list)
 
 
 @chex.dataclass(frozen=True)
@@ -165,6 +168,9 @@ class SnapshotArrays:
     vol_pv_missing: np.ndarray  # [P] bool bound claim -> non-existent PV
     wfc_ccid: np.ndarray       # [P, Lw] i64 claim-class per WFC slot
     wfc_valid: np.ndarray      # [P, Lw] bool
+    # NodeVolumeLimits analog; Lk attachable-volume limit keys
+    vol_limit_cap: np.ndarray  # [N, Lk] f32 (big = node declares no limit)
+    vol_limit_req: np.ndarray  # [P, Lk] f32 attachments demanded per key
 
 
 @dataclass
@@ -586,6 +592,32 @@ def encode_cluster(
     vol_pv_missing = np.zeros(P, dtype=bool)
     wfc_ccid = np.zeros((P, Lw), dtype=np.int64)
     wfc_valid = np.zeros((P, Lw), dtype=bool)
+    # attachable-volume limit keys: vocab over pod demands; a node without
+    # the allocatable key declares no limit (vendored getVolumeLimits only
+    # limits keys the node reports)
+    limit_keys = sorted({k for i in vol_model.pod_volumes for k in i.limit_demand})
+    Lk = max(len(limit_keys), 1)
+    NO_LIMIT = np.float32(1e9)
+    vol_limit_cap = np.full((N, Lk), NO_LIMIT, dtype=np.float32)
+    for i, n in enumerate(all_nodes):
+        for j, lk in enumerate(limit_keys):
+            if lk in n.allocatable:
+                vol_limit_cap[i, j] = float(n.allocatable[lk])
+    # CSINode driver limits override the legacy allocatable keys (the
+    # vendored CSILimits plugin prefers CSINode, csi.go getVolumeLimits;
+    # real 1.23 clusters publish only CSINode)
+    for cn in opts.csi_nodes:
+        i = node_index.get(cn.meta.name)
+        if i is None:
+            continue
+        for driver, cnt in cn.driver_limits().items():
+            lk = f"attachable-volumes-csi-{driver}"
+            if lk in limit_keys:
+                vol_limit_cap[i, limit_keys.index(lk)] = float(cnt)
+    vol_limit_req = np.zeros((P, Lk), dtype=np.float32)
+    for pi, info in enumerate(vol_model.pod_volumes):
+        for j, lk in enumerate(limit_keys):
+            vol_limit_req[pi, j] = float(info.limit_demand.get(lk, 0))
     pre_reasons: Dict[int, str] = {}
     for pi, info in enumerate(vol_model.pod_volumes):
         vol_pv_missing[pi] = info.missing_pv
@@ -694,6 +726,8 @@ def encode_cluster(
         vol_pv_missing=vol_pv_missing,
         wfc_ccid=wfc_ccid,
         wfc_valid=wfc_valid,
+        vol_limit_cap=vol_limit_cap,
+        vol_limit_req=vol_limit_req,
     )
 
     group_desc = [f"group#{i}" for i in range(S)]

@@ -106,6 +106,9 @@ class EngineConfig(NamedTuple):
     # dynamic WaitForFirstConsumer PV matching (ops/volumes.py)
     enable_vol_static: bool = False
     enable_pv_match: bool = False
+    # NodeVolumeLimits analog: attachable-volume counts vs the node's
+    # attachable-volumes-* allocatable keys
+    enable_vol_limits: bool = False
     # Out-of-tree extension ops (engine/extensions.py ExtensionOp tuples) —
     # the WithFrameworkOutOfTreeRegistry analog
     # (pkg/simulator/simulator.go:188-195). Filter extensions append reason
@@ -140,9 +143,9 @@ class EngineConfig(NamedTuple):
     @property
     def n_ops(self) -> int:
         # 4 pre-fit masks + R fit rows + [pod-aff, anti-aff, spread, gpu,
-        # storage, vol-node-aff, vol-zone, vol-bind, vol-pv-missing]
-        # (filter_op_table order) + one row per filter extension
-        return (OP_FIT_BASE + self.n_resources + 9
+        # storage, vol-node-aff, vol-zone, vol-bind, vol-pv-missing,
+        # vol-limits] (filter_op_table order) + one per filter extension
+        return (OP_FIT_BASE + self.n_resources + 10
                 + sum(1 for e in self.extensions if e.filter_fn is not None))
 
     @property
@@ -175,6 +178,8 @@ class SimState(NamedTuple):
     # PVs consumed by earlier pods' WaitForFirstConsumer matches
     # (AssumePodVolumes analog)
     pv_taken: jnp.ndarray     # [Npv] bool
+    # attachable-volume attachments per node per limit key
+    vol_cnt: jnp.ndarray      # [N, Lk] f32
 
 
 class ScheduleOutput(NamedTuple):
@@ -214,6 +219,7 @@ def init_state(arrs: SnapshotArrays, cfg: "EngineConfig | None" = None) -> SimSt
         sdev_taken=jnp.zeros((n, arrs.sdev_cap.shape[1]), dtype=bool),
         dom_count=jnp.zeros((k1, d, s), f32),
         pv_taken=jnp.zeros((arrs.pv_node_ok.shape[0],), dtype=bool),
+        vol_cnt=jnp.zeros((n, arrs.vol_limit_cap.shape[1]), f32),
     )
 
 
@@ -264,6 +270,10 @@ def _apply_prefix_chunk(arrs: SnapshotArrays, cfg: EngineConfig,
     if cfg.enable_ports:
         ports = ports | (
             jnp.matmul(oh.T, arrs.ports[lo:hi].astype(f32), precision=hp) > 0)
+    vol_cnt = state.vol_cnt
+    if cfg.enable_vol_limits:
+        vol_cnt = vol_cnt + jnp.matmul(
+            oh.T, arrs.vol_limit_req[lo:hi], precision=hp)
     term = state.term_block
     pref = state.pref_paint
     if cfg.enable_anti_affinity or cfg.enable_pref:
@@ -297,7 +307,8 @@ def _apply_prefix_chunk(arrs: SnapshotArrays, cfg: EngineConfig,
             pref = pref + jnp.matmul(
                 sd_a.T, col * w[:, None], precision=hp)
     return SimState(used, gc, term, pref, ports, state.gpu_used,
-                    state.vg_used, state.sdev_taken, dom, state.pv_taken)
+                    state.vg_used, state.sdev_taken, dom, state.pv_taken,
+                    vol_cnt)
 
 
 def _pod_xs(arrs: SnapshotArrays) -> Dict[str, jnp.ndarray]:
@@ -311,7 +322,7 @@ def _pod_xs(arrs: SnapshotArrays) -> Dict[str, jnp.ndarray]:
         "pref_group", "pref_key", "pref_weight", "pref_valid", "pref_tid", "hit_pref",
         "gpu_mem", "gpu_cnt", "gpu_forced", "gpu_has_forced",
         "lvm_req", "sdev_req", "sdev_req_ssd",
-        "vol_cid", "vol_pv_missing", "wfc_ccid", "wfc_valid",
+        "vol_cid", "vol_pv_missing", "wfc_ccid", "wfc_valid", "vol_limit_req",
     ]
     xs = {k: getattr(arrs, k) for k in names}
     xs["_pod_index"] = jnp.arange(arrs.req.shape[0], dtype=jnp.int32)
@@ -445,11 +456,19 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
             state.pv_taken, arrs.pv_cand, arrs.pv_node_ok,
             x["wfc_ccid"], x["wfc_valid"])
         ok_vol_bind = ok_vol_bind & wfc_ok if ok_vol_bind is not true_v else wfc_ok
+    if cfg.enable_vol_limits:
+        # NodeVolumeLimits: attachments + demand within every limit key
+        ok_vol_limits = jnp.all(
+            state.vol_cnt + x["vol_limit_req"][None, :] <= arrs.vol_limit_cap,
+            axis=1)
+    else:
+        ok_vol_limits = true_v
 
     op_masks = [ok_unsched, ok_aff, ok_taint, ok_ports]
     op_masks += [fit[:, r] for r in range(cfg.n_resources)]
     op_masks += [ok_pod_aff, ok_pod_anti, ok_spread, ok_gpu, ok_storage,
-                 ok_vol_node, ok_vol_zone, ok_vol_bind, ok_pv_exist]
+                 ok_vol_node, ok_vol_zone, ok_vol_bind, ok_pv_exist,
+                 ok_vol_limits]
     # out-of-tree filter extensions: appended after the built-in pipeline,
     # each with its own reason row
     for ext in cfg.extensions:
@@ -722,9 +741,14 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     else:
         pv_taken = state.pv_taken
         vol_pick = jnp.zeros((0,), dtype=jnp.int32)
+    if cfg.enable_vol_limits:
+        vol_cnt = state.vol_cnt + onehot_n[:, None] * x["vol_limit_req"][None, :]
+    else:
+        vol_cnt = state.vol_cnt
 
     new_state = SimState(used, group_count, term_block, pref_paint, ports_used,
-                         gpu_used, vg_used, sdev_taken, dom_count, pv_taken)
+                         gpu_used, vg_used, sdev_taken, dom_count, pv_taken,
+                         vol_cnt)
     return new_state, (final_node, fail_counts, feasible_n, pick, vol_pick)
 
 
@@ -856,6 +880,9 @@ def make_config(snapshot: ClusterSnapshot, **overrides) -> EngineConfig:
             or not np.all(a.class_vol_bind) or np.any(a.vol_pv_missing)
         ),
         enable_pv_match=bool(np.any(a.wfc_valid)),
+        enable_vol_limits=bool(
+            np.any(a.vol_limit_req > 0) and np.any(a.vol_limit_cap < 1e9)
+        ),
     )
     # forced-bind prefix: leading run of spec.nodeName pods whose carry
     # updates are order-free (no gpu/storage/WFC picks within the prefix)

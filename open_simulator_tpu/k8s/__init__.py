@@ -18,6 +18,7 @@ from open_simulator_tpu.k8s.objects import (
     ObjectMeta,
     Pod,
     PodDisruptionBudget,
+    CSINode,
     PersistentVolume,
     PersistentVolumeClaim,
     ReplicaSet,

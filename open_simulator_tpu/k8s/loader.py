@@ -41,6 +41,7 @@ _KIND_MAP = {
     "StorageClass": k8s.StorageClass,
     "PersistentVolumeClaim": k8s.PersistentVolumeClaim,
     "PersistentVolume": k8s.PersistentVolume,
+    "CSINode": k8s.CSINode,
     "ConfigMap": k8s.ConfigMap,
 }
 
@@ -63,6 +64,7 @@ class ClusterResources:
     storage_classes: List[k8s.StorageClass] = field(default_factory=list)
     pvcs: List[k8s.PersistentVolumeClaim] = field(default_factory=list)
     pvs: List[k8s.PersistentVolume] = field(default_factory=list)
+    csi_nodes: List[k8s.CSINode] = field(default_factory=list)
     config_maps: List[k8s.ConfigMap] = field(default_factory=list)
     priority_classes: List[k8s.PriorityClass] = field(default_factory=list)
 
@@ -80,6 +82,7 @@ class ClusterResources:
         "StorageClass": "storage_classes",
         "PersistentVolumeClaim": "pvcs",
         "PersistentVolume": "pvs",
+        "CSINode": "csi_nodes",
         "ConfigMap": "config_maps",
         "PriorityClass": "priority_classes",
     }
@@ -234,7 +237,9 @@ def make_valid_pod(pod: k8s.Pod) -> k8s.Pod:
 # apiserver ValidatePodCreate subset (the checks this simulator's inputs
 # can actually trip; the reference runs the full vendored validation,
 # pkg/utils/utils.go:408)
-_DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9.]{0,251}[a-z0-9])?$")     # subdomain (names)
+# RFC 1123 subdomain: dot-separated labels, each [a-z0-9]([-a-z0-9]*[a-z0-9])?
+_DNS1123 = re.compile(
+    r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*$")
 _DNS1123_LABEL = re.compile(r"^[a-z0-9]([-a-z0-9]{0,61}[a-z0-9])?$")  # label (namespaces)
 _SELECTOR_OPS = {"In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"}
 

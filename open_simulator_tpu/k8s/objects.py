@@ -611,6 +611,24 @@ class PersistentVolume(_Passthrough):
         return out
 
 
+class CSINode(_Passthrough):
+    """CSINode: per-node CSI driver attach limits — the source the vendored
+    CSILimits plugin prefers over legacy node.status.allocatable keys
+    (nodevolumelimits/csi.go getVolumeLimits)."""
+
+    KIND = "CSINode"
+
+    def driver_limits(self) -> Dict[str, int]:
+        """driver name -> allocatable.count (drivers without a count are
+        unlimited and omitted)."""
+        out: Dict[str, int] = {}
+        for d in (self.raw.get("spec") or {}).get("drivers") or []:
+            cnt = (d.get("allocatable") or {}).get("count")
+            if d.get("name") and cnt is not None:
+                out[d["name"]] = int(cnt)
+        return out
+
+
 class ConfigMap(_Passthrough):
     KIND = "ConfigMap"
 

@@ -60,6 +60,10 @@ class PodVolumes:
     wfc_claim_ids: List[int] = field(default_factory=list)   # candidate-class ids
     wfc_claim_keys: List[str] = field(default_factory=list)  # ns/name per slot
     provision_scs: List[str] = field(default_factory=list)   # SC names
+    # attachable-volume demand per limit key (NodeVolumeLimits analog):
+    # one count per attachable volume the pod mounts, keyed like the node
+    # allocatable keys ("attachable-volumes-csi-<driver>" etc.)
+    limit_demand: Dict[str, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -129,6 +133,43 @@ def _pv_matches_claim(pv: PersistentVolume, pvc: PersistentVolumeClaim,
     return True
 
 
+def attach_limit_key_for_pv(pv: PersistentVolume) -> Optional[str]:
+    """The node-allocatable limit key an attached PV counts against
+    (vendored nodevolumelimits: GetCSIAttachLimitKey + the in-tree cloud
+    keys). Local/hostPath/NFS-style volumes are not attachable -> None."""
+    spec = pv.spec
+    if spec.get("csi"):
+        return f"attachable-volumes-csi-{spec['csi'].get('driver', '')}"
+    if spec.get("awsElasticBlockStore"):
+        return "attachable-volumes-aws-ebs"
+    if spec.get("gcePersistentDisk"):
+        return "attachable-volumes-gce-pd"
+    if spec.get("azureDisk"):
+        return "attachable-volumes-azure-disk"
+    return None
+
+
+_INTREE_PROVISIONER_KEYS = {
+    "kubernetes.io/aws-ebs": "attachable-volumes-aws-ebs",
+    "kubernetes.io/gce-pd": "attachable-volumes-gce-pd",
+    "kubernetes.io/azure-disk": "attachable-volumes-azure-disk",
+}
+
+
+def attach_limit_key_for_sc(sc: Optional[StorageClass]) -> Optional[str]:
+    """Dynamic-provision claims count against the provisioner's limit key:
+    the in-tree cloud provisioners map to their legacy keys (mirroring
+    attach_limit_key_for_pv and the vendored non-CSI limit plugins, which
+    count unbound claims by SC provisioner), everything else to the CSI
+    key."""
+    if sc is None or not sc.provisioner:
+        return None
+    if sc.provisioner == "kubernetes.io/no-provisioner":
+        return None
+    intree = _INTREE_PROVISIONER_KEYS.get(sc.provisioner)
+    return intree or f"attachable-volumes-csi-{sc.provisioner}"
+
+
 def _claim_name_for_volume(pod: Pod, vol: Dict[str, Any]) -> Tuple[Optional[str], bool]:
     """(pvc name, is_ephemeral) for a pod volume; (None, False) if the
     volume does not reference a claim (podHasPVCs, volume_binding.go)."""
@@ -165,12 +206,16 @@ def analyze_volumes(
         info = PodVolumes()
         model.pod_volumes.append(info)
         volumes = (pod.raw.get("spec") or {}).get("volumes") or []
+        seen_claims: set = set()
         for vol in volumes:
             name, is_ephemeral = _claim_name_for_volume(pod, vol)
             if name is None:
                 continue
             model.any_volumes = True
             claim_key = f"{pod.meta.namespace or 'default'}/{name}"
+            if claim_key in seen_claims:
+                continue  # unique volumes count once (nodevolumelimits)
+            seen_claims.add(claim_key)
             pvc = pvc_index.get(claim_key)
             if pvc is None:
                 info.pre_reason = (
@@ -195,6 +240,9 @@ def analyze_volumes(
                     info.missing_pv = True
                 else:
                     info.bound_pv_ids.append(pv_id)
+                    lk = attach_limit_key_for_pv(pv_sorted[pv_id])
+                    if lk:
+                        info.limit_demand[lk] = info.limit_demand.get(lk, 0) + 1
                 continue
             # unbound claim: binding mode decides
             sc = sc_index.get(pvc.storage_class_name or "")
@@ -203,6 +251,9 @@ def analyze_volumes(
                 break
             if sc.provisioner and sc.provisioner != "kubernetes.io/no-provisioner":
                 info.provision_scs.append(sc.meta.name)
+                lk = attach_limit_key_for_sc(sc)
+                if lk:
+                    info.limit_demand[lk] = info.limit_demand.get(lk, 0) + 1
                 continue
             # static (no-provisioner) WFC claim: candidate PV set
             fp = "|".join([

@@ -85,6 +85,11 @@ def load_simulation(path: str) -> Tuple[SimState, Optional[np.ndarray], dict]:
             elif name == "pv_taken":
                 # pre-volume-ops checkpoints had no PV axis
                 fields[name] = np.zeros((0,), dtype=bool)
+            elif name == "vol_cnt":
+                # sentinel (0, 0): resume_state widens it to the snapshot's
+                # [N, Lk] (pre-vol-limits checkpoints carried no attachments,
+                # so zeros are the exact state)
+                fields[name] = np.zeros((0, 0), dtype=np.float32)
             else:
                 fields[name] = np.zeros(
                     (n, 1), dtype=bool if name == "sdev_taken" else np.float32
@@ -102,6 +107,7 @@ def resume_state(state: SimState, arrs) -> SimState:
     Call before passing a loaded state back into schedule_pods."""
     k1, _, d = arrs.topo_onehot.shape
     s = np.asarray(state.group_count).shape[1]
+    state = _widen_vol_cnt(state, arrs)
     dom = np.asarray(state.dom_count)
     if dom.shape == (k1, d, s):
         return state
@@ -109,3 +115,10 @@ def resume_state(state: SimState, arrs) -> SimState:
     topo = np.asarray(arrs.topo_onehot)
     rebuilt = np.einsum("knd,ns->kds", topo, gc).astype(np.float32)
     return state._replace(dom_count=rebuilt)
+
+
+def _widen_vol_cnt(state: SimState, arrs) -> SimState:
+    want = (np.asarray(arrs.alloc).shape[0], np.asarray(arrs.vol_limit_cap).shape[1])
+    if np.asarray(state.vol_cnt).shape == want:
+        return state
+    return state._replace(vol_cnt=np.zeros(want, dtype=np.float32))

@@ -253,3 +253,22 @@ def test_namespace_is_dns1123_label_not_subdomain():
         make_valid_pod(pod("team.prod"))
     with pytest.raises(PodValidationError, match="DNS-1123 label"):
         make_valid_pod(pod("x" * 64))
+
+
+def test_dns1123_subdomain_validates_per_label():
+    """Review r4: each dot-separated label must independently satisfy
+    DNS-1123 — 'a..b' / 'a.-b' are rejected like the real apiserver."""
+    import pytest
+
+    from open_simulator_tpu.k8s.loader import PodValidationError, make_valid_pod
+    from open_simulator_tpu.k8s.objects import Pod
+
+    def pod(name):
+        return Pod.from_dict({
+            "metadata": {"name": name},
+            "spec": {"containers": [{"name": "c", "resources": {}}]}})
+
+    make_valid_pod(pod("a.b-c.d"))
+    for bad in ("a..b", "a.-b", "a-.b", ".a", "a."):
+        with pytest.raises(PodValidationError, match="DNS-1123"):
+            make_valid_pod(pod(bad))

@@ -329,3 +329,108 @@ def test_volume_bindings_reported():
         "default/c-small": "pv-small",
         "default/c-big": "pv-big",
     }
+
+
+def test_attachable_volume_limits():
+    """NodeVolumeLimits analog: a node's attachable-volumes-* allocatable
+    caps the attachments it hosts (vendored csi.go:136-140; reason string
+    non_csi.go:63). Nodes without the key declare no limit."""
+    from open_simulator_tpu.k8s.objects import PersistentVolume
+
+    def csi_pv(name, claim):
+        return PersistentVolume.from_dict({
+            "apiVersion": "v1", "kind": "PersistentVolume",
+            "metadata": {"name": name},
+            "spec": {
+                "capacity": {"storage": "10Gi"},
+                "accessModes": ["ReadWriteOnce"],
+                "storageClassName": "local-wfc",
+                "csi": {"driver": "ebs.csi.aws.com", "volumeHandle": name},
+                "claimRef": {"namespace": "default", "name": claim},
+            },
+            "status": {"phase": "Bound"},
+        })
+
+    limited = make_node(
+        "n0", labels={"kubernetes.io/hostname": "n0"},
+        extra_alloc={"attachable-volumes-csi-ebs.csi.aws.com": 2})
+    nodes = [limited]
+    pvcs_ = [pvc(f"c{i}", volume_name=f"ebs-{i}") for i in range(3)]
+    pvs_ = [csi_pv(f"ebs-{i}", f"c{i}") for i in range(3)]
+    pods = [claim_pod(f"p{i}", [f"c{i}"]) for i in range(3)]
+    res = run(nodes, pods, pvcs=pvcs_, pvs=pvs_)
+    assert len(res.unscheduled_pods) == 1
+    assert "exceed max volume count" in res.unscheduled_pods[0].reason
+
+    # a node that does not report the key has no limit
+    unlimited = make_node("n1", labels={"kubernetes.io/hostname": "n1"})
+    res2 = run([unlimited], pods, pvcs=pvcs_, pvs=pvs_)
+    assert not res2.unscheduled_pods
+
+
+def test_dynamic_provision_counts_against_csi_limit():
+    """WFC dynamic-provision claims count against the provisioner's CSI
+    limit key."""
+    dyn = StorageClass.from_dict({
+        "apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+        "metadata": {"name": "csi-dyn"},
+        "provisioner": "ebs.csi.aws.com",
+        "volumeBindingMode": "WaitForFirstConsumer",
+    })
+    limited = make_node(
+        "n0", labels={"kubernetes.io/hostname": "n0"},
+        extra_alloc={"attachable-volumes-csi-ebs.csi.aws.com": 1})
+    pvcs_ = [pvc(f"c{i}", sc="csi-dyn") for i in range(2)]
+    pods = [claim_pod(f"p{i}", [f"c{i}"]) for i in range(2)]
+    res = run([limited], pods, pvcs=pvcs_, scs=(dyn,))
+    assert len(res.unscheduled_pods) == 1
+    assert "exceed max volume count" in res.unscheduled_pods[0].reason
+
+
+def test_csinode_limits_and_intree_provisioner_keys():
+    """Review r4: CSINode.spec.drivers[].allocatable.count is the limit
+    source real clusters publish (csi.go prefers it over legacy allocatable
+    keys), and in-tree cloud provisioners count against their legacy keys."""
+    from open_simulator_tpu.k8s.objects import CSINode
+
+    # CSINode caps the csi driver at 1 even though the node's allocatable
+    # does not carry the legacy key
+    dyn = StorageClass.from_dict({
+        "apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+        "metadata": {"name": "csi-dyn"},
+        "provisioner": "ebs.csi.aws.com",
+        "volumeBindingMode": "WaitForFirstConsumer",
+    })
+    node = make_node("n0", labels={"kubernetes.io/hostname": "n0"})
+    csinode = CSINode.from_dict({
+        "apiVersion": "storage.k8s.io/v1", "kind": "CSINode",
+        "metadata": {"name": "n0"},
+        "spec": {"drivers": [{"name": "ebs.csi.aws.com",
+                              "nodeID": "n0", "allocatable": {"count": 1}}]},
+    })
+    cluster = ClusterResources()
+    cluster.nodes = [node]
+    cluster.csi_nodes = [csinode]
+    cluster.pvcs = [pvc(f"c{i}", sc="csi-dyn") for i in range(2)]
+    cluster.storage_classes = [dyn]
+    app = ClusterResources()
+    app.pods = [claim_pod(f"p{i}", [f"c{i}"]) for i in range(2)]
+    res = simulate(cluster, [AppResource(name="a", resources=app)])
+    assert len(res.unscheduled_pods) == 1
+    assert "exceed max volume count" in res.unscheduled_pods[0].reason
+
+    # in-tree provisioner maps to the legacy key
+    intree = StorageClass.from_dict({
+        "apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+        "metadata": {"name": "ebs-intree"},
+        "provisioner": "kubernetes.io/aws-ebs",
+        "volumeBindingMode": "WaitForFirstConsumer",
+    })
+    limited = make_node("n0", labels={"kubernetes.io/hostname": "n0"},
+                        extra_alloc={"attachable-volumes-aws-ebs": 1})
+    res2 = run([limited],
+               [claim_pod(f"q{i}", [f"d{i}"]) for i in range(2)],
+               pvcs=[pvc(f"d{i}", sc="ebs-intree") for i in range(2)],
+               scs=(intree,))
+    assert len(res2.unscheduled_pods) == 1
+    assert "exceed max volume count" in res2.unscheduled_pods[0].reason
