@@ -126,7 +126,8 @@ def shard_arrays(arrs, mesh: Mesh):
     heuristics would misfire when P happens to equal N).
     """
     node_first = {"alloc", "active", "is_new_node", "gpu_cap_mem", "gpu_count", "gpu_slot",
-                  "unschedulable", "vg_cap", "sdev_cap", "sdev_ssd"}
+                  "unschedulable", "vg_cap", "sdev_cap", "sdev_ssd",
+                  "vol_limit_cap", "spec_id"}
     node_second = {"topo_onehot", "has_key", "class_affinity", "class_taint",
                    "class_node_aff_score", "class_taint_prefer",
                    "pv_node_ok", "class_vol_node", "class_vol_zone",
