@@ -156,7 +156,8 @@ class EngineConfig(NamedTuple):
 class SimState(NamedTuple):
     """The scan carry — the whole mutable world of the simulation.
     (The reference spreads this across the fake clientset, the scheduler
-    cache, and the gpu-share cache; here it is five dense arrays.)
+    cache, and the gpu-share cache; here it is eleven dense arrays —
+    see ARCHITECTURE.md section 2 for the roster.)
 
     group_count/term_block store small integer counts; with
     cfg.compact_carry they are bfloat16 (f32 otherwise), halving their

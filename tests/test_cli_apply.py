@@ -96,3 +96,13 @@ def test_gen_doc(tmp_path):
     assert rc == 0
     files = os.listdir(tmp_path / "docs")
     assert "simon-tpu.md" in files and "simon-tpu_apply.md" in files
+
+
+def test_apply_more_pods_sweep_answer(capsys):
+    """The more-pods scale corpus (reference example/application/more_pods
+    analog): the batched sweep must land on a stable minimum node count."""
+    rc = main(["apply", "-f", os.path.join(REPO, "examples/morepods-config.yaml"),
+               "--max-new-nodes", "16"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "requires 3 new node(s)" in out
