@@ -1,0 +1,186 @@
+"""Differential oracle for the scan engine's INLINE inter-pod
+(anti-)affinity paths, in the style of tests/test_engine_spread_oracle.py:
+a step-by-step numpy mini-engine re-derives the vendored semantics
+(interpodaffinity/filtering.go) and the scan's assignment sequence must
+match exactly — covering the group_count carry, the anti-affinity
+term_block paint, hostname and zone topology keys, and the first-pod
+affinity bootstrap.
+
+Scores are zeroed down to nothing but the deterministic lowest-index
+tie-break, so feasibility alone decides.
+"""
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu.encode.snapshot import encode_cluster
+from open_simulator_tpu.engine.scheduler import (
+    device_arrays,
+    make_config,
+    schedule_pods,
+)
+from tests.conftest import make_node, make_pod
+
+ZONE_KEY = "topology.kubernetes.io/zone"
+
+
+def build(n_nodes, zones, pods_spec, cpu_cap=8000):
+    """pods_spec rows: (cpu_m, labels, aff, anti) where aff/anti are
+    (match_label_value, topo) or None, selecting pods labeled app=<value>
+    over the hostname or zone key."""
+    nodes = [
+        make_node(f"n{i}", cpu_m=cpu_cap, mem_mib=32768,
+                  labels={ZONE_KEY: f"z{zones[i]}"})
+        for i in range(n_nodes)
+    ]
+    pods = []
+    for i, (cpu_m, labels, aff, anti) in enumerate(pods_spec):
+        affinity = {}
+        for kind, spec in (("podAffinity", aff), ("podAntiAffinity", anti)):
+            if spec is None:
+                continue
+            val, topo = spec
+            affinity[kind] = {
+                "requiredDuringSchedulingIgnoredDuringExecution": [{
+                    "labelSelector": {"matchLabels": {"app": val}},
+                    "topologyKey": ("kubernetes.io/hostname"
+                                    if topo == "host" else ZONE_KEY),
+                }],
+            }
+        pods.append(make_pod(
+            f"p{i}", cpu=f"{cpu_m}m", mem="64Mi", labels=dict(labels),
+            affinity=affinity or None))
+    return nodes, pods
+
+
+def numpy_oracle(n_nodes, zones, pods_spec, cpu_cap=8000):
+    """Sequential mini-engine: fit + required (anti-)affinity only.
+
+    Vendored semantics (interpodaffinity/filtering.go):
+      affinity:   node ok iff its topo domain holds a matching bound pod;
+                  BOOTSTRAP: if NO matching pod exists anywhere and the
+                  incoming pod matches its own selector, every node with
+                  the key is ok.
+      anti-aff:   both directions — the incoming pod's terms must find no
+                  matching bound pod in the node's domain, AND no bound
+                  pod's anti-term may match the incoming pod within that
+                  bound pod's domain.
+    """
+    zmap = sorted({z for z in zones})
+    node_zone = [zmap.index(z) for z in zones]
+    cpu_used = np.zeros(n_nodes)
+    bound = []  # (node, labels, anti_terms)
+    assign = []
+
+    def domain_nodes(n, topo):
+        if topo == "host":
+            return [n]
+        return [m for m in range(n_nodes) if node_zone[m] == node_zone[n]]
+
+    for (cpu_m, labels, aff, anti) in pods_spec:
+        ok = cpu_used + cpu_m <= cpu_cap
+        for n in range(n_nodes):
+            if not ok[n]:
+                continue
+            if aff is not None:
+                val, topo = aff
+                dom = set(domain_nodes(n, topo))
+                hits = [b for b in bound if b[1].get("app") == val]
+                in_dom = any(b[0] in dom for b in hits)
+                bootstrap = (not hits) and labels.get("app") == val
+                if not (in_dom or bootstrap):
+                    ok[n] = False
+                    continue
+            if anti is not None:
+                val, topo = anti
+                dom = set(domain_nodes(n, topo))
+                if any(b[0] in dom and b[1].get("app") == val for b in bound):
+                    ok[n] = False
+                    continue
+            # existing pods' anti-terms vs the incoming pod
+            for (bn, _bl, bterms) in bound:
+                for (bval, btopo) in bterms:
+                    if labels.get("app") == bval and n in domain_nodes(bn, btopo):
+                        ok[n] = False
+                        break
+                if not ok[n]:
+                    break
+        if not ok.any():
+            assign.append(-1)
+            continue
+        pick = int(np.argmax(ok))   # scores zeroed: lowest feasible index
+        assign.append(pick)
+        cpu_used[pick] += cpu_m
+        bound.append((pick, dict(labels), [anti] if anti else []))
+    return np.array(assign)
+
+
+def run_engine(nodes, pods):
+    snap = encode_cluster(nodes, pods)
+    cfg = make_config(
+        snap, w_balanced=0.0, w_least=0.0, w_simon=0.0, w_spread=0.0,
+        w_interpod=0.0, w_node_aff=0.0, w_taint=0.0)
+    out = schedule_pods(device_arrays(snap), snap.arrays.active, cfg)
+    return np.asarray(out.node)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_anti_affinity_sequences_match_oracle(seed):
+    rng = np.random.RandomState(seed)
+    n = 6
+    zones = [i % 2 for i in range(n)]
+    spec = []
+    for i in range(24):
+        labels = {"app": f"a{i % 3}"}
+        anti = (f"a{i % 3}", "host") if i % 2 == 0 else None
+        spec.append((int(rng.randint(100, 500)), labels, None, anti))
+    nodes, pods = build(n, zones, spec)
+    np.testing.assert_array_equal(run_engine(nodes, pods),
+                                  numpy_oracle(n, zones, spec))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_affinity_with_bootstrap_matches_oracle(seed):
+    rng = np.random.RandomState(seed + 30)
+    n = 6
+    zones = [i % 3 for i in range(n)]
+    spec = []
+    for i in range(20):
+        labels = {"app": f"a{i % 2}"}
+        # self-selecting zone affinity: first pod bootstraps, later pods
+        # must co-locate in a zone holding one
+        aff = (f"a{i % 2}", "zone") if i % 3 != 2 else None
+        spec.append((int(rng.randint(100, 400)), labels, aff, None))
+    nodes, pods = build(n, zones, spec)
+    np.testing.assert_array_equal(run_engine(nodes, pods),
+                                  numpy_oracle(n, zones, spec))
+
+
+def test_mixed_affinity_anti_affinity_matches_oracle():
+    rng = np.random.RandomState(99)
+    n = 8
+    zones = [i % 2 for i in range(n)]
+    spec = []
+    for i in range(30):
+        labels = {"app": f"a{i % 4}"}
+        aff = (f"a{(i + 1) % 4}", "zone") if i % 5 == 0 and i > 4 else None
+        anti = (f"a{i % 4}", "host") if i % 3 == 0 else None
+        spec.append((int(rng.randint(100, 300)), labels, aff, anti))
+    nodes, pods = build(n, zones, spec)
+    np.testing.assert_array_equal(run_engine(nodes, pods),
+                                  numpy_oracle(n, zones, spec))
+
+
+def test_zone_anti_affinity_blocks_whole_domain():
+    """A zone-keyed anti term must exclude every node in the zone, and the
+    existing-pods direction must block newcomers the first pod anti-selects."""
+    zones = [0, 0, 1]
+    spec = [
+        (100, {"app": "solo"}, None, ("solo", "zone")),  # lands n0
+        (100, {"app": "solo"}, None, ("solo", "zone")),  # z0 blocked -> n2
+        (100, {"app": "solo"}, None, ("solo", "zone")),  # nowhere left
+    ]
+    nodes, pods = build(3, zones, spec)
+    got = run_engine(nodes, pods)
+    np.testing.assert_array_equal(got, numpy_oracle(3, zones, spec))
+    assert list(got) == [0, 2, -1]
