@@ -496,22 +496,22 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         fail_counts = jnp.zeros((0,), jnp.int32)
 
     # ---- scores (feasible nodes only) ---------------------------------
-    # Every normalizer's min/max — and the any-feasible probe — ride ONE
-    # stacked min-reduction (maxes via negation). Per-op reductions each
-    # cost a kernel launch; at 50k scan steps the launches dominate the
-    # step, so Q rows x one reduce beats Q reduces. Values are identical
-    # to the standalone minmax_normalize/max_normalize formulas.
+    # Every normalizer's min/max rides ONE variadic min-reduction (maxes
+    # via negation); any-feasible falls out of the selectHost max below.
+    # Values are identical to the standalone minmax_normalize/
+    # max_normalize formulas.
     big = jnp.float32(3.4e38)
     score = scores.resource_scores_fused(
         state.used, arrs.alloc, inv_alloc, x["req"], cfg.cpu_mem_idx,
         cfg.w_balanced, cfg.w_least, cfg.w_most)
 
-    # row 0: any-feasible probe (min == 0 iff some node is feasible),
-    # riding the variadic min. selectHost below is two monoid reduces
-    # (max + min-index-among-maxima); a (max, index) tuple-reduce was
-    # measured ~2.4x a plain min/max (generic comparator path) and plain
-    # jnp.argmax lowers through that same path — see ROADMAP r4 notes.
-    red_rows = [jnp.where(mask, 0.0, big)]
+    # selectHost below is two monoid reduces (max + min-index-among-
+    # maxima); a (max, index) tuple-reduce was measured ~2.4x a plain
+    # min/max (generic comparator path) and plain jnp.argmax lowers
+    # through that same path — see ROADMAP r4 notes. any-feasible falls
+    # out of the max (== neg_inf iff the mask is empty; real scores are
+    # finite sums of 0..100-scale terms), so no probe row is needed.
+    red_rows = []
 
     def add_row(vec):
         red_rows.append(vec)
@@ -568,12 +568,12 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     # variadic reduce: one fused pass, no stacked [Q, N] materialization (a
     # jnp.stack would write ~Q*N floats to HBM per step just to read them
     # back in the reduce)
-    reds = jax.lax.reduce(
-        tuple(red_rows), tuple(jnp.float32(big) for _ in red_rows),
-        lambda a, b: tuple(jnp.minimum(x, y) for x, y in zip(a, b)),
-        (0,),
-    )
-    any_feasible = reds[0] < big
+    if red_rows:
+        reds = jax.lax.reduce(
+            tuple(red_rows), tuple(jnp.float32(big) for _ in red_rows),
+            lambda a, b: tuple(jnp.minimum(x, y) for x, y in zip(a, b)),
+            (0,),
+        )
 
     if cfg.w_node_aff and cfg.enable_node_aff_score:
         score += cfg.w_node_aff * scores.max_apply(raw_na, -reds[i_na])
@@ -624,6 +624,7 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     # reduce, measured ~2.4x the cost of a plain min/max at [64, 5184]
     masked_score = jnp.where(mask, score, neg_inf)
     top = jnp.max(masked_score)
+    any_feasible = top > neg_inf  # scores are finite; == neg_inf iff mask empty
     sel_node = jnp.min(
         jnp.where(masked_score == top, jax.lax.iota(jnp.int32, n_nodes), n_nodes)
     ).astype(jnp.int32)

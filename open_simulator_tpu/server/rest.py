@@ -207,7 +207,7 @@ class SimulationServer:
             if app_only and LABEL_APP_NAME not in sp.pod.meta.labels:
                 continue
             placements.setdefault(sp.node_name, []).append(sp.pod.key)
-        return {
+        out = {
             "unscheduled_pods": [
                 {"pod": up.pod.key, "reason": up.reason}
                 for up in result.unscheduled_pods
@@ -216,6 +216,10 @@ class SimulationServer:
             "placements": placements,
             "elapsed_s": round(result.elapsed_s, 3),
         }
+        # claim -> PV choices (the PreBind volumeName writes); always
+        # present so the response schema is stable
+        out["volume_bindings"] = dict(result.volume_bindings)
+        return out
 
 
 def _make_handler(server: SimulationServer):

@@ -160,3 +160,58 @@ def test_debug_stats_endpoint():
     stats = srv.debug_stats()
     assert stats["requests"] == 1 and stats["simulations"] == 1
     assert stats["last_elapsed_s"] > 0
+
+
+def test_deploy_apps_reports_volume_bindings():
+    """WFC claim -> PV choices surface in the REST response."""
+    from open_simulator_tpu.server.rest import SimulationServer
+
+    srv = SimulationServer()
+    cluster_yaml = """
+apiVersion: v1
+kind: Node
+metadata: {name: n0, labels: {kubernetes.io/hostname: n0}}
+status:
+  allocatable: {cpu: '4', memory: 8Gi, pods: '110'}
+---
+apiVersion: storage.k8s.io/v1
+kind: StorageClass
+metadata: {name: local-wfc}
+provisioner: kubernetes.io/no-provisioner
+volumeBindingMode: WaitForFirstConsumer
+---
+apiVersion: v1
+kind: PersistentVolume
+metadata: {name: pv-a}
+spec:
+  capacity: {storage: 10Gi}
+  accessModes: [ReadWriteOnce]
+  storageClassName: local-wfc
+status: {phase: Available}
+---
+apiVersion: v1
+kind: PersistentVolumeClaim
+metadata: {name: data, namespace: default}
+spec:
+  accessModes: [ReadWriteOnce]
+  storageClassName: local-wfc
+  resources: {requests: {storage: 5Gi}}
+"""
+    app_yaml = """
+apiVersion: v1
+kind: Pod
+metadata: {name: db, namespace: default}
+spec:
+  containers:
+    - name: c
+      resources: {requests: {cpu: 100m}}
+  volumes:
+    - name: v
+      persistentVolumeClaim: {claimName: data}
+"""
+    resp = srv.deploy_apps({
+        "cluster": {"yaml": cluster_yaml},
+        "apps": [{"name": "a", "yaml": app_yaml}],
+    })
+    assert resp["volume_bindings"] == {"default/data": "pv-a"}
+    assert not resp["unscheduled_pods"]
