@@ -156,6 +156,7 @@ def volumes_from_claim_templates(templates: List[dict]) -> List[dict]:
         elif sc in SC_DEVICE_SSD:
             kind = "SSD"
         else:
-            continue  # not an open-local class; VolumeBinding pass-through
+            continue  # not an open-local class; the VolumeBinding ops
+            # (k8s/volumes.py) handle generic PVC claims
         out.append({"size": str(size_bytes), "kind": kind, "scName": sc})
     return out
