@@ -1,0 +1,114 @@
+"""Property test: for a single pod against an empty random cluster, the
+engine's feasible-node verdict must equal a host-side recomputation from
+the RAW objects (selectors/taints/affinity evaluated directly) — cross-
+validating the encoder's compat-class construction and the per-op masks
+against the semantics they were built from.
+
+The pod is scheduled alone (no carry interference), scores are defaults,
+and only first-pod-decidable ops participate (selector, required node
+affinity, taints, ports vs empty state, fit vs empty state, unschedulable
+marks); feasibility == (some node passes), and when feasible the pick must
+be one of the host-derived feasible nodes.
+"""
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu.encode.snapshot import encode_cluster
+from open_simulator_tpu.engine.scheduler import (
+    device_arrays,
+    make_config,
+    schedule_pods,
+)
+from open_simulator_tpu.k8s.selectors import (
+    node_selector_terms_match,
+    tolerates_taints,
+)
+from tests.conftest import make_node, make_pod
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def random_cluster(rng, n):
+    nodes = []
+    for i in range(n):
+        labels = {ZONE: f"z{rng.randint(3)}"}
+        if rng.rand() < 0.5:
+            labels["disk"] = rng.choice(["ssd", "hdd"])
+        if rng.rand() < 0.3:
+            labels["tier"] = rng.choice(["gold", "silver"])
+        taints = []
+        if rng.rand() < 0.3:
+            taints.append({"key": "dedicated",
+                           "value": rng.choice(["infra", "batch"]),
+                           "effect": "NoSchedule"})
+        nodes.append(make_node(
+            f"n{i}", cpu_m=int(rng.choice([500, 2000, 8000])),
+            mem_mib=int(rng.choice([1024, 8192])),
+            labels=labels, taints=taints,
+            unschedulable=bool(rng.rand() < 0.15)))
+    return nodes
+
+
+def random_pod(rng):
+    kw = dict(cpu=f"{int(rng.choice([100, 1000, 4000]))}m",
+              mem=f"{int(rng.choice([128, 2048, 4096]))}Mi")
+    if rng.rand() < 0.4:
+        kw["node_selector"] = {"disk": rng.choice(["ssd", "hdd"])}
+    if rng.rand() < 0.4:
+        kw["tolerations"] = [{"key": "dedicated", "operator": "Equal",
+                              "value": rng.choice(["infra", "batch"]),
+                              "effect": "NoSchedule"}]
+    if rng.rand() < 0.4:
+        ops = rng.choice(["In", "NotIn", "Exists"])
+        expr = {"key": "tier", "operator": str(ops)}
+        if ops != "Exists":
+            expr["values"] = ["gold"]
+        kw["affinity"] = {"nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [{"matchExpressions": [expr]}]}}}
+    return make_pod("probe", **kw)
+
+
+def host_feasible(nodes, pod):
+    """Independent recomputation straight from the objects."""
+    req = pod.requests()
+    out = []
+    for n in nodes:
+        if n.unschedulable:
+            out.append(False)
+            continue
+        if pod.node_selector and not all(
+                n.meta.labels.get(k) == v for k, v in pod.node_selector.items()):
+            out.append(False)
+            continue
+        if pod.node_affinity_required is not None and not node_selector_terms_match(
+                n.meta.labels, pod.node_affinity_required):
+            out.append(False)
+            continue
+        if not tolerates_taints(
+                [t for t in n.taints if t.effect in ("NoSchedule", "NoExecute")],
+                pod.tolerations):
+            out.append(False)
+            continue
+        if any(req.get(r, 0) > n.allocatable.get(r, 0) for r in req):
+            out.append(False)
+            continue
+        out.append(True)
+    return np.array(out)
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_single_pod_feasibility_matches_host_recomputation(seed):
+    rng = np.random.RandomState(seed)
+    nodes = random_cluster(rng, int(rng.randint(3, 9)))
+    pod = random_pod(rng)
+    snap = encode_cluster(nodes, [pod])
+    out = schedule_pods(device_arrays(snap), snap.arrays.active, make_config(snap))
+    pick = int(np.asarray(out.node)[0])
+    want = host_feasible(nodes, pod)
+    if want.any():
+        assert pick >= 0, (seed, "engine found nothing; host found", np.nonzero(want))
+        assert want[pick], (seed, "engine picked host-infeasible node", pick)
+    else:
+        assert pick == -1, (seed, "engine picked", pick, "host found none")
