@@ -336,9 +336,11 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     f32 = jnp.float32
     true_v = jnp.ones((n_nodes,), dtype=bool)  # identity-compared below
 
-    # compact carry columns are stored bf16; compute in f32 (the casts fuse
-    # into the loop body — only the halved carry bytes hit HBM per step)
-    gc = state.group_count.astype(f32) if cfg.needs_group_count else None
+    # compact carry columns are stored bf16; columns are cast to f32 AT THE
+    # GATHER (ops do group_count[:, g].astype(f32)) so no [N, S] whole-array
+    # convert materializes per step — counts are integers < 256, exact in
+    # both dtypes, and domain matmuls run in f32
+    gc = state.group_count if cfg.needs_group_count else None
     cid = x["class_id"]
 
     cm_aff = arrs.class_affinity[cid] if cfg.enable_class_aff else true_v  # [N]
@@ -359,8 +361,10 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         gc, arrs.topo_onehot, arrs.has_key,
         x["aff_group"], x["aff_key"], x["aff_valid"], x["aff_self"],
     ) if cfg.enable_pod_affinity else true_v)
+    # term_block stays bf16: its only read is a sum-of-nonnegatives > 0
+    # test, which cannot false-positive in bf16
     ok_pod_anti = (filters.pod_anti_affinity_ok(
-        gc, state.term_block.astype(f32), arrs.topo_onehot, arrs.has_key,
+        gc, state.term_block, arrs.topo_onehot, arrs.has_key,
         x["anti_group"], x["anti_key"], x["anti_valid"], x["hit_terms"],
     ) if cfg.enable_anti_affinity else true_v)
 
@@ -391,7 +395,7 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
                 oh = arrs.topo_onehot[k1i]
             dc_nonhost = oh @ dcol                     # broadcast, no N-reduction
             if gc is not None:
-                dc = jnp.where(kid == 0, gc[:, g], dc_nonhost)
+                dc = jnp.where(kid == 0, gc[:, g].astype(f32), dc_nonhost)
             else:
                 dc = dc_nonhost  # spread_hostname gate: no hostname terms
             node_has = arrs.has_key[kid] > 0
@@ -403,7 +407,7 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
                 min_other = jnp.min(jnp.where(dhas, dcol, big))
                 if gc is not None:
                     min_host = jnp.min(
-                        jnp.where(hoisted.elig_host[cid], gc[:, g], big))
+                        jnp.where(hoisted.elig_host[cid], gc[:, g].astype(f32), big))
                     min_val = jnp.where(kid == 0, min_host, min_other)
                 else:
                     min_val = min_other

@@ -44,7 +44,7 @@ def pod_affinity_ok(
     n = group_count.shape[0]
     ok = jnp.ones((n,), dtype=bool)
     for a in range(aff_group.shape[0]):  # A is tiny and static -> unrolled
-        vec = group_count[:, aff_group[a]]
+        vec = group_count[:, aff_group[a]].astype(jnp.float32)
         dc = domain_count(vec, aff_key[a], topo_onehot)
         node_has = has_key[aff_key[a]] > 0
         total = jnp.sum(vec)
@@ -71,7 +71,7 @@ def pod_anti_affinity_ok(
     n = group_count.shape[0]
     ok = jnp.ones((n,), dtype=bool)
     for b in range(anti_group.shape[0]):
-        vec = group_count[:, anti_group[b]]
+        vec = group_count[:, anti_group[b]].astype(jnp.float32)
         dc = domain_count(vec, anti_key[b], topo_onehot)
         term_ok = dc == 0
         ok &= jnp.where(anti_valid[b], term_ok, True)
@@ -98,7 +98,7 @@ def topology_spread_ok(
     n = group_count.shape[0]
     ok = jnp.ones((n,), dtype=bool)
     for c in range(spread_group.shape[0]):
-        vec = group_count[:, spread_group[c]]
+        vec = group_count[:, spread_group[c]].astype(jnp.float32)
         dc = domain_count(vec, spread_key[c], topo_onehot)
         elig = eligible & (has_key[spread_key[c]] > 0)
         min_val, _ = domain_min(vec, spread_key[c], topo_onehot, elig)

@@ -227,7 +227,7 @@ def interpod_preference_raw(
     n = group_count.shape[0]
     raw = jnp.zeros((n,), dtype=jnp.float32) if extra_raw is None else extra_raw
     for a in range(pref_group.shape[0]):
-        vec = group_count[:, pref_group[a]]
+        vec = group_count[:, pref_group[a]].astype(jnp.float32)
         dc = domain_count(vec, pref_key[a], topo_onehot)
         contrib = pref_weight[a] * dc * (has_key[pref_key[a]] > 0)
         raw = raw + jnp.where(pref_valid[a], contrib, 0.0)
