@@ -43,12 +43,16 @@ def _pod_from_template(
     doc = {
         "apiVersion": "v1",
         "kind": "Pod",
-        "metadata": dict(template.get("metadata") or {}),
+        # name/namespace must be present BEFORE parsing: inter-pod
+        # (anti-)affinity terms default their namespace scope to the pod's
+        # namespace at parse time (PodAffinityTerm.from_dict), so setting
+        # meta.namespace afterwards would leave the terms scoped to
+        # "default" and silently matching nothing
+        "metadata": {**dict(template.get("metadata") or {}),
+                     "name": name, "namespace": namespace},
         "spec": template.get("spec") or {},
     }
     pod = k8s.Pod.from_dict(doc)
-    pod.meta.name = name
-    pod.meta.namespace = namespace
     pod.meta.owner_kind = owner_kind
     pod.meta.owner_name = owner_name
     # Workload provenance annotations (reference: AddWorkloadInfoToPod,

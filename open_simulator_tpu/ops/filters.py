@@ -79,31 +79,7 @@ def pod_anti_affinity_ok(
     return ok & ~blocked
 
 
-def topology_spread_ok(
-    group_count: jnp.ndarray,
-    topo_onehot: jnp.ndarray,
-    has_key: jnp.ndarray,
-    eligible: jnp.ndarray,      # [N] active & pod's node-affinity class mask
-    spread_group: jnp.ndarray,  # [Cs]
-    spread_key: jnp.ndarray,    # [Cs]
-    spread_skew: jnp.ndarray,   # [Cs]
-    spread_hard: jnp.ndarray,   # [Cs]
-    spread_valid: jnp.ndarray,  # [Cs]
-    self_match: jnp.ndarray,    # [Cs] bool: pod matches its own constraint selector
-) -> jnp.ndarray:
-    """PodTopologySpread DoNotSchedule constraints (vendored
-    podtopologyspread/filtering.go:285-340): for node n,
-    skew = matchNum(domain(n)) + selfMatch - minMatchNum  must be <= maxSkew;
-    nodes without the topology key fail the constraint."""
-    n = group_count.shape[0]
-    ok = jnp.ones((n,), dtype=bool)
-    for c in range(spread_group.shape[0]):
-        vec = group_count[:, spread_group[c]].astype(jnp.float32)
-        dc = domain_count(vec, spread_key[c], topo_onehot)
-        elig = eligible & (has_key[spread_key[c]] > 0)
-        min_val, _ = domain_min(vec, spread_key[c], topo_onehot, elig)
-        skew = dc + self_match[c].astype(dc.dtype) - min_val
-        term_ok = (has_key[spread_key[c]] > 0) & (skew <= spread_skew[c])
-        applies = spread_valid[c] & spread_hard[c]
-        ok &= jnp.where(applies, term_ok, True)
-    return ok
+# NOTE: the standalone topology_spread_ok op was removed in round 4: the
+# scan engine inlines the DoNotSchedule filter against the dom_count carry
+# (engine/scheduler._step), and the inline path is oracle-tested end to end
+# in tests/test_engine_spread_oracle.py.

@@ -95,3 +95,33 @@ def test_zero_replica_deployment():
                  "template": {"spec": {"containers": [{"name": "c", "image": "i"}]}}},
     })
     assert expand_workload(d) == []
+
+
+def test_expanded_pod_affinity_terms_scope_to_workload_namespace():
+    """Round-4 bug fix: workload expansion must parse the template with the
+    workload's namespace already set — (anti-)affinity terms default their
+    namespace scope at parse time, so late assignment left them scoped to
+    'default' and silently matching nothing for non-default workloads."""
+    dep = Deployment.from_dict({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "web", "namespace": "prod"},
+        "spec": {"replicas": 2, "selector": {"matchLabels": {"app": "web"}},
+                 "template": {"metadata": {"labels": {"app": "web"}},
+                              "spec": {
+                                  "affinity": {"podAntiAffinity": {
+                                      "requiredDuringSchedulingIgnoredDuringExecution": [{
+                                          "labelSelector": {"matchLabels": {"app": "web"}},
+                                          "topologyKey": "kubernetes.io/hostname"}]}},
+                                  "containers": [{"name": "c", "resources": {
+                                      "requests": {"cpu": "100m"}}}]}}},
+    })
+    cluster = ClusterResources()
+    cluster.nodes = [make_node("n0", cpu_m=8000)]
+    app = ClusterResources()
+    app.deployments = [dep]
+    res = simulate(cluster, [AppResource(name="a", resources=app)])
+    # one node, two mutually anti-affine replicas in ns prod: exactly one
+    # schedules (before the fix both landed on n0)
+    assert len(res.scheduled_pods) == 1
+    assert len(res.unscheduled_pods) == 1
+    assert "anti-affinity" in res.unscheduled_pods[0].reason
