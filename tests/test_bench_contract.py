@@ -1,0 +1,47 @@
+"""Guard the driver's bench contract: preset invariants and the measured
+code path (bench.py is the round-over-round record; a drifted preset or a
+broken run_batched would silently corrupt the series)."""
+
+import sys
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def test_preset_invariants():
+    ns = bench.PRESETS["northstar"]
+    wide = bench.PRESETS["northstar-wide"]
+    # the wide metric reuses the northstar snapshot: only lanes may differ
+    assert all(wide[k] == ns[k] for k in ("nodes", "pods", "max_new"))
+    assert wide["scenarios"] > ns["scenarios"]
+    # comparability contract: the default tracks the all-ops workload,
+    # gated and northstar keep the rounds-1..3 easy workload
+    assert bench.PRESETS["default"].get("rich") is True
+    assert not bench.PRESETS["gated"].get("rich", False)
+    assert not ns.get("rich", False)
+    assert bench.PRESETS["northstar-rich"].get("rich") is True
+
+
+def test_run_batched_tiny():
+    """The exact code path the driver times, at toy scale (CPU here)."""
+    snap = bench.build(8, 16, 4, rich=True)
+    dt = bench.run_batched(snap, 4)
+    assert dt > 0
+
+
+def test_all_gates_on_for_rich_build():
+    """The honesty premise: the rich bench workload keeps every
+    make_config feature gate ON (VERDICT r3 #2)."""
+    from open_simulator_tpu.engine.scheduler import make_config
+
+    snap = bench.build(64, 128, 8, rich=True)
+    cfg = make_config(snap)
+    for gate in ("enable_ports", "enable_pod_affinity", "enable_anti_affinity",
+                 "enable_spread_hard", "enable_spread_soft", "enable_pref",
+                 "enable_node_aff_score", "enable_taint_score",
+                 "spread_hostname", "enable_unsched", "enable_class_aff",
+                 "enable_class_taint"):
+        assert getattr(cfg, gate), gate
