@@ -256,13 +256,19 @@ def encode_cluster(
     # vendored NodeResourcesFit checks the *resource form* of
     # alibabacloud.com/gpu-mem against node allocatable, while the
     # annotation form drives the gpu-share device packing — both coexist.
-    res_vocab = ["cpu", "memory", "ephemeral-storage", "pods"]
+    #
+    # Only resources some pod actually REQUESTS are encoded (plus
+    # cpu/memory, which the score ops always read; the implicit one-pod
+    # slot keeps "pods" requested whenever pods exist). A node-allocatable
+    # key no pod requests would have a constant-true fit row (req 0 can
+    # always be subtracted from nonnegative headroom) and an "Insufficient
+    # ..." reason row that can never fire — it would only widen the hot
+    # [N, R] headroom/fit tensors the scan touches every step (a dead
+    # ephemeral-storage column was 25% of that traffic at the bench
+    # shapes). Resources requested but exposed by no node encode as
+    # alloc 0 and correctly reject the requesting pods.
+    res_vocab = ["cpu", "memory"]
     seen = set(res_vocab)
-    for n in all_nodes:
-        for r in n.allocatable:
-            if r not in seen:
-                seen.add(r)
-                res_vocab.append(r)
     for p in pods:
         for r in p.requests():
             if r not in seen:

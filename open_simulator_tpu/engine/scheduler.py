@@ -161,9 +161,17 @@ class SimState(NamedTuple):
 
     group_count/term_block store small integer counts; with
     cfg.compact_carry they are bfloat16 (f32 otherwise), halving their
-    carry bytes per step."""
+    carry bytes per step.
 
-    used: jnp.ndarray         # [N, R] f32
+    Resource occupancy is carried as HEADROOM (allocatable - used) rather
+    than used: fit is then one compare against the carry (req <= headroom,
+    no per-step [N, R] add and no alloc read in the hot fusion) and the
+    resource scores read free fractions directly. Encoded requests are
+    integer-valued (milli-cpu, MiB, counts) below 2^24, so the running
+    subtraction is bit-exact against the alloc-minus-sum form; decode
+    recovers used = alloc - headroom."""
+
+    headroom: jnp.ndarray     # [N, R] f32 = alloc - used
     group_count: jnp.ndarray  # [N, S] bf16 | f32
     term_block: jnp.ndarray   # [N, T] bf16 | f32
     pref_paint: jnp.ndarray   # [N, T2] f32 weighted preferred-term domains
@@ -210,7 +218,7 @@ def init_state(arrs: SnapshotArrays, cfg: "EngineConfig | None" = None) -> SimSt
     cdt = jnp.bfloat16 if (cfg is not None and cfg.compact_carry) else f32
     k1, _, d = arrs.topo_onehot.shape
     return SimState(
-        used=jnp.zeros((n, r), f32),
+        headroom=jnp.asarray(arrs.alloc, f32),
         group_count=jnp.zeros((n, s), cdt),
         term_block=jnp.zeros((n, t), cdt),
         pref_paint=jnp.zeros((n, t2), f32),
@@ -257,7 +265,7 @@ def _apply_prefix_chunk(arrs: SnapshotArrays, cfg: EngineConfig,
     hp = jax.lax.Precision.HIGHEST
     idx = arrs.forced_node[lo:hi].astype(jnp.int32)       # [c], all >= 0
     oh = jax.nn.one_hot(idx, arrs.alloc.shape[0], dtype=f32)   # [c, N]
-    used = state.used + jnp.matmul(oh.T, arrs.req[lo:hi], precision=hp)
+    headroom = state.headroom - jnp.matmul(oh.T, arrs.req[lo:hi], precision=hp)
     gc = state.group_count
     match = arrs.match_groups[lo:hi].astype(f32)
     if cfg.needs_group_count:
@@ -287,7 +295,7 @@ def _apply_prefix_chunk(arrs: SnapshotArrays, cfg: EngineConfig,
                 arrs.topo_onehot[kk].T, precision=hp))    # [c, N]
     if cfg.enable_anti_affinity:
         own = arrs.own_terms[lo:hi].astype(f32)           # [c, T]
-        paint = jnp.zeros((state.used.shape[0], own.shape[1]), f32)
+        paint = jnp.zeros((state.headroom.shape[0], own.shape[1]), f32)
         for kk in range(len(sd_all)):                     # K is tiny
             mask_t = (arrs.term_key == kk).astype(f32)    # [T]
             paint = paint + jnp.matmul(
@@ -307,7 +315,7 @@ def _apply_prefix_chunk(arrs: SnapshotArrays, cfg: EngineConfig,
             col = jax.nn.one_hot(arrs.pref_tid[lo:hi, a], t2_n, dtype=f32)
             pref = pref + jnp.matmul(
                 sd_a.T, col * w[:, None], precision=hp)
-    return SimState(used, gc, term, pref, ports, state.gpu_used,
+    return SimState(headroom, gc, term, pref, ports, state.gpu_used,
                     state.vg_used, state.sdev_taken, dom, state.pv_taken,
                     vol_cnt)
 
@@ -330,6 +338,67 @@ def _pod_xs(arrs: SnapshotArrays) -> Dict[str, jnp.ndarray]:
     return xs
 
 
+# ---- live-leaf xs filtering --------------------------------------------
+# Only the xs leaves the gate config actually reads are fed to scan; dead
+# leaves never reach the jit, so trace/compile work tracks the gated op
+# set and the dis/nom blocks below compile out entirely on the sweep path.
+#
+# NOTE(perf): PACKING the live leaves into one [P, W] buffer per dtype
+# (fewer per-step dynamic-slices) was measured and is a LOSS on v5e —
+# 100 -> 64 scen/s at the north-star shape packed unconditionally,
+# 100 -> 74 packed gate-aware. The scan's per-leaf slicing is NOT a
+# bottleneck (XLA prefetches the tiny rows fine); forcing leaves through
+# one buffer only serializes the loads. Do not retry.
+
+
+def _live_xs_names(cfg: EngineConfig, has_disabled: bool,
+                   has_nominated: bool) -> "set[str] | None":
+    """The xs leaves _step can read under this gate config; None = all
+    (extension ops may read any key, extensions.py)."""
+    if cfg.extensions:
+        return None
+    live = {"req", "forced_node"}
+    if (cfg.enable_class_aff or cfg.enable_class_taint
+            or cfg.enable_spread_hard  # hoisted eligibility rows are per-class
+            or (cfg.w_node_aff and cfg.enable_node_aff_score)
+            or (cfg.w_taint and cfg.enable_taint_score)):
+        live.add("class_id")
+    if cfg.tie_break_seed:
+        live.add("_pod_index")
+    if has_disabled:
+        live.add("_disabled")
+    if has_nominated:
+        live.add("_nominated")
+    if cfg.enable_ports:
+        live.add("ports")
+    if cfg.needs_group_count or cfg.enable_spread:
+        live.add("match_groups")
+    if cfg.enable_pod_affinity:
+        live |= {"aff_group", "aff_key", "aff_valid", "aff_self"}
+    if cfg.enable_anti_affinity:
+        live |= {"anti_group", "anti_key", "anti_valid", "own_terms",
+                 "hit_terms"}
+    if cfg.enable_spread:
+        live |= {"spread_group", "spread_key", "spread_skew", "spread_hard",
+                 "spread_valid"}
+    if cfg.enable_pref:
+        live |= {"pref_group", "pref_key", "pref_weight", "pref_valid",
+                 "pref_tid", "hit_pref"}
+    if cfg.enable_gpu:
+        live |= {"gpu_mem", "gpu_cnt", "gpu_forced", "gpu_has_forced"}
+    if cfg.enable_storage:
+        live |= {"lvm_req", "sdev_req", "sdev_req_ssd"}
+    if cfg.enable_vol_static:
+        live |= {"vol_cid", "vol_pv_missing"}
+    if cfg.enable_pv_match:
+        live |= {"wfc_ccid", "wfc_valid"}
+    if cfg.enable_vol_limits:
+        live.add("vol_limit_req")
+    return live
+
+
+
+
 def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
           hoisted, inv_alloc, state: SimState, x):
     n_nodes = arrs.alloc.shape[0]
@@ -341,10 +410,20 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     # convert materializes per step — counts are integers < 256, exact in
     # both dtypes, and domain matmuls run in f32
     gc = state.group_count if cfg.needs_group_count else None
-    cid = x["class_id"]
+    # None iff no live op gathers per-class rows; every gated use below
+    # asserts, so drift between a gate and _live_xs_names fails at trace
+    # time instead of broadcasting a [1, C] row into the mask math
+    cid = x.get("class_id")
 
-    cm_aff = arrs.class_affinity[cid] if cfg.enable_class_aff else true_v  # [N]
-    cm_taint = arrs.class_taint[cid] if cfg.enable_class_taint else true_v
+    def _cid():
+        if cid is None:  # not assert: must survive python -O
+            raise AssertionError(
+                "class_id xs leaf is dead but a per-class op is live — "
+                "_live_xs_names and _step disagree")
+        return cid
+
+    cm_aff = arrs.class_affinity[_cid()] if cfg.enable_class_aff else true_v  # [N]
+    cm_taint = arrs.class_taint[_cid()] if cfg.enable_class_taint else true_v
 
     # ---- filter pipeline (ordered; see filter_op_table) ---------------
     ok_unsched = ~arrs.unschedulable if cfg.enable_unsched else true_v
@@ -353,10 +432,11 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     ok_ports = (filters.ports_free(state.ports_used, x["ports"])
                 if cfg.enable_ports else true_v)
     # NOTE(perf): restricting fit to the requested-resource columns
-    # (used[:, :ra] slicing) was measured ~12% SLOWER at 5120n x 64 lanes
-    # — the carry slice defeats XLA's in-place carry update and forces a
-    # copy. Full width it is; never-requested columns cost one compare.
-    fit = filters.fit_per_resource(state.used, arrs.alloc, x["req"])   # [N, R]
+    # (headroom[:, :ra] slicing) was measured ~12% SLOWER at 5120n x 64
+    # lanes — the carry slice defeats XLA's in-place carry update and
+    # forces a copy. Full width it is; never-requested columns cost one
+    # compare.
+    fit = filters.fit_per_resource(state.headroom, x["req"])   # [N, R]
     ok_pod_aff = (filters.pod_affinity_ok(
         gc, arrs.topo_onehot, arrs.has_key,
         x["aff_group"], x["aff_key"], x["aff_valid"], x["aff_self"],
@@ -402,16 +482,16 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
             if cfg.enable_spread_hard:
                 # hard constraint (DoNotSchedule) -> filter; minMatchNum
                 # over domains holding an eligible node (filtering.go)
-                dhas = (hoisted.domain_has[cid, 0] if k1_static == 1
-                        else hoisted.domain_has[cid, k1i])   # [D]
+                dhas = (hoisted.domain_has[_cid(), 0] if k1_static == 1
+                        else hoisted.domain_has[_cid(), k1i])   # [D]
                 min_other = jnp.min(jnp.where(dhas, dcol, big))
                 if gc is not None:
                     min_host = jnp.min(
-                        jnp.where(hoisted.elig_host[cid], gc[:, g].astype(f32), big))
+                        jnp.where(hoisted.elig_host[_cid()], gc[:, g].astype(f32), big))
                     min_val = jnp.where(kid == 0, min_host, min_other)
                 else:
                     min_val = min_other
-                min_val = jnp.where(hoisted.any_elig[cid, kid], min_val, 0.0)
+                min_val = jnp.where(hoisted.any_elig[_cid(), kid], min_val, 0.0)
                 self_m = x["match_groups"][g] & x["spread_valid"][c]
                 skew = dc + self_m.astype(dc.dtype) - min_val
                 term_ok = node_has & (skew <= x["spread_skew"][c])
@@ -506,7 +586,7 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     # max_normalize formulas.
     big = jnp.float32(3.4e38)
     score = scores.resource_scores_fused(
-        state.used, arrs.alloc, inv_alloc, x["req"], cfg.cpu_mem_idx,
+        state.headroom, inv_alloc, x["req"], cfg.cpu_mem_idx,
         cfg.w_balanced, cfg.w_least, cfg.w_most)
 
     # selectHost below is two monoid reduces (max + min-index-among-
@@ -522,10 +602,10 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         return len(red_rows) - 1
 
     if cfg.w_node_aff and cfg.enable_node_aff_score:
-        raw_na = arrs.class_node_aff_score[cid]
+        raw_na = arrs.class_node_aff_score[_cid()]
         i_na = add_row(jnp.where(mask, -raw_na, 0.0))    # -max(where(m, raw, 0))
     if cfg.w_taint and cfg.enable_taint_score:
-        raw_tt = arrs.class_taint_prefer[cid]
+        raw_tt = arrs.class_taint_prefer[_cid()]
         i_tt = add_row(jnp.where(mask, -raw_tt, 0.0))
     if cfg.w_interpod and cfg.enable_pref:
         # existing pods' preferred (anti-)affinity toward this pod: one
@@ -607,14 +687,16 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     # Preemption retry: a nominated node (status.nominatedNodeName analog,
     # defaultpreemption PostFilter) restricts the pick to that node while it
     # is still feasible; if other pods took it meanwhile, fall back to the
-    # full feasible set like the vendored retry does.
-    nom = x["_nominated"]
-    nom_row = jax.nn.one_hot(nom, n_nodes, dtype=bool)  # -1 -> all-zero row
-    # "nominated node still feasible" is a scalar gather, not an N-reduce;
-    # the explicit range check keeps out-of-range nominations falling back
-    # to the full feasible set (a clamped gather would read mask[n-1])
-    use_nom = (nom >= 0) & (nom < n_nodes) & mask[jnp.clip(nom, 0, n_nodes - 1)]
-    mask = jnp.where(use_nom, mask & nom_row, mask)
+    # full feasible set like the vendored retry does. The sweep path passes
+    # no nominations, so the whole block compiles out (nom is None).
+    nom = x.get("_nominated")
+    if nom is not None:
+        nom_row = jax.nn.one_hot(nom, n_nodes, dtype=bool)  # -1 -> all-zero row
+        # "nominated node still feasible" is a scalar gather, not an N-reduce;
+        # the explicit range check keeps out-of-range nominations falling back
+        # to the full feasible set (a clamped gather would read mask[n-1])
+        use_nom = (nom >= 0) & (nom < n_nodes) & mask[jnp.clip(nom, 0, n_nodes - 1)]
+        mask = jnp.where(use_nom, mask & nom_row, mask)
 
     neg_inf = jnp.float32(-3.4e38)
     if cfg.tie_break_seed:
@@ -646,11 +728,12 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         forced >= 0, forced, jnp.where(do_schedule & any_feasible, sel_node, -1)
     ).astype(jnp.int32)
     # A preemption victim is a deleted pod: no bind, no reasons, node = -3
-    # (the host decodes -3 as "preempted by <pod>").
-    dis = x["_disabled"]
-    final_node = jnp.where(dis, jnp.int32(-3), final_node)
-    fail_counts = jnp.where(dis, 0, fail_counts)
-    feasible_n = jnp.where(dis, 0, feasible_n)
+    # (the host decodes -3 as "preempted by <pod>"). No victims -> no ops.
+    dis = x.get("_disabled")
+    if dis is not None:
+        final_node = jnp.where(dis, jnp.int32(-3), final_node)
+        fail_counts = jnp.where(dis, 0, fail_counts)
+        feasible_n = jnp.where(dis, 0, feasible_n)
 
     # ---- bind: carry update (masked when final_node < 0) --------------
     # NOTE(perf): onehot outer-product adds beat .at[node] row-scatters here —
@@ -661,7 +744,7 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     safe_node = jnp.maximum(final_node, 0)
     onehot_n = jax.nn.one_hot(final_node, n_nodes, dtype=f32)  # -1 -> zeros
     cdt = state.group_count.dtype
-    used = state.used + onehot_n[:, None] * x["req"][None, :]
+    headroom = state.headroom - onehot_n[:, None] * x["req"][None, :]
     if cfg.needs_group_count:
         group_count = state.group_count + (
             onehot_n[:, None] * x["match_groups"].astype(f32)[None, :]
@@ -752,7 +835,7 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     else:
         vol_cnt = state.vol_cnt
 
-    new_state = SimState(used, group_count, term_block, pref_paint, ports_used,
+    new_state = SimState(headroom, group_count, term_block, pref_paint, ports_used,
                          gpu_used, vg_used, sdev_taken, dom_count, pv_taken,
                          vol_cnt)
     return new_state, (final_node, fail_counts, feasible_n, pick, vol_pick)
@@ -792,12 +875,20 @@ def schedule_pods(
         # keep the global pod index (tie_break_seed folds it into the
         # jitter key; hoisting must not shift it)
         xs["_pod_index"] = xs["_pod_index"] + k
-    xs["_disabled"] = (
-        jnp.zeros(n_scan, dtype=bool) if disabled is None else disabled.astype(bool)
-    )
-    xs["_nominated"] = (
-        jnp.full(n_scan, -1, jnp.int32) if nominated is None else nominated.astype(jnp.int32)
-    )
+    # no victims / no nominations (the sweep path) -> the columns do not
+    # exist and their _step blocks compile out; with extensions the live
+    # set is None (an extension may read any key), so neutral columns are
+    # materialized for them
+    live = _live_xs_names(cfg, has_disabled=disabled is not None,
+                          has_nominated=nominated is not None)
+    if disabled is not None:
+        xs["_disabled"] = disabled.astype(bool)
+    elif live is None:
+        xs["_disabled"] = jnp.zeros(n_scan, dtype=bool)
+    if nominated is not None:
+        xs["_nominated"] = nominated.astype(jnp.int32)
+    elif live is None:
+        xs["_nominated"] = jnp.full(n_scan, -1, jnp.int32)
     if cfg.enable_spread:
         from open_simulator_tpu.ops.domains import hoist_active_stats
 
@@ -808,6 +899,8 @@ def schedule_pods(
     # loop-invariant reciprocal: the per-step resource-score divides become
     # multiplies (inv = 0 encodes the cap<=0 -> fraction 0 convention)
     inv_alloc = jnp.where(arrs.alloc > 0, 1.0 / jnp.where(arrs.alloc > 0, arrs.alloc, 1.0), 0.0)
+    if live is not None:
+        xs = {k: v for k, v in xs.items() if k in live}
     step = functools.partial(_step, scan_arrs, active, cfg, hoisted, inv_alloc)
     final_state, (nodes, fail_counts, feasible, gpu_pick, vol_pick) = jax.lax.scan(
         step, state, xs, unroll=cfg.scan_unroll

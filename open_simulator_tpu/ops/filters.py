@@ -12,13 +12,15 @@ import jax.numpy as jnp
 from open_simulator_tpu.ops.domains import domain_count, domain_min
 
 
-def fit_per_resource(used: jnp.ndarray, alloc: jnp.ndarray, req_p: jnp.ndarray) -> jnp.ndarray:
+def fit_per_resource(headroom: jnp.ndarray, req_p: jnp.ndarray) -> jnp.ndarray:
     """NodeResourcesFit (vendored noderesources/fit.go:221-283 fitsRequest):
     [N, R] bool — per-resource feasibility, so reasons can say which
     resource was insufficient. Zero-allocatable resources fail only if
     requested (matches k8s: a node that doesn't expose a resource cannot
-    host a pod requesting it)."""
-    return used + req_p[None, :] <= alloc
+    host a pod requesting it). The engine carries headroom = alloc - used,
+    so the vendored `used + req <= alloc` is one compare against the carry
+    (bit-equivalent: encoded requests are integer-valued below 2^24)."""
+    return req_p[None, :] <= headroom
 
 
 def ports_free(ports_used: jnp.ndarray, pod_ports: jnp.ndarray) -> jnp.ndarray:

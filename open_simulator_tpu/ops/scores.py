@@ -83,8 +83,7 @@ def balanced_allocation_score(
 
 
 def resource_scores_fused(
-    used: jnp.ndarray,        # [N, R]
-    alloc: jnp.ndarray,       # [N, R]
+    headroom: jnp.ndarray,    # [N, R] = alloc - used (the engine carry)
     inv_alloc: jnp.ndarray,   # [N, R] = 1/alloc where alloc > 0 else 0
     req_p: jnp.ndarray,       # [R]
     cpu_mem_idx,
@@ -92,27 +91,32 @@ def resource_scores_fused(
     w_least: float,
     w_most: float,
 ) -> jnp.ndarray:
-    """Balanced + Least(+Most)Allocated in one pass over shared request
-    fractions — the scan engine's hot-path form of the three functions
-    above. The per-step divides become multiplies by the loop-invariant
-    inv_alloc, and the 2-point std collapses to |a-b|/2 (algebraically
-    identical; float rounding differs at the ulp level, which only
-    reorders ties that were already rounding-level)."""
+    """Balanced + Least(+Most)Allocated in one pass over shared FREE
+    fractions h = (headroom - req) * inv_alloc — the scan engine's
+    hot-path form of the three functions above. The per-step divides
+    become multiplies by the loop-invariant inv_alloc; the 2-point std
+    collapses to |a-b|/2 and is invariant under a -> 1-a, so balanced
+    reads |h_cpu - h_mem| directly (algebraically identical; float
+    rounding differs at the ulp level, which only reorders ties that were
+    already rounding-level). LeastAllocated's max(free, 0)*inv is
+    bit-identical to the used-form. Convention shift on pathological
+    nodes: where allocatable <= 0 the used-form scored the resource as 0%
+    utilized, the headroom form scores it 0% free — such nodes reject any
+    pod actually requesting the resource either way."""
     ci, mi = cpu_mem_idx
-    want_c = used[:, ci] + req_p[ci]
-    want_m = used[:, mi] + req_p[mi]
-    a_c = want_c * inv_alloc[:, ci]
-    a_m = want_m * inv_alloc[:, mi]
-    out = jnp.zeros(used.shape[:1], dtype=jnp.float32)
+    h_c = (headroom[:, ci] - req_p[ci]) * inv_alloc[:, ci]
+    h_m = (headroom[:, mi] - req_p[mi]) * inv_alloc[:, mi]
+    out = jnp.zeros(headroom.shape[:1], dtype=jnp.float32)
     if w_balanced:
-        out = out + w_balanced * ((1.0 - jnp.abs(a_c - a_m) * 0.5) * MAX_SCORE)
+        out = out + w_balanced * ((1.0 - jnp.abs(h_c - h_m) * 0.5) * MAX_SCORE)
     if w_least:
-        free_c = jnp.maximum(alloc[:, ci] - want_c, 0.0) * inv_alloc[:, ci]
-        free_m = jnp.maximum(alloc[:, mi] - want_m, 0.0) * inv_alloc[:, mi]
-        out = out + w_least * ((free_c + free_m) * (MAX_SCORE / 2.0))
+        out = out + w_least * (
+            (jnp.maximum(h_c, 0.0) + jnp.maximum(h_m, 0.0)) * (MAX_SCORE / 2.0)
+        )
     if w_most:
         out = out + w_most * (
-            (jnp.clip(a_c, 0.0, 1.0) + jnp.clip(a_m, 0.0, 1.0)) * (MAX_SCORE / 2.0)
+            (jnp.clip(1.0 - h_c, 0.0, 1.0) + jnp.clip(1.0 - h_m, 0.0, 1.0))
+            * (MAX_SCORE / 2.0)
         )
     return out
 

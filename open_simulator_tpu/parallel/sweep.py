@@ -189,8 +189,8 @@ def capacity_sweep(
     else:
         # all-zero by construction; skip the device->host transfer
         fail = np.zeros(out.fail_counts.shape, dtype=np.int32)
-    used = np.asarray(out.state.used)          # [S, N, R]
     alloc = np.asarray(arrs.alloc)             # [N, R]
+    used = alloc[None] - np.asarray(out.state.headroom)   # [S, N, R]
 
     cpu_i = snapshot.resources.index("cpu")
     mem_i = snapshot.resources.index("memory")

@@ -25,12 +25,27 @@ def save_simulation(
     state: SimState,
     node_assign: Optional[np.ndarray] = None,
     meta: Optional[dict] = None,
+    resources: Optional[list] = None,
 ) -> None:
+    """Write one .npz checkpoint. Pass `resources` (snapshot.resources) so
+    a resume against a re-encoded cluster can detect a changed resource
+    column order (the [N, R] carry records no names itself)."""
     # npz cannot round-trip ml_dtypes (the compact bfloat16 carry comes back
     # as raw void bytes) — store widened and record the original dtype
+    # a state loaded from a legacy file but NOT passed through resume_state
+    # still holds `used` values in the headroom slot — write it back out in
+    # the legacy format (state_used) so the next load re-flags it, instead
+    # of silently laundering used-values into a state_headroom entry
+    legacy_unconverted = bool(meta and meta.get("_headroom_is_legacy_used"))
+    if meta:
+        # other underscore keys are loader-internal (e.g. _resources);
+        # persisting them would shadow the next load's own markers
+        meta = {k: v for k, v in meta.items() if not k.startswith("_")}
     arrays = {}
     dtypes = {}
     for k, v in state._asdict().items():
+        if k == "headroom" and legacy_unconverted:
+            k = "used"
         a = np.asarray(v)
         dtypes[k] = str(a.dtype)
         if a.dtype not in (np.float32, np.float64, np.int32, np.int64, np.bool_):
@@ -38,8 +53,11 @@ def save_simulation(
         arrays[f"state_{k}"] = a
     if node_assign is not None:
         arrays["node_assign"] = np.asarray(node_assign)
+    wrapper = {"user": meta or {}, "state_dtypes": dtypes}
+    if resources is not None:
+        wrapper["resources"] = list(resources)
     arrays["meta_json"] = np.frombuffer(
-        json.dumps({"user": meta or {}, "state_dtypes": dtypes}).encode(), dtype=np.uint8
+        json.dumps(wrapper).encode(), dtype=np.uint8
     )
     np.savez_compressed(path, **arrays)
 
@@ -51,6 +69,9 @@ def load_simulation(path: str) -> Tuple[SimState, Optional[np.ndarray], dict]:
         raw = json.loads(bytes(z["meta_json"]).decode()) if "meta_json" in z.files else {}
         if "state_dtypes" in raw:
             meta, dtypes = raw.get("user", {}), raw["state_dtypes"]
+            if "resources" in raw:
+                meta = dict(meta)
+                meta["_resources"] = raw["resources"]
         else:  # pre-round-2 checkpoint: meta only, dtypes as stored
             meta, dtypes = raw, {}
         fields = {}
@@ -63,11 +84,19 @@ def load_simulation(path: str) -> Tuple[SimState, Optional[np.ndarray], dict]:
             if want != str(a.dtype):
                 a = a.astype(np.dtype(want) if want != "bfloat16" else ml_dtypes.bfloat16)
             fields[name] = a
+        # pre-round-4.2 checkpoints carried `used`; the carry is now
+        # headroom = alloc - used, which needs the snapshot's alloc to
+        # convert — resume_state() does it (flagged via the private meta
+        # key below, since only the caller holds the arrays)
+        if "used" in fields and "headroom" not in fields:
+            fields["headroom"] = fields.pop("used")
+            meta = dict(meta)
+            meta["_headroom_is_legacy_used"] = True
         # checkpoints predating newer SimState fields (e.g. the open-local
         # vg_used/sdev_taken columns): fill empty zero columns so old files
         # keep loading (their snapshots had no storage, so [N, 1] zeros are
         # the exact state they would have carried)
-        n = fields["used"].shape[0] if "used" in fields else 0
+        n = fields["headroom"].shape[0] if "headroom" in fields else 0
         for name in SimState._fields:
             if name in fields:
                 continue
@@ -99,12 +128,40 @@ def load_simulation(path: str) -> Tuple[SimState, Optional[np.ndarray], dict]:
     return state, node_assign, meta
 
 
-def resume_state(state: SimState, arrs) -> SimState:
+def resume_state(state: SimState, arrs, meta: dict,
+                 resources: Optional[list] = None) -> SimState:
     """Make a loaded state resumable against its snapshot arrays: rebuild
     any back-compat-filled dom_count from the per-node group_count
     (dom_count[k,d,s] = sum_n topo_onehot[k,n,d] * group_count[n,s] — the
-    same 0/1 increments summed in a different order, so integer-exact).
-    Call before passing a loaded state back into schedule_pods."""
+    same 0/1 increments summed in a different order, so integer-exact),
+    and convert a legacy `used` carry (pre-headroom checkpoints) to
+    headroom = alloc - used. `meta` is REQUIRED (pass the dict
+    load_simulation returned): the legacy-used marker lives there, and a
+    skipped conversion would silently invert resource accounting. The
+    marker is popped, so repeated calls with the same dict cannot
+    double-convert. Pass `resources` (snapshot.resources) to verify the
+    checkpoint's [N, R] column order still matches the snapshot's. Call
+    before passing a loaded state back into schedule_pods."""
+    if meta is None:
+        raise TypeError(
+            "resume_state requires the meta dict load_simulation returned "
+            "(it carries the legacy-used conversion marker)")
+    if np.asarray(state.headroom).shape != np.asarray(arrs.alloc).shape:
+        raise ValueError(
+            f"checkpoint carry shape {np.asarray(state.headroom).shape} does "
+            f"not match the snapshot's [N, R] {np.asarray(arrs.alloc).shape} "
+            "— was the cluster re-encoded with different nodes or resources?")
+    saved_res = (meta or {}).get("_resources")
+    if saved_res is not None and resources is not None and list(saved_res) != list(resources):
+        raise ValueError(
+            f"checkpoint resource columns {list(saved_res)} do not match the "
+            f"snapshot's {list(resources)} — the [N, R] carry would silently "
+            "mix columns; re-encode with the original pod set or discard the "
+            "checkpoint")
+    if meta is not None and meta.pop("_headroom_is_legacy_used", False):
+        state = state._replace(
+            headroom=np.asarray(arrs.alloc, dtype=np.float32)
+            - np.asarray(state.headroom, dtype=np.float32))
     k1, _, d = arrs.topo_onehot.shape
     s = np.asarray(state.group_count).shape[1]
     state = _widen_vol_cnt(state, arrs)

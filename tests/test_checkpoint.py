@@ -38,6 +38,114 @@ def test_resume_equals_full_run(tmp_path):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+def test_legacy_used_checkpoint_converts_once(tmp_path):
+    """A pre-headroom checkpoint stores `state_used`; load must flag it,
+    resume_state must convert to headroom = alloc - used EXACTLY ONCE
+    (idempotent across repeated calls with the same meta dict), and a
+    meta round-trip through save_simulation must not re-trigger the
+    conversion."""
+    from open_simulator_tpu.utils.checkpoint import resume_state
+
+    snap = ge._synthetic_snapshot(n_nodes=12, n_pods=64)
+    cfg = make_config(snap)
+    arrs = device_arrays(snap)
+    full = schedule_pods(arrs, arrs.active, cfg)
+
+    k = 30
+    first = schedule_pods(slice_pods(arrs, 0, k), arrs.active, cfg)
+    ckpt = tmp_path / "legacy.npz"
+    save_simulation(str(ckpt), first.state, np.asarray(first.node),
+                    resources=snap.resources)
+
+    # rewrite the file as an old-format checkpoint: state_used = alloc -
+    # headroom, no state_headroom entry
+    alloc = np.asarray(arrs.alloc, dtype=np.float32)
+    with np.load(str(ckpt)) as z:
+        entries = {kk: z[kk] for kk in z.files}
+    entries["state_used"] = alloc - entries.pop("state_headroom")
+    import json as _json
+    raw = _json.loads(bytes(entries["meta_json"]).decode())
+    raw["state_dtypes"]["used"] = raw["state_dtypes"].pop("headroom")
+    entries["meta_json"] = np.frombuffer(
+        _json.dumps(raw).encode(), dtype=np.uint8)
+    np.savez_compressed(str(ckpt), **entries)
+
+    state, _, meta = load_simulation(str(ckpt))
+    assert meta.get("_headroom_is_legacy_used") is True
+    state = resume_state(state, arrs, meta, resources=snap.resources)
+    np.testing.assert_allclose(
+        np.asarray(state.headroom), np.asarray(first.state.headroom), atol=0)
+    # idempotent: the flag was popped, a second call is a no-op
+    state2 = resume_state(state, arrs, meta, resources=snap.resources)
+    np.testing.assert_allclose(
+        np.asarray(state2.headroom), np.asarray(state.headroom), atol=0)
+    # converted-state round-trip: the popped flag means the dict is clean,
+    # so the save writes the new format and the next load does not re-flag
+    ckpt2 = tmp_path / "converted.npz"
+    save_simulation(str(ckpt2), state, meta=meta)
+    _, _, meta2 = load_simulation(str(ckpt2))
+    assert "_headroom_is_legacy_used" not in meta2
+
+    resumed = schedule_pods(
+        slice_pods(arrs, k, snap.n_pods), arrs.active, cfg,
+        state=SimState(*[np.asarray(v) for v in state]),
+    )
+    np.testing.assert_array_equal(np.asarray(full.node)[k:], np.asarray(resumed.node))
+
+
+def test_legacy_copy_without_resume_stays_legacy(tmp_path):
+    """A migration tool that loads a legacy checkpoint and re-saves it
+    WITHOUT resume_state (it has no snapshot arrays) must write the
+    legacy format back (state_used), not launder used-values into a
+    state_headroom entry the next load would trust."""
+    snap = ge._synthetic_snapshot(n_nodes=12, n_pods=64)
+    cfg = make_config(snap)
+    arrs = device_arrays(snap)
+    first = schedule_pods(slice_pods(arrs, 0, 30), arrs.active, cfg)
+    ckpt = tmp_path / "legacy.npz"
+    save_simulation(str(ckpt), first.state)
+    alloc = np.asarray(arrs.alloc, dtype=np.float32)
+    with np.load(str(ckpt)) as z:
+        entries = {kk: z[kk] for kk in z.files}
+    entries["state_used"] = alloc - entries.pop("state_headroom")
+    import json as _json
+    raw = _json.loads(bytes(entries["meta_json"]).decode())
+    raw["state_dtypes"]["used"] = raw["state_dtypes"].pop("headroom")
+    entries["meta_json"] = np.frombuffer(_json.dumps(raw).encode(), dtype=np.uint8)
+    np.savez_compressed(str(ckpt), **entries)
+
+    state, node_assign, meta = load_simulation(str(ckpt))
+    copied = tmp_path / "copied.npz"
+    save_simulation(str(copied), state, node_assign, meta=meta)
+    with np.load(str(copied)) as z:
+        assert "state_used" in z.files and "state_headroom" not in z.files
+    state2, _, meta2 = load_simulation(str(copied))
+    assert meta2.get("_headroom_is_legacy_used") is True
+    from open_simulator_tpu.utils.checkpoint import resume_state
+    state2 = resume_state(state2, arrs, meta2)
+    np.testing.assert_allclose(
+        np.asarray(state2.headroom), np.asarray(first.state.headroom), atol=0)
+
+
+def test_resume_rejects_mismatched_resources(tmp_path):
+    """A checkpoint resumed against a snapshot whose resource columns
+    differ (order or set) must fail loudly, not mix [N, R] columns."""
+    import pytest
+    from open_simulator_tpu.utils.checkpoint import resume_state
+
+    snap = ge._synthetic_snapshot(n_nodes=12, n_pods=64)
+    cfg = make_config(snap)
+    arrs = device_arrays(snap)
+    first = schedule_pods(slice_pods(arrs, 0, 30), arrs.active, cfg)
+    ckpt = tmp_path / "sim.npz"
+    save_simulation(str(ckpt), first.state, resources=snap.resources)
+    state, _, meta = load_simulation(str(ckpt))
+    swapped = list(snap.resources)
+    swapped[-1], swapped[-2] = swapped[-2], swapped[-1]
+    with pytest.raises(ValueError, match="resource columns"):
+        resume_state(state, arrs, meta, resources=swapped)
+
+
 def test_pre_round4_checkpoint_loads_and_resumes(tmp_path):
     """A checkpoint written before the dom_count carry existed must still
     load (shape-safe fill) and resume exactly after resume_state rebuilds
@@ -62,9 +170,9 @@ def test_pre_round4_checkpoint_loads_and_resumes(tmp_path):
         stripped = {kk: z[kk] for kk in z.files if kk != "state_dom_count"}
     np.savez_compressed(str(ckpt), **stripped)
 
-    state, _, _ = load_simulation(str(ckpt))
+    state, _, meta = load_simulation(str(ckpt))
     assert np.asarray(state.dom_count).ndim == 3  # shape-safe fill
-    state = resume_state(state, arrs)
+    state = resume_state(state, arrs, meta)
     np.testing.assert_allclose(
         np.asarray(state.dom_count), np.asarray(first.state.dom_count), atol=0)
     resumed = schedule_pods(

@@ -84,12 +84,15 @@ def test_same_domain_oracle():
 
 @pytest.mark.parametrize("seed", range(3))
 def test_fit_oracle(seed):
+    """The headroom-form fit must equal the vendored `used + req <= alloc`
+    (fit.go fitsRequest). Integer-valued quantities (the encoder's units)
+    keep both forms bit-exact; used may exceed alloc (forced overcommit)."""
     rng = np.random.RandomState(seed)
     n, r = 9, 4
     alloc = rng.randint(0, 100, size=(n, r)).astype(np.float32)
-    used = (alloc * rng.rand(n, r) * 1.2).astype(np.float32)
+    used = rng.randint(0, 120, size=(n, r)).astype(np.float32)
     req = rng.randint(0, 30, size=r).astype(np.float32)
-    got = np.asarray(filters.fit_per_resource(jnp.asarray(used), jnp.asarray(alloc), jnp.asarray(req)))
+    got = np.asarray(filters.fit_per_resource(jnp.asarray(alloc - used), jnp.asarray(req)))
     want = used + req[None, :] <= alloc
     np.testing.assert_array_equal(got, want)
 
@@ -234,13 +237,13 @@ def test_resource_scores_fused_matches_component_ops(seed):
     rng = np.random.RandomState(seed)
     n, r = 12, 4
     alloc = rng.randint(1, 100, size=(n, r)).astype(np.float32)
-    alloc[0, 0] = 0.0  # cap<=0 -> fraction 0 convention
+    alloc[0, 0] = 0.0  # cap<=0: headroom-form convention checked separately
     used = (alloc * rng.rand(n, r)).astype(np.float32)
     req = rng.randint(0, 30, size=r).astype(np.float32)
     inv = np.where(alloc > 0, 1.0 / np.where(alloc > 0, alloc, 1.0), 0.0)
     for wb, wl, wm in [(1.0, 1.0, 0.0), (1.0, 0.0, 2.0), (0.5, 1.5, 1.0)]:
         got = np.asarray(scores.resource_scores_fused(
-            jnp.asarray(used), jnp.asarray(alloc), jnp.asarray(inv),
+            jnp.asarray(alloc - used), jnp.asarray(inv),
             jnp.asarray(req), (0, 1), wb, wl, wm))
         want = (
             wb * np.asarray(scores.balanced_allocation_score(
@@ -250,4 +253,15 @@ def test_resource_scores_fused_matches_component_ops(seed):
             + wm * np.asarray(scores.most_allocated_score(
                 jnp.asarray(used), jnp.asarray(alloc), jnp.asarray(req), (0, 1)))
         )
-        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+        # row 0 has a zero-capacity cpu: the component ops score it "0%
+        # utilized", the headroom form "0% free" (documented divergence on
+        # pathological nodes) — compare the healthy rows to the oracle and
+        # row 0 to the headroom-form expectation
+        np.testing.assert_allclose(got[1:], want[1:], rtol=1e-4, atol=1e-3)
+        h_m0 = (alloc[0, 1] - used[0, 1] - req[1]) * inv[0, 1]
+        want0 = (
+            wb * (1.0 - abs(0.0 - h_m0) * 0.5) * 100.0
+            + wl * (max(h_m0, 0.0) * 50.0)
+            + wm * ((min(max(1.0 - 0.0, 0.0), 1.0) + min(max(1.0 - h_m0, 0.0), 1.0)) * 50.0)
+        )
+        np.testing.assert_allclose(got[0], want0, rtol=1e-4, atol=1e-3)

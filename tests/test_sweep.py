@@ -86,7 +86,7 @@ def test_node_axis_sharding_bit_equal_across_meshes():
         arrs = shard_arrays(device_arrays(snap), mesh)
         out = batched_schedule(arrs, masks, cfg, mesh=mesh)
         results.append((np.asarray(out.node), np.asarray(out.fail_counts),
-                        np.asarray(out.state.used)))
+                        np.asarray(out.state.headroom)))
     base = results[0]
     for got in results[1:]:
         np.testing.assert_array_equal(got[0], base[0])
