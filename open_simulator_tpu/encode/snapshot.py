@@ -171,6 +171,13 @@ class SnapshotArrays:
     # NodeVolumeLimits analog; Lk attachable-volume limit keys
     vol_limit_cap: np.ndarray  # [N, Lk] f32 (big = node declares no limit)
     vol_limit_req: np.ndarray  # [P, Lk] f32 attachments demanded per key
+    #                            (claims no other pod shares — see below)
+    # unique-volume dedup (vendored csi.go getVolumeUniqueName semantics):
+    # claims with an attach limit key referenced by >= 2 pods form a
+    # shared-volume vocabulary of Nsv entries; the engine attaches each at
+    # most once per node via the svol_on_node presence carry
+    svol_id: np.ndarray        # [P, Lv] i32 shared-volume refs (-1 pad)
+    svol_key: np.ndarray       # [Nsv] i32 limit-key index per shared volume
 
 
 @dataclass
@@ -601,7 +608,7 @@ def encode_cluster(
     # attachable-volume limit keys: vocab over pod demands; a node without
     # the allocatable key declares no limit (vendored getVolumeLimits only
     # limits keys the node reports)
-    limit_keys = sorted({k for i in vol_model.pod_volumes for k in i.limit_demand})
+    limit_keys = sorted({lk for i in vol_model.pod_volumes for _, lk in i.limit_claims})
     Lk = max(len(limit_keys), 1)
     NO_LIMIT = np.float32(1e9)
     vol_limit_cap = np.full((N, Lk), NO_LIMIT, dtype=np.float32)
@@ -620,10 +627,33 @@ def encode_cluster(
             lk = f"attachable-volumes-csi-{driver}"
             if lk in limit_keys:
                 vol_limit_cap[i, limit_keys.index(lk)] = float(cnt)
+    # unique-volume dedup: a claim mounted by >= 2 pods attaches ONCE per
+    # node (vendored csi/in-tree limits count unique volume names). Shared
+    # claims go to the svol vocabulary + per-pod reference slots; claims
+    # only one pod mounts keep the cheap static per-pod count.
+    claim_lk: Dict[str, str] = {}
+    claim_refs: Dict[str, int] = {}
+    for info in vol_model.pod_volumes:
+        for ck, lk in info.limit_claims:
+            claim_lk[ck] = lk
+            claim_refs[ck] = claim_refs.get(ck, 0) + 1
+    shared_claims = sorted(ck for ck, c in claim_refs.items() if c >= 2)
+    svol_index = {ck: i for i, ck in enumerate(shared_claims)}
+    svol_key = np.array(
+        [limit_keys.index(claim_lk[ck]) for ck in shared_claims], dtype=np.int32)
+    Lv = max(
+        (sum(1 for ck, _ in i.limit_claims if ck in svol_index)
+         for i in vol_model.pod_volumes), default=0)
+    svol_id = np.full((P, Lv), -1, dtype=np.int32)
     vol_limit_req = np.zeros((P, Lk), dtype=np.float32)
     for pi, info in enumerate(vol_model.pod_volumes):
-        for j, lk in enumerate(limit_keys):
-            vol_limit_req[pi, j] = float(info.limit_demand.get(lk, 0))
+        slot = 0
+        for ck, lk in info.limit_claims:
+            if ck in svol_index:
+                svol_id[pi, slot] = svol_index[ck]
+                slot += 1
+            else:
+                vol_limit_req[pi, limit_keys.index(lk)] += 1.0
     pre_reasons: Dict[int, str] = {}
     for pi, info in enumerate(vol_model.pod_volumes):
         vol_pv_missing[pi] = info.missing_pv
@@ -734,6 +764,8 @@ def encode_cluster(
         wfc_valid=wfc_valid,
         vol_limit_cap=vol_limit_cap,
         vol_limit_req=vol_limit_req,
+        svol_id=svol_id,
+        svol_key=svol_key,
     )
 
     group_desc = [f"group#{i}" for i in range(S)]

@@ -109,6 +109,10 @@ class EngineConfig(NamedTuple):
     # NodeVolumeLimits analog: attachable-volume counts vs the node's
     # attachable-volumes-* allocatable keys
     enable_vol_limits: bool = False
+    # unique-volume dedup: claims shared by >= 2 pods attach once per node
+    # (vendored csi.go getVolumeUniqueName); needs the svol_on_node
+    # presence carry, so it is compiled out when no shared claim exists
+    enable_vol_dedup: bool = False
     # Out-of-tree extension ops (engine/extensions.py ExtensionOp tuples) —
     # the WithFrameworkOutOfTreeRegistry analog
     # (pkg/simulator/simulator.go:188-195). Filter extensions append reason
@@ -156,7 +160,7 @@ class EngineConfig(NamedTuple):
 class SimState(NamedTuple):
     """The scan carry — the whole mutable world of the simulation.
     (The reference spreads this across the fake clientset, the scheduler
-    cache, and the gpu-share cache; here it is eleven dense arrays —
+    cache, and the gpu-share cache; here it is twelve dense arrays —
     see ARCHITECTURE.md section 2 for the roster.)
 
     group_count/term_block store small integer counts; with
@@ -189,6 +193,9 @@ class SimState(NamedTuple):
     pv_taken: jnp.ndarray     # [Npv] bool
     # attachable-volume attachments per node per limit key
     vol_cnt: jnp.ndarray      # [N, Lk] f32
+    # shared attachable volumes already present per node (unique-volume
+    # dedup: a claim two pods mount attaches once per node)
+    svol_on_node: jnp.ndarray  # [N, Nsv] bool
 
 
 class ScheduleOutput(NamedTuple):
@@ -229,6 +236,7 @@ def init_state(arrs: SnapshotArrays, cfg: "EngineConfig | None" = None) -> SimSt
         dom_count=jnp.zeros((k1, d, s), f32),
         pv_taken=jnp.zeros((arrs.pv_node_ok.shape[0],), dtype=bool),
         vol_cnt=jnp.zeros((n, arrs.vol_limit_cap.shape[1]), f32),
+        svol_on_node=jnp.zeros((n, arrs.svol_key.shape[0]), dtype=bool),
     )
 
 
@@ -317,7 +325,7 @@ def _apply_prefix_chunk(arrs: SnapshotArrays, cfg: EngineConfig,
                 sd_a.T, col * w[:, None], precision=hp)
     return SimState(headroom, gc, term, pref, ports, state.gpu_used,
                     state.vg_used, state.sdev_taken, dom, state.pv_taken,
-                    vol_cnt)
+                    vol_cnt, state.svol_on_node)
 
 
 def _pod_xs(arrs: SnapshotArrays) -> Dict[str, jnp.ndarray]:
@@ -332,6 +340,7 @@ def _pod_xs(arrs: SnapshotArrays) -> Dict[str, jnp.ndarray]:
         "gpu_mem", "gpu_cnt", "gpu_forced", "gpu_has_forced",
         "lvm_req", "sdev_req", "sdev_req_ssd",
         "vol_cid", "vol_pv_missing", "wfc_ccid", "wfc_valid", "vol_limit_req",
+        "svol_id",
     ]
     xs = {k: getattr(arrs, k) for k in names}
     xs["_pod_index"] = jnp.arange(arrs.req.shape[0], dtype=jnp.int32)
@@ -394,6 +403,8 @@ def _live_xs_names(cfg: EngineConfig, has_disabled: bool,
         live |= {"wfc_ccid", "wfc_valid"}
     if cfg.enable_vol_limits:
         live.add("vol_limit_req")
+        if cfg.enable_vol_dedup:
+            live.add("svol_id")
     return live
 
 
@@ -543,9 +554,26 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         ok_vol_bind = ok_vol_bind & wfc_ok if ok_vol_bind is not true_v else wfc_ok
     if cfg.enable_vol_limits:
         # NodeVolumeLimits: attachments + demand within every limit key
+        vol_demand = x["vol_limit_req"][None, :]          # [1, Lk] static part
+        if cfg.enable_vol_dedup:
+            # shared claims attach once per node (vendored unique-volume
+            # counting): a slot adds demand only on nodes that do not
+            # already hold its volume
+            lk_n = arrs.vol_limit_cap.shape[1]
+            sv_extra = jnp.zeros((n_nodes, lk_n), f32)
+            for sl in range(x["svol_id"].shape[0]):       # Lv tiny, unrolled
+                vid = x["svol_id"][sl]
+                valid = vid >= 0
+                # O(N) dynamic column gather (vs an [N, Nsv] masked reduce)
+                present = state.svol_on_node[:, jnp.maximum(vid, 0)]
+                add = valid & ~present                             # [N]
+                key_oh = (jax.lax.iota(jnp.int32, lk_n)
+                          == arrs.svol_key[jnp.maximum(vid, 0)])   # [Lk]
+                sv_extra = sv_extra + (
+                    add.astype(f32)[:, None] * key_oh.astype(f32)[None, :])
+            vol_demand = vol_demand + sv_extra
         ok_vol_limits = jnp.all(
-            state.vol_cnt + x["vol_limit_req"][None, :] <= arrs.vol_limit_cap,
-            axis=1)
+            state.vol_cnt + vol_demand <= arrs.vol_limit_cap, axis=1)
     else:
         ok_vol_limits = true_v
 
@@ -831,13 +859,26 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         pv_taken = state.pv_taken
         vol_pick = jnp.zeros((0,), dtype=jnp.int32)
     if cfg.enable_vol_limits:
-        vol_cnt = state.vol_cnt + onehot_n[:, None] * x["vol_limit_req"][None, :]
+        # vol_demand is the filter pass's per-node demand: static part
+        # plus, under dedup, only the shared volumes NOT already on each
+        # node — so the bound row's increment is exactly the new
+        # attachments (unique-volume counting)
+        vol_cnt = state.vol_cnt + onehot_n[:, None] * vol_demand
     else:
         vol_cnt = state.vol_cnt
+    if cfg.enable_vol_limits and cfg.enable_vol_dedup:
+        svol_on = state.svol_on_node
+        nsv = svol_on.shape[1]
+        for sl in range(x["svol_id"].shape[0]):
+            vid = x["svol_id"][sl]
+            sv_oh = (jax.lax.iota(jnp.int32, nsv) == vid)          # [Nsv]
+            svol_on = svol_on | ((onehot_n[:, None] > 0) & sv_oh[None, :])
+    else:
+        svol_on = state.svol_on_node
 
     new_state = SimState(headroom, group_count, term_block, pref_paint, ports_used,
                          gpu_used, vg_used, sdev_taken, dom_count, pv_taken,
-                         vol_cnt)
+                         vol_cnt, svol_on)
     return new_state, (final_node, fail_counts, feasible_n, pick, vol_pick)
 
 
@@ -980,7 +1021,11 @@ def make_config(snapshot: ClusterSnapshot, **overrides) -> EngineConfig:
         ),
         enable_pv_match=bool(np.any(a.wfc_valid)),
         enable_vol_limits=bool(
-            np.any(a.vol_limit_req > 0) and np.any(a.vol_limit_cap < 1e9)
+            (np.any(a.vol_limit_req > 0) or np.any(a.svol_id >= 0))
+            and np.any(a.vol_limit_cap < 1e9)
+        ),
+        enable_vol_dedup=bool(
+            np.any(a.svol_id >= 0) and np.any(a.vol_limit_cap < 1e9)
         ),
     )
     # forced-bind prefix: leading run of spec.nodeName pods whose carry
@@ -997,6 +1042,10 @@ def make_config(snapshot: ClusterSnapshot, **overrides) -> EngineConfig:
         ):
             fp = 0
         elif bool(np.any(np.asarray(a.wfc_valid)[:fp])):
+            fp = 0
+        elif kw["enable_vol_dedup"] and bool(np.any(np.asarray(a.svol_id)[:fp] >= 0)):
+            # shared-volume dedup demand depends on which volumes already
+            # sit on the node — exact only pod-by-pod
             fp = 0
     kw["forced_prefix"] = fp
     kw.update(overrides)

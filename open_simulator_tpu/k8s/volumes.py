@@ -60,10 +60,26 @@ class PodVolumes:
     wfc_claim_ids: List[int] = field(default_factory=list)   # candidate-class ids
     wfc_claim_keys: List[str] = field(default_factory=list)  # ns/name per slot
     provision_scs: List[str] = field(default_factory=list)   # SC names
-    # attachable-volume demand per limit key (NodeVolumeLimits analog):
-    # one count per attachable volume the pod mounts, keyed like the node
-    # allocatable keys ("attachable-volumes-csi-<driver>" etc.)
-    limit_demand: Dict[str, int] = field(default_factory=dict)
+    # attachable-volume demand (NodeVolumeLimits analog): one
+    # (claim_key, limit_key) entry per attachable volume the pod mounts,
+    # keyed like the node allocatable keys ("attachable-volumes-csi-..."
+    # etc.). The claim key is the volume's dedup identity — the vendored
+    # plugins count UNIQUE volumes per node (csi.go getVolumeUniqueName:
+    # bound claims resolve to one PV per claim via claimRef, unbound
+    # provisioned claims count per claim UID), so a claim mounted by two
+    # pods on the same node attaches once. The encoder splits entries into
+    # a static per-pod count (claims no other pod shares) and a shared-
+    # volume vocabulary the engine dedups against a per-node presence
+    # carry.
+    limit_claims: List[Tuple[str, str]] = field(default_factory=list)
+
+    @property
+    def limit_demand(self) -> Dict[str, int]:
+        """Dedup-blind per-key totals (the pre-dedup counting)."""
+        out: Dict[str, int] = {}
+        for _, lk in self.limit_claims:
+            out[lk] = out.get(lk, 0) + 1
+        return out
 
 
 @dataclass
@@ -242,7 +258,7 @@ def analyze_volumes(
                     info.bound_pv_ids.append(pv_id)
                     lk = attach_limit_key_for_pv(pv_sorted[pv_id])
                     if lk:
-                        info.limit_demand[lk] = info.limit_demand.get(lk, 0) + 1
+                        info.limit_claims.append((claim_key, lk))
                 continue
             # unbound claim: binding mode decides
             sc = sc_index.get(pvc.storage_class_name or "")
@@ -253,7 +269,7 @@ def analyze_volumes(
                 info.provision_scs.append(sc.meta.name)
                 lk = attach_limit_key_for_sc(sc)
                 if lk:
-                    info.limit_demand[lk] = info.limit_demand.get(lk, 0) + 1
+                    info.limit_claims.append((claim_key, lk))
                 continue
             # static (no-provisioner) WFC claim: candidate PV set
             fp = "|".join([

@@ -38,8 +38,12 @@ def save_simulation(
     # of silently laundering used-values into a state_headroom entry
     legacy_unconverted = bool(meta and meta.get("_headroom_is_legacy_used"))
     if meta:
-        # other underscore keys are loader-internal (e.g. _resources);
-        # persisting them would shadow the next load's own markers
+        # a load->save copy keeps the original file's column-order record
+        # unless the caller supplies a fresh one
+        if resources is None and meta.get("_resources") is not None:
+            resources = meta["_resources"]
+        # other underscore keys are loader-internal; persisting them would
+        # shadow the next load's own markers
         meta = {k: v for k, v in meta.items() if not k.startswith("_")}
     arrays = {}
     dtypes = {}
@@ -119,6 +123,10 @@ def load_simulation(path: str) -> Tuple[SimState, Optional[np.ndarray], dict]:
                 # [N, Lk] (pre-vol-limits checkpoints carried no attachments,
                 # so zeros are the exact state)
                 fields[name] = np.zeros((0, 0), dtype=np.float32)
+            elif name == "svol_on_node":
+                # sentinel: pre-dedup checkpoints tracked no shared-volume
+                # presence; resume_state widens to the snapshot's [N, Nsv]
+                fields[name] = np.zeros((0, 0), dtype=bool)
             else:
                 fields[name] = np.zeros(
                     (n, 1), dtype=bool if name == "sdev_taken" else np.float32
@@ -158,7 +166,7 @@ def resume_state(state: SimState, arrs, meta: dict,
             f"snapshot's {list(resources)} — the [N, R] carry would silently "
             "mix columns; re-encode with the original pod set or discard the "
             "checkpoint")
-    if meta is not None and meta.pop("_headroom_is_legacy_used", False):
+    if meta.pop("_headroom_is_legacy_used", False):
         state = state._replace(
             headroom=np.asarray(arrs.alloc, dtype=np.float32)
             - np.asarray(state.headroom, dtype=np.float32))
@@ -175,7 +183,11 @@ def resume_state(state: SimState, arrs, meta: dict,
 
 
 def _widen_vol_cnt(state: SimState, arrs) -> SimState:
-    want = (np.asarray(arrs.alloc).shape[0], np.asarray(arrs.vol_limit_cap).shape[1])
-    if np.asarray(state.vol_cnt).shape == want:
-        return state
-    return state._replace(vol_cnt=np.zeros(want, dtype=np.float32))
+    n = np.asarray(arrs.alloc).shape[0]
+    want = (n, np.asarray(arrs.vol_limit_cap).shape[1])
+    if np.asarray(state.vol_cnt).shape != want:
+        state = state._replace(vol_cnt=np.zeros(want, dtype=np.float32))
+    want_sv = (n, np.asarray(arrs.svol_key).shape[0])
+    if np.asarray(state.svol_on_node).shape != want_sv:
+        state = state._replace(svol_on_node=np.zeros(want_sv, dtype=bool))
+    return state

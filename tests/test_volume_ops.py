@@ -67,6 +67,23 @@ def pvc(name, size="5Gi", sc="local-wfc", volume_name="", phase=None):
     return PersistentVolumeClaim.from_dict(d)
 
 
+def csi_pv(name, claim, modes=("ReadWriteOnce",)):
+    """Bound CSI PV (ebs driver) claimed by `claim` — shared by the
+    attach-limit tests."""
+    return PersistentVolume.from_dict({
+        "apiVersion": "v1", "kind": "PersistentVolume",
+        "metadata": {"name": name},
+        "spec": {
+            "capacity": {"storage": "10Gi"},
+            "accessModes": list(modes),
+            "storageClassName": "local-wfc",
+            "csi": {"driver": "ebs.csi.aws.com", "volumeHandle": name},
+            "claimRef": {"namespace": "default", "name": claim},
+        },
+        "status": {"phase": "Bound"},
+    })
+
+
 def claim_pod(name, claims, cpu="100m"):
     p = make_pod(name, cpu=cpu)
     p.raw.setdefault("spec", {})["volumes"] = [
@@ -335,21 +352,6 @@ def test_attachable_volume_limits():
     """NodeVolumeLimits analog: a node's attachable-volumes-* allocatable
     caps the attachments it hosts (vendored csi.go:136-140; reason string
     non_csi.go:63). Nodes without the key declare no limit."""
-    from open_simulator_tpu.k8s.objects import PersistentVolume
-
-    def csi_pv(name, claim):
-        return PersistentVolume.from_dict({
-            "apiVersion": "v1", "kind": "PersistentVolume",
-            "metadata": {"name": name},
-            "spec": {
-                "capacity": {"storage": "10Gi"},
-                "accessModes": ["ReadWriteOnce"],
-                "storageClassName": "local-wfc",
-                "csi": {"driver": "ebs.csi.aws.com", "volumeHandle": name},
-                "claimRef": {"namespace": "default", "name": claim},
-            },
-            "status": {"phase": "Bound"},
-        })
 
     limited = make_node(
         "n0", labels={"kubernetes.io/hostname": "n0"},
@@ -434,3 +436,64 @@ def test_csinode_limits_and_intree_provisioner_keys():
                scs=(intree,))
     assert len(res2.unscheduled_pods) == 1
     assert "exceed max volume count" in res2.unscheduled_pods[0].reason
+
+
+def test_shared_claim_attaches_once_per_node():
+    """Unique-volume dedup (vendored csi.go getVolumeUniqueName, in-tree
+    non_csi.go unique-volume counting): a claim mounted by several pods
+    attaches ONCE per node, so pods sharing a volume co-locate within one
+    attachment slot while a distinct claim still needs its own."""
+
+    limited = make_node(
+        "n0", labels={"kubernetes.io/hostname": "n0"},
+        extra_alloc={"attachable-volumes-csi-ebs.csi.aws.com": 1})
+    pvcs_ = [pvc("cshare", volume_name="ebs-share"),
+             pvc("cown", volume_name="ebs-own")]
+    pvs_ = [csi_pv("ebs-share", "cshare", modes=("ReadWriteMany",)), csi_pv("ebs-own", "cown")]
+    # three pods mount the shared claim -> all fit in ONE attachment;
+    # the pod with its own claim needs a second -> rejected
+    pods = ([claim_pod(f"s{i}", ["cshare"]) for i in range(3)]
+            + [claim_pod("own", ["cown"])])
+    res = run([limited], pods, pvcs=pvcs_, pvs=pvs_)
+    assert res.placements()["default/s0"] == "n0"
+    assert res.placements()["default/s1"] == "n0"
+    assert res.placements()["default/s2"] == "n0"
+    assert len(res.unscheduled_pods) == 1
+    assert "exceed max volume count" in res.unscheduled_pods[0].reason
+
+    # same workload WITHOUT dedup pressure: every pod its own claim on the
+    # same 1-slot node -> only one fits (the pre-dedup counting)
+    pvcs2 = [pvc(f"c{i}", volume_name=f"ebs-{i}") for i in range(2)]
+    pvs2 = [csi_pv(f"ebs-{i}", f"c{i}") for i in range(2)]
+    pods2 = [claim_pod(f"p{i}", [f"c{i}"]) for i in range(2)]
+    res2 = run([limited], pods2, pvcs=pvcs2, pvs=pvs2)
+    assert len(res2.unscheduled_pods) == 1
+
+
+def test_shared_claim_attaches_per_node_across_nodes():
+    """The dedup is per NODE: the same shared claim attaching on two
+    different nodes consumes a slot on each (presence carry is per node)."""
+
+    # two 1-slot nodes; pods pinned apart by hostname anti-affinity via
+    # required node selectors to force the shared claim onto both nodes
+    nodes = [
+        make_node(f"n{i}", labels={"kubernetes.io/hostname": f"n{i}"},
+                  extra_alloc={"attachable-volumes-csi-ebs.csi.aws.com": 1})
+        for i in range(2)
+    ]
+    pvcs_ = [pvc("cshare", volume_name="ebs-share"),
+             pvc("cextra", volume_name="ebs-extra")]
+    pvs_ = [csi_pv("ebs-share", "cshare", modes=("ReadWriteMany",)), csi_pv("ebs-extra", "cextra")]
+    pa = claim_pod("a", ["cshare"])
+    pa.raw["spec"]["nodeSelector"] = {"kubernetes.io/hostname": "n0"}
+    pb = claim_pod("b", ["cshare"])
+    pb.raw["spec"]["nodeSelector"] = {"kubernetes.io/hostname": "n1"}
+    # n1 now holds one attachment (the shared volume): an extra claim
+    # pinned there must be rejected
+    pc = claim_pod("c", ["cextra"])
+    pc.raw["spec"]["nodeSelector"] = {"kubernetes.io/hostname": "n1"}
+    res = run(nodes, [pa, pb, pc], pvcs=pvcs_, pvs=pvs_)
+    assert res.placements()["default/a"] == "n0"
+    assert res.placements()["default/b"] == "n1"
+    assert len(res.unscheduled_pods) == 1
+    assert "exceed max volume count" in res.unscheduled_pods[0].reason
