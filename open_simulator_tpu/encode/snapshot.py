@@ -45,6 +45,28 @@ OP_PORTS = 3
 OP_FIT_BASE = 4  # one slot per resource follows
 
 
+# max set bits per pod row the slot encoding covers; beyond it the engine
+# uses the dense forms (a pod matching >8 selector groups is pathological)
+SLOT_CAP = 8
+
+
+def slot_indices(dense: np.ndarray, cap: int = SLOT_CAP) -> np.ndarray:
+    """[P, X] bool -> [P, K] i32 ascending set-bit indices, -1 padded,
+    K = max set bits (<= cap). Overflow (some row exceeding cap) returns
+    width cap+1 with truncated contents — callers treat that width as
+    'use the dense form' and never read the slots."""
+    p_n, x_n = dense.shape
+    counts = dense.sum(axis=1) if x_n else np.zeros(p_n, dtype=int)
+    k = int(counts.max()) if p_n and x_n else 0
+    if k > cap:
+        return np.full((p_n, cap + 1), -1, dtype=np.int32)
+    if k == 0:
+        return np.zeros((p_n, 0), dtype=np.int32)
+    order = np.argsort(~dense, axis=1, kind="stable")[:, :k]
+    picked = np.take_along_axis(dense, order, axis=1)
+    return np.where(picked, order, -1).astype(np.int32)
+
+
 def filter_op_table(resources: Sequence[str]) -> List[str]:
     ops = [
         "node(s) were unschedulable",
@@ -132,6 +154,15 @@ class SnapshotArrays:
     own_terms: np.ndarray      # [P, T] bool
     hit_terms: np.ndarray      # [P, T] bool
     term_key: np.ndarray       # [T] i32
+    # set-bit slot forms of match_groups/own_terms/hit_terms (-1 pad): a
+    # pod touches only a handful of selector groups / anti-affinity terms,
+    # so the engine's carry updates and blocked test can run on O(slots)
+    # dynamic columns instead of dense [N, S]/[N, T] tensors per step.
+    # Width SLOT_CAP+1 marks overflow (some pod exceeds the cap) — the
+    # engine then falls back to the dense forms (EngineConfig.slot_paint).
+    match_gid: np.ndarray      # [P, M<=9] i32
+    own_tid: np.ndarray        # [P, O<=9] i32
+    hit_tid: np.ndarray        # [P, H<=9] i32
     spread_group: np.ndarray   # [P, Cs] i32
     spread_key: np.ndarray     # [P, Cs] i32
     spread_skew: np.ndarray    # [P, Cs] f32
@@ -451,6 +482,9 @@ def encode_cluster(
             own_terms[pi, term_vocab.index[(gid, kid)]] = True
     for (gid, kid), tid in term_vocab.index.items():
         hit_terms[:, tid] = match_groups[:, gid]
+    match_gid = slot_indices(match_groups)
+    own_tid = slot_indices(own_terms)
+    hit_tid = slot_indices(hit_terms)
 
     # ---- preferred-term registry (existing-pods scoring direction) ----
     T2 = max(len(pref_term_vocab), 1)
@@ -730,6 +764,9 @@ def encode_cluster(
         anti_valid=anti_valid,
         own_terms=own_terms,
         hit_terms=hit_terms,
+        match_gid=match_gid,
+        own_tid=own_tid,
+        hit_tid=hit_tid,
         term_key=term_key_arr.astype(np.int32),
         spread_group=spread_group.astype(np.int32),
         spread_key=spread_key.astype(np.int32),

@@ -24,6 +24,7 @@ import numpy as np
 
 from open_simulator_tpu.encode.snapshot import (
     OP_FIT_BASE,
+    SLOT_CAP,
     ClusterSnapshot,
     SnapshotArrays,
 )
@@ -113,6 +114,15 @@ class EngineConfig(NamedTuple):
     # (vendored csi.go getVolumeUniqueName); needs the svol_on_node
     # presence carry, so it is compiled out when no shared claim exists
     enable_vol_dedup: bool = False
+    # Sparse-slot carry updates: a pod touches only a handful of selector
+    # groups / anti-affinity terms, so the group_count/term_block/dom_count
+    # bind updates and the reverse-anti-affinity read run on O(slots)
+    # dynamic columns instead of dense [N, S]/[N, T] tensors per step (the
+    # dense term_block write + 97-wide matvec dominated the all-ops bench
+    # profile). make_config enables it when every pod fits the slot cap;
+    # values are bit-identical to the dense forms (each column is touched
+    # at most once per pod, so the adds are the same adds).
+    slot_paint: bool = False
     # Out-of-tree extension ops (engine/extensions.py ExtensionOp tuples) —
     # the WithFrameworkOutOfTreeRegistry analog
     # (pkg/simulator/simulator.go:188-195). Filter extensions append reason
@@ -340,7 +350,7 @@ def _pod_xs(arrs: SnapshotArrays) -> Dict[str, jnp.ndarray]:
         "gpu_mem", "gpu_cnt", "gpu_forced", "gpu_has_forced",
         "lvm_req", "sdev_req", "sdev_req_ssd",
         "vol_cid", "vol_pv_missing", "wfc_ccid", "wfc_valid", "vol_limit_req",
-        "svol_id",
+        "svol_id", "match_gid", "own_tid", "hit_tid",
     ]
     xs = {k: getattr(arrs, k) for k in names}
     xs["_pod_index"] = jnp.arange(arrs.req.shape[0], dtype=jnp.int32)
@@ -381,12 +391,13 @@ def _live_xs_names(cfg: EngineConfig, has_disabled: bool,
     if cfg.enable_ports:
         live.add("ports")
     if cfg.needs_group_count or cfg.enable_spread:
-        live.add("match_groups")
+        live.add("match_gid" if cfg.slot_paint else "match_groups")
     if cfg.enable_pod_affinity:
         live |= {"aff_group", "aff_key", "aff_valid", "aff_self"}
     if cfg.enable_anti_affinity:
-        live |= {"anti_group", "anti_key", "anti_valid", "own_terms",
-                 "hit_terms"}
+        live |= {"anti_group", "anti_key", "anti_valid"}
+        live |= ({"own_tid", "hit_tid"} if cfg.slot_paint
+                 else {"own_terms", "hit_terms"})
     if cfg.enable_spread:
         live |= {"spread_group", "spread_key", "spread_skew", "spread_hard",
                  "spread_valid"}
@@ -402,9 +413,9 @@ def _live_xs_names(cfg: EngineConfig, has_disabled: bool,
     if cfg.enable_pv_match:
         live |= {"wfc_ccid", "wfc_valid"}
     if cfg.enable_vol_limits:
-        live.add("vol_limit_req")
-        if cfg.enable_vol_dedup:
-            live.add("svol_id")
+        # svol_id is read even with dedup off (dedup-blind shared-claim
+        # demand); the leaf is width-0 when no claim is shared
+        live |= {"vol_limit_req", "svol_id"}
     return live
 
 
@@ -452,12 +463,26 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         gc, arrs.topo_onehot, arrs.has_key,
         x["aff_group"], x["aff_key"], x["aff_valid"], x["aff_self"],
     ) if cfg.enable_pod_affinity else true_v)
-    # term_block stays bf16: its only read is a sum-of-nonnegatives > 0
+    # term_block stays bf16: its only read is a nonnegative-counts > 0
     # test, which cannot false-positive in bf16
-    ok_pod_anti = (filters.pod_anti_affinity_ok(
-        gc, state.term_block, arrs.topo_onehot, arrs.has_key,
-        x["anti_group"], x["anti_key"], x["anti_valid"], x["hit_terms"],
-    ) if cfg.enable_anti_affinity else true_v)
+    if cfg.enable_anti_affinity:
+        if cfg.slot_paint:
+            # reverse direction via per-hit-term column gathers (a pod
+            # hits only a few terms; the dense [N, T] matvec dominated
+            # the all-ops profile)
+            blocked = jnp.zeros((n_nodes,), dtype=bool)
+            for h in range(x["hit_tid"].shape[0]):
+                tid = x["hit_tid"][h]
+                colv = state.term_block[:, jnp.maximum(tid, 0)]
+                blocked |= (tid >= 0) & (colv > 0)
+        else:
+            blocked = filters.anti_blocked_dense(state.term_block, x["hit_terms"])
+        ok_pod_anti = filters.pod_anti_affinity_ok(
+            gc, arrs.topo_onehot, arrs.has_key,
+            x["anti_group"], x["anti_key"], x["anti_valid"], blocked,
+        )
+    else:
+        ok_pod_anti = true_v
 
     # PodTopologySpread: per-constraint domain counts are computed ONCE and
     # shared between the DoNotSchedule filter (skew check, vendored
@@ -503,7 +528,13 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
                 else:
                     min_val = min_other
                 min_val = jnp.where(hoisted.any_elig[_cid(), kid], min_val, 0.0)
-                self_m = x["match_groups"][g] & x["spread_valid"][c]
+                if cfg.slot_paint:
+                    self_raw = jnp.zeros((), dtype=bool)
+                    for m in range(x["match_gid"].shape[0]):
+                        self_raw |= x["match_gid"][m] == g
+                    self_m = self_raw & x["spread_valid"][c]
+                else:
+                    self_m = x["match_groups"][g] & x["spread_valid"][c]
                 skew = dc + self_m.astype(dc.dtype) - min_val
                 term_ok = node_has & (skew <= x["spread_skew"][c])
                 applies = x["spread_valid"][c] & x["spread_hard"][c]
@@ -553,20 +584,26 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
             x["wfc_ccid"], x["wfc_valid"])
         ok_vol_bind = ok_vol_bind & wfc_ok if ok_vol_bind is not true_v else wfc_ok
     if cfg.enable_vol_limits:
-        # NodeVolumeLimits: attachments + demand within every limit key
+        # NodeVolumeLimits: attachments + demand within every limit key.
+        # Shared-claim slots (width 0 when no claim is shared) add their
+        # demand here too: deduped against the per-node presence carry
+        # when enable_vol_dedup, else dedup-blind (every mount counts) —
+        # so flipping the dedup gate off degrades conservatively instead
+        # of uncounting shared claims (their demand is NOT in the static
+        # vol_limit_req).
         vol_demand = x["vol_limit_req"][None, :]          # [1, Lk] static part
-        if cfg.enable_vol_dedup:
-            # shared claims attach once per node (vendored unique-volume
-            # counting): a slot adds demand only on nodes that do not
-            # already hold its volume
-            lk_n = arrs.vol_limit_cap.shape[1]
+        lk_n = arrs.vol_limit_cap.shape[1]
+        if x["svol_id"].shape[0]:
             sv_extra = jnp.zeros((n_nodes, lk_n), f32)
             for sl in range(x["svol_id"].shape[0]):       # Lv tiny, unrolled
                 vid = x["svol_id"][sl]
                 valid = vid >= 0
-                # O(N) dynamic column gather (vs an [N, Nsv] masked reduce)
-                present = state.svol_on_node[:, jnp.maximum(vid, 0)]
-                add = valid & ~present                             # [N]
+                if cfg.enable_vol_dedup:
+                    # O(N) dynamic column gather (vs an [N, Nsv] reduce)
+                    present = state.svol_on_node[:, jnp.maximum(vid, 0)]
+                    add = valid & ~present                         # [N]
+                else:
+                    add = jnp.broadcast_to(valid, (n_nodes,))
                 key_oh = (jax.lax.iota(jnp.int32, lk_n)
                           == arrs.svol_key[jnp.maximum(vid, 0)])   # [Lk]
                 sv_extra = sv_extra + (
@@ -774,9 +811,20 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     cdt = state.group_count.dtype
     headroom = state.headroom - onehot_n[:, None] * x["req"][None, :]
     if cfg.needs_group_count:
-        group_count = state.group_count + (
-            onehot_n[:, None] * x["match_groups"].astype(f32)[None, :]
-        ).astype(cdt)
+        if cfg.slot_paint:
+            # a pod matches only a few selector groups: update those
+            # columns in place instead of writing the full [N, S] carry
+            group_count = state.group_count
+            for m in range(x["match_gid"].shape[0]):
+                g_raw = x["match_gid"][m]
+                gid = jnp.maximum(g_raw, 0)
+                newcol = group_count[:, gid] + (
+                    onehot_n * (g_raw >= 0)).astype(cdt)
+                group_count = group_count.at[:, gid].set(newcol)
+        else:
+            group_count = state.group_count + (
+                onehot_n[:, None] * x["match_groups"].astype(f32)[None, :]
+            ).astype(cdt)
     else:
         group_count = state.group_count  # untouched -> loop-invariant, no copy
     if cfg.enable_spread:
@@ -784,9 +832,17 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         # [K1, D] domain rows (a gather, not a reduction) outer the match
         # vector — K1*D*S adds on a table that stays tiny
         dom_row = arrs.topo_onehot[:, safe_node, :] * bound.astype(f32)  # [K1, D]
-        dom_count = state.dom_count + (
-            dom_row[:, :, None] * x["match_groups"].astype(f32)[None, None, :]
-        )
+        if cfg.slot_paint:
+            dom_count = state.dom_count
+            for m in range(x["match_gid"].shape[0]):
+                g_raw = x["match_gid"][m]
+                gid = jnp.maximum(g_raw, 0)
+                newcol = dom_count[:, :, gid] + dom_row * (g_raw >= 0)
+                dom_count = dom_count.at[:, :, gid].set(newcol)
+        else:
+            dom_count = state.dom_count + (
+                dom_row[:, :, None] * x["match_groups"].astype(f32)[None, None, :]
+            )
     else:
         dom_count = state.dom_count
     if cfg.enable_ports:
@@ -806,20 +862,32 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
 
     if cfg.enable_anti_affinity:
         # anti-affinity domain paint for this pod's own terms
-        paint = sd_all[arrs.term_key].T * x["own_terms"].astype(f32)[None, :]  # [N, T]
-        term_block = state.term_block + paint.astype(cdt)  # 0/1 values, cast exact
+        if cfg.slot_paint:
+            # a pod owns only a few terms: paint those columns in place
+            term_block = state.term_block
+            for o in range(x["own_tid"].shape[0]):
+                t_raw = x["own_tid"][o]
+                tid = jnp.maximum(t_raw, 0)
+                col = sd_all[arrs.term_key[tid]] * (t_raw >= 0)
+                term_block = term_block.at[:, tid].set(
+                    term_block[:, tid] + col.astype(cdt))
+        else:
+            paint = sd_all[arrs.term_key].T * x["own_terms"].astype(f32)[None, :]  # [N, T]
+            term_block = state.term_block + paint.astype(cdt)  # 0/1 values, cast exact
     else:
         term_block = state.term_block
 
     if cfg.enable_pref:
         # weighted paint of this pod's own preferred terms (for future pods'
-        # existing-direction score); Ap is tiny and static -> unrolled
-        t2_n = state.pref_paint.shape[1]
+        # existing-direction score); Ap is tiny and static -> unrolled, and
+        # each slot updates ONE column in place (pref_tid is already a slot
+        # index; invalid slots add weight 0)
         pref_paint = state.pref_paint
         for a in range(x["pref_tid"].shape[0]):
-            col = jax.nn.one_hot(x["pref_tid"][a], t2_n, dtype=f32)    # [T2]
+            t = x["pref_tid"][a]
             w = x["pref_weight"][a] * x["pref_valid"][a].astype(f32)
-            pref_paint = pref_paint + sd_all[x["pref_key"][a]][:, None] * col[None, :] * w
+            pref_paint = pref_paint.at[:, t].set(
+                pref_paint[:, t] + sd_all[x["pref_key"][a]] * w)
     else:
         pref_paint = state.pref_paint
 
@@ -1027,6 +1095,11 @@ def make_config(snapshot: ClusterSnapshot, **overrides) -> EngineConfig:
         enable_vol_dedup=bool(
             np.any(a.svol_id >= 0) and np.any(a.vol_limit_cap < 1e9)
         ),
+        slot_paint=bool(
+            a.match_gid.shape[1] <= SLOT_CAP
+            and a.own_tid.shape[1] <= SLOT_CAP
+            and a.hit_tid.shape[1] <= SLOT_CAP
+        ),
     )
     # forced-bind prefix: leading run of spec.nodeName pods whose carry
     # updates are order-free (no gpu/storage/WFC picks within the prefix)
@@ -1043,9 +1116,11 @@ def make_config(snapshot: ClusterSnapshot, **overrides) -> EngineConfig:
             fp = 0
         elif bool(np.any(np.asarray(a.wfc_valid)[:fp])):
             fp = 0
-        elif kw["enable_vol_dedup"] and bool(np.any(np.asarray(a.svol_id)[:fp] >= 0)):
-            # shared-volume dedup demand depends on which volumes already
-            # sit on the node — exact only pod-by-pod
+        elif bool(np.any(np.asarray(a.svol_id)[:fp] >= 0)
+                  and np.any(np.asarray(a.vol_limit_cap) < 1e9)):
+            # shared-claim attach demand is not in the static vol_limit_req
+            # the prefix matmul folds (deduped it also depends on which
+            # volumes already sit on the node) — exact only pod-by-pod
             fp = 0
     kw["forced_prefix"] = fp
     kw.update(overrides)

@@ -73,14 +73,6 @@ class PodVolumes:
     # carry.
     limit_claims: List[Tuple[str, str]] = field(default_factory=list)
 
-    @property
-    def limit_demand(self) -> Dict[str, int]:
-        """Dedup-blind per-key totals (the pre-dedup counting)."""
-        out: Dict[str, int] = {}
-        for _, lk in self.limit_claims:
-            out[lk] = out.get(lk, 0) + 1
-        return out
-
 
 @dataclass
 class VolumeModel:

@@ -57,19 +57,19 @@ def pod_affinity_ok(
 
 def pod_anti_affinity_ok(
     group_count: jnp.ndarray,
-    term_block: jnp.ndarray,    # [N, T] carry: anti-affinity domain paint
     topo_onehot: jnp.ndarray,
     has_key: jnp.ndarray,
     anti_group: jnp.ndarray,    # [B]
     anti_key: jnp.ndarray,      # [B]
     anti_valid: jnp.ndarray,    # [B]
-    hit_terms_p: jnp.ndarray,   # [T] terms whose selector matches this pod
+    blocked: jnp.ndarray,       # [N] reverse-direction verdict (see below)
 ) -> jnp.ndarray:
     """InterPodAffinity required anti-affinity, both directions
     (filtering.go satisfyPodAntiAffinity + satisfyExistingPodsAntiAffinity):
       forward: no existing pod matching the incoming pod's term in the domain;
-      reverse: no existing pod whose own anti-affinity term matches the
-      incoming pod, within that term's domain (the [N, T] paint carry)."""
+      reverse: `blocked` — nodes where an existing pod's own anti-affinity
+      term covers this pod, read off the term-paint carry by the engine
+      (dense matvec or per-hit-term column gathers; identical verdicts)."""
     n = group_count.shape[0]
     ok = jnp.ones((n,), dtype=bool)
     for b in range(anti_group.shape[0]):
@@ -77,8 +77,14 @@ def pod_anti_affinity_ok(
         dc = domain_count(vec, anti_key[b], topo_onehot)
         term_ok = dc == 0
         ok &= jnp.where(anti_valid[b], term_ok, True)
-    blocked = (term_block @ hit_terms_p.astype(term_block.dtype)) > 0
     return ok & ~blocked
+
+
+def anti_blocked_dense(term_block: jnp.ndarray, hit_terms_p: jnp.ndarray) -> jnp.ndarray:
+    """Reverse anti-affinity verdict, dense form: sum the paint over every
+    term whose selector matches this pod (sum of nonnegative counts > 0
+    cannot false-positive in bf16)."""
+    return (term_block @ hit_terms_p.astype(term_block.dtype)) > 0
 
 
 # NOTE: the standalone topology_spread_ok op was removed in round 4: the

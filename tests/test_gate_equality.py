@@ -255,3 +255,52 @@ def test_forced_prefix_hoisting_bit_equal():
     out_f = schedule_pods(arrs, arrs.active, cfg_auto._replace(forced_prefix=0))
     for a, b in zip(out_h.state, out_f.state):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_slot_paint_vs_dense_bit_equal():
+    """The sparse-slot carry updates (group_count/term_block/dom_count
+    column DUS + per-hit-term blocked gathers, EngineConfig.slot_paint)
+    must be bit-identical to the dense forms — each column is touched at
+    most once per pod, so the adds are the same adds."""
+    rng = np.random.RandomState(7)
+    pods = []
+    for i in range(40):
+        kw = dict(cpu=f"{rng.randint(100, 900)}m", mem="256Mi",
+                  labels={"app": f"a{i % 4}", "anti": f"g{i % 7}"})
+        if i % 3 == 0:
+            kw["affinity"] = {
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [{
+                        "labelSelector": {"matchLabels": {"anti": f"g{i % 7}"}},
+                        "topologyKey": "kubernetes.io/hostname",
+                    }],
+                    "preferredDuringSchedulingIgnoredDuringExecution": [{
+                        "weight": 4,
+                        "podAffinityTerm": {
+                            "labelSelector": {"matchLabels": {"app": f"a{(i + 1) % 4}"}},
+                            "topologyKey": "topology.kubernetes.io/zone",
+                        },
+                    }],
+                },
+            }
+        kw["spread"] = [{
+            "maxSkew": 2, "topologyKey": "topology.kubernetes.io/zone",
+            "whenUnsatisfiable": "DoNotSchedule" if i % 2 else "ScheduleAnyway",
+            "labelSelector": {"matchLabels": {"app": f"a{i % 4}"}},
+        }]
+        pods.append(make_pod(f"p{i}", **kw))
+    snap = encode_cluster(_zone_nodes(8), pods)
+    cfg = make_config(snap)
+    assert cfg.slot_paint and cfg.enable_anti_affinity and cfg.enable_pref
+
+    nodes_slot, fails_slot, _ = _run(snap)
+    nodes_dense, fails_dense, _ = _run(snap, slot_paint=False)
+    np.testing.assert_array_equal(nodes_slot, nodes_dense)
+    np.testing.assert_array_equal(fails_slot, fails_dense)
+
+    # final carries must agree too (the slot updates ARE the carry)
+    arrs = device_arrays(snap)
+    out_s = schedule_pods(arrs, arrs.active, make_config(snap))
+    out_d = schedule_pods(arrs, arrs.active, make_config(snap, slot_paint=False))
+    for a, b in zip(out_s.state, out_d.state):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

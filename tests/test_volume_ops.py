@@ -497,3 +497,32 @@ def test_shared_claim_attaches_per_node_across_nodes():
     assert res.placements()["default/b"] == "n1"
     assert len(res.unscheduled_pods) == 1
     assert "exceed max volume count" in res.unscheduled_pods[0].reason
+
+
+def test_dedup_gate_off_counts_every_mount():
+    """Flipping enable_vol_dedup off must degrade to dedup-BLIND counting
+    (every mount of a shared claim attaches), never to uncounting the
+    shared claims (their demand is not in the static per-pod counts)."""
+    from open_simulator_tpu.encode.snapshot import EncodeOptions, encode_cluster
+    from open_simulator_tpu.engine.scheduler import (
+        device_arrays, make_config, schedule_pods)
+
+    limited = make_node(
+        "n0", labels={"kubernetes.io/hostname": "n0"},
+        extra_alloc={"attachable-volumes-csi-ebs.csi.aws.com": 1})
+    pvcs_ = [pvc("cshare", volume_name="ebs-share")]
+    pvs_ = [csi_pv("ebs-share", "cshare", modes=("ReadWriteMany",))]
+    pods = [claim_pod(f"s{i}", ["cshare"]) for i in range(2)]
+    snap = encode_cluster([limited], pods, EncodeOptions(
+        pvcs=pvcs_, pvs=pvs_, storage_classes=[WFC_SC]))
+    cfg = make_config(snap)
+    assert cfg.enable_vol_dedup
+    arrs = device_arrays(snap)
+    # dedup on: both pods share the single slot
+    out_on = schedule_pods(arrs, arrs.active, cfg)
+    assert (np.asarray(out_on.node) >= 0).all()
+    # dedup off: each mount counts -> the second pod exceeds the limit
+    out_off = schedule_pods(arrs, arrs.active,
+                            make_config(snap, enable_vol_dedup=False))
+    nodes_off = np.asarray(out_off.node)
+    assert (nodes_off >= 0).sum() == 1 and (nodes_off == -1).sum() == 1
