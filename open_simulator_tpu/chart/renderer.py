@@ -18,6 +18,11 @@ is not part of this image, so rendering is tiered:
 
 from __future__ import annotations
 
+import base64
+import functools as _ft
+import hashlib
+import json as _json
+import operator as _op
 import os
 import re
 import shutil
@@ -297,6 +302,9 @@ def _eval_call(args: List[str], sc: _Scope):
         return _quote(_eval_atom(args[1], sc))
     if len(args) == 1:
         return _eval_atom(fn, sc)
+    got = _sprig_call(fn, [_eval_atom(a, sc) for a in args[1:]], sc)
+    if got is not _SPRIG_MISS:
+        return got
     raise ChartError(
         f"{sc.origin}: unsupported template function {fn!r} — install helm or "
         "pre-render with `helm template`"
@@ -349,10 +357,150 @@ def _apply_pipe(stage: str, val, sc: _Scope):
             return len(val)
         except TypeError:
             return 0
+    # sprig order puts the piped value LAST: `x | foo a` == `foo a x`
+    got = _sprig_call(fn, [_eval_atom(a, sc) for a in args[1:]] + [val], sc)
+    if got is not _SPRIG_MISS:
+        return got
     raise ChartError(
         f"{sc.origin}: unsupported pipe {fn!r} — install helm or pre-render "
         "with `helm template`"
     )
+
+
+_SPRIG_MISS = object()
+
+
+def _num(v):
+    """Sprig arithmetic coercion (int64 semantics; floats only via floor)."""
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        try:
+            return int(float(v))
+        except (TypeError, ValueError):
+            return 0
+
+
+def _semver_parse(s: str):
+    """-> ((major, minor, patch), n_specified); x/X/* parts read as -1."""
+    core = s.strip().lstrip("vV").split("-")[0].split("+")[0]
+    parts = [p for p in core.split(".") if p != ""]
+    out = []
+    for part in (parts + ["0", "0", "0"])[:3]:
+        digits = re.match(r"\d*", part).group()
+        out.append(-1 if part in ("x", "X", "*") else int(digits or 0))
+    return tuple(out), min(len(parts), 3)
+
+
+def _semver_one(clause: str, v) -> bool:
+    clause = clause.strip()
+    if not clause or clause == "*":
+        return True
+    m = re.match(r"(>=|<=|!=|=|>|<|\^|~)?\s*(.*)$", clause)
+    op = m.group(1) or "="
+    ref, n_spec = _semver_parse(m.group(2))
+    if op == "^":
+        # Masterminds caret: >= ref, < next increment of the LEFTMOST
+        # NONZERO element (^0.2.3 -> <0.3.0, ^0.0.3 -> <0.0.4)
+        if ref[0] > 0 or n_spec == 1:
+            hi = (ref[0] + 1, 0, 0)
+        elif ref[1] > 0 or n_spec == 2:
+            hi = (ref[0], ref[1] + 1, 0)
+        else:
+            hi = (ref[0], ref[1], ref[2] + 1)
+        return ref <= v < hi
+    if op == "~":
+        # Masterminds tilde: ~1 -> >=1 <2; ~1.2(/.3) -> >=1.2(.3) <1.3.0
+        hi = (ref[0] + 1, 0, 0) if n_spec == 1 else (ref[0], ref[1] + 1, 0)
+        return ref <= v < hi
+    if -1 in ref:  # wildcard: compare only the specified leading parts
+        k = ref.index(-1)
+        return v[:k] == ref[:k] if op == "=" else _semver_one(
+            op + ".".join(str(p) for p in ref[:k] + (0,) * (3 - k)), v)
+    return {"=": v == ref, "!=": v != ref, ">": v > ref, "<": v < ref,
+            ">=": v >= ref, "<=": v <= ref}[op]
+
+
+def _semver_compare(constraint: str, version: str) -> bool:
+    """Masterminds/semver subset used by chart conditions: AND via
+    comma/space, OR via ||, operators = != > < >= <= ^ ~ and x/* wildcards.
+    'op version' with whitespace between them is one clause (the common
+    spaced form '>= 1.19-0'), so operators are glued to their operand
+    before splitting."""
+    v, _ = _semver_parse(version)
+    for alt in constraint.split("||"):
+        alt = re.sub(r"(>=|<=|!=|=|>|<|\^|~)\s+", r"\1", alt.strip())
+        clauses = [c for c in re.split(r"[,\s]+", alt) if c]
+        if all(_semver_one(c, v) for c in clauses):
+            return True
+    return False
+
+
+def _sprig_call(fn: str, vals, sc: _Scope):
+    """Sprig-subset functions shared by function position (sprig argument
+    order) and pipe position (piped value appended last). Returns
+    _SPRIG_MISS for unknown names so callers fall through to their error."""
+    if fn == "sha256sum":
+        return hashlib.sha256(str(vals[0]).encode()).hexdigest()
+    if fn == "b64enc":
+        return base64.b64encode(str(vals[0]).encode()).decode()
+    if fn == "b64dec":
+        try:
+            return base64.b64decode(str(vals[0]).encode()).decode()
+        except Exception:
+            raise ChartError(f"{sc.origin}: b64dec: invalid base64")
+    if fn == "toJson":
+        # default=str keeps YAML-native dates/timestamps renderable (their
+        # ISO form), matching toJson's never-fails contract closely enough
+        return _json.dumps(vals[0], default=str)
+    if fn == "fromJson":
+        try:
+            return _json.loads(str(vals[0]))
+        except ValueError:
+            raise ChartError(f"{sc.origin}: fromJson: invalid JSON")
+    if fn == "title":
+        return str(vals[0]).title()
+    if fn == "contains":       # contains substr str
+        return str(vals[0]) in str(vals[1])
+    if fn == "hasPrefix":      # hasPrefix prefix str
+        return str(vals[1]).startswith(str(vals[0]))
+    if fn == "hasSuffix":
+        return str(vals[1]).endswith(str(vals[0]))
+    if fn == "repeat":         # repeat n str
+        return str(vals[1]) * _num(vals[0])
+    if fn == "join":           # join sep list
+        seq = vals[1] if isinstance(vals[1], (list, tuple)) else []
+        return str(vals[0]).join("" if v is None else str(v) for v in seq)
+    if fn == "splitList":      # splitList sep str
+        return str(vals[1]).split(str(vals[0]))
+    if fn == "ternary":        # ternary trueVal falseVal cond
+        return vals[0] if _truthy(vals[2]) else vals[1]
+    if fn == "coalesce":
+        for v in vals:
+            if _truthy(v):
+                return v
+        return None
+    if fn in ("add", "mul"):
+        return _ft.reduce(_op.add if fn == "add" else _op.mul,
+                          (_num(v) for v in vals))
+    if fn == "sub":
+        return _num(vals[0]) - _num(vals[1])
+    if fn == "div":
+        d = _num(vals[1])
+        return _num(vals[0]) // d if d else 0
+    if fn == "mod":
+        d = _num(vals[1])
+        return _num(vals[0]) % d if d else 0
+    if fn == "add1":
+        return _num(vals[0]) + 1
+    if fn == "int":
+        return _num(vals[0])
+    if fn == "tpl":            # tpl templateString context
+        nodes, _, _ = _parse(_tokenize(str(vals[0])), 0, sc.origin)
+        return _render_nodes(nodes, sc.child(dot=vals[1]))
+    if fn == "semverCompare":  # semverCompare constraint version
+        return _semver_compare(str(vals[0]), str(vals[1]))
+    return _SPRIG_MISS
 
 
 def _split_pipes(s: str) -> List[str]:

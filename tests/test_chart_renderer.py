@@ -169,14 +169,16 @@ def test_range_over_map_with_bindings(tmp_path):
 
 
 def test_unsupported_pipe_raises_chart_error(tmp_path):
+    # genCA needs real certificate machinery — stays ChartError territory
+    # (sha256sum et al. graduated into the builtin sprig subset)
     tmpl = textwrap.dedent("""\
         apiVersion: v1
         kind: ConfigMap
         metadata:
-          name: {{ .Release.Name | sha256sum }}
+          name: {{ .Release.Name | genCA }}
     """)
     path = write_chart(tmp_path, "x: 1\n", {"cm.yaml": tmpl})
-    with pytest.raises(ChartError, match="sha256sum"):
+    with pytest.raises(ChartError, match="genCA"):
         process_chart(path)
 
 
@@ -429,3 +431,111 @@ def test_disabled_subchart_defines_do_not_shadow(tmp_path):
         "cache:\n  enabled: true", "cache:\n  enabled: false"))
     docs = {d["kind"]: d for d in process_chart(str(work))}
     assert docs["Deployment"]["metadata"]["labels"]["team"] == "data"
+
+
+def test_sprig_subset_functions(tmp_path):
+    """The sprig long tail charts commonly use: checksum annotations
+    (sha256sum), secrets (b64enc/b64dec), JSON round-trips, string
+    predicates, arithmetic, ternary/coalesce, join/splitList, and tpl."""
+    import base64
+    import hashlib
+
+    values = textwrap.dedent("""\
+        config: "a=1"
+        secret: hunter2
+        hosts: [alpha, beta]
+        bannerTpl: "host-{{ .Values.config }}"
+        flag: true
+    """)
+    cm = textwrap.dedent("""\
+        apiVersion: v1
+        kind: ConfigMap
+        metadata:
+          name: probe
+          annotations:
+            checksum/config: {{ .Values.config | sha256sum }}
+            enc: {{ b64enc .Values.secret }}
+            dec: {{ .Values.secret | b64enc | b64dec }}
+            js: {{ toJson .Values.hosts }}
+            round: {{ (fromJson "[1, 2]") | len }}
+            joined: {{ join "," .Values.hosts }}
+            split: {{ (splitList "," "x,y,z") | len }}
+            pick: {{ ternary "up" "down" .Values.flag }}
+            co: {{ coalesce "" .Values.secret "fallback" }}
+            math: {{ add 1 2 3 }}-{{ sub 9 4 }}-{{ mul 2 3 }}-{{ div 9 2 }}-{{ mod 9 2 }}
+            pfx: {{ ternary "p" "q" (hasPrefix "hun" .Values.secret) }}
+            cont: {{ ternary "in" "out" (contains "=1" .Values.config) }}
+            rep: {{ repeat 3 "ab" }}
+            tpl: {{ tpl .Values.bannerTpl . }}
+            cap: {{ "hello world" | title }}
+    """)
+    docs = process_chart(
+        write_chart(tmp_path, values, {"cm.yaml": cm}), release_name="r")
+    ann = docs[0]["metadata"]["annotations"]
+    assert ann["checksum/config"] == hashlib.sha256(b"a=1").hexdigest()
+    assert ann["enc"] == base64.b64encode(b"hunter2").decode()
+    assert ann["dec"] == "hunter2"
+    # the rendered text is YAML-parsed, so the JSON string reads back as a list
+    assert ann["js"] == ["alpha", "beta"]
+    assert ann["round"] == 2
+    assert ann["joined"] == "alpha,beta"
+    assert ann["split"] == 3
+    assert ann["pick"] == "up"
+    assert ann["co"] == "hunter2"
+    assert ann["math"] == "6-5-6-4-1"
+    assert ann["pfx"] == "p"
+    assert ann["cont"] == "in"
+    assert ann["rep"] == "ababab"
+    assert ann["tpl"] == "host-a=1"
+    assert ann["cap"] == "Hello World"
+
+
+def test_semver_compare(tmp_path):
+    """semverCompare: the Masterminds subset chart conditions use."""
+    values = "kubeVersion: v1.23.4\n"
+    cm = textwrap.dedent("""\
+        apiVersion: v1
+        kind: ConfigMap
+        metadata:
+          name: semver
+          annotations:
+            ge: {{ ternary "y" "n" (semverCompare ">=1.23.0" .Values.kubeVersion) }}
+            lt: {{ ternary "y" "n" (semverCompare "<1.23.0" .Values.kubeVersion) }}
+            caret: {{ ternary "y" "n" (semverCompare "^1.20.0" .Values.kubeVersion) }}
+            tilde: {{ ternary "y" "n" (semverCompare "~1.23.1" .Values.kubeVersion) }}
+            tildeno: {{ ternary "y" "n" (semverCompare "~1.22.0" .Values.kubeVersion) }}
+            wild: {{ ternary "y" "n" (semverCompare "1.23.x" .Values.kubeVersion) }}
+            range: {{ ternary "y" "n" (semverCompare ">=1.20.0, <1.24.0" .Values.kubeVersion) }}
+            either: {{ ternary "y" "n" (semverCompare "<1.0.0 || >=1.23.0" .Values.kubeVersion) }}
+            exact: {{ ternary "y" "n" (semverCompare "=1.23.4" .Values.kubeVersion) }}
+            neq: {{ ternary "y" "n" (semverCompare "!=1.23.4" .Values.kubeVersion) }}
+    """)
+    docs = process_chart(
+        write_chart(tmp_path, values, {"cm.yaml": cm}), release_name="r")
+    ann = docs[0]["metadata"]["annotations"]
+    want = {"ge": "y", "lt": "n", "caret": "y", "tilde": "y",
+            "tildeno": "n", "wild": "y", "range": "y", "either": "y",
+            "exact": "y", "neq": "n"}
+    for k, v in want.items():
+        assert ann[k] == v, (k, ann[k])
+
+
+def test_semver_masterminds_edge_semantics():
+    """Direct checks of the Masterminds rules charts rely on: the spaced
+    'op version' form is one clause, caret pins the leftmost nonzero
+    element (pre-1.0 pinning), and major-only tilde spans the major."""
+    from open_simulator_tpu.chart.renderer import _semver_compare
+
+    assert _semver_compare(">= 1.20.0", "1.23.4")          # spaced form
+    assert _semver_compare(">= 1.20.0, < 1.24.0", "1.23.4")
+    assert not _semver_compare(">= 1.24.0", "1.23.4")
+    assert _semver_compare(">= 1.19-0", "v1.23.4")          # helm kubeVersion idiom
+    assert not _semver_compare("^0.2.3", "0.9.0")           # caret: < 0.3.0
+    assert _semver_compare("^0.2.3", "0.2.9")
+    assert not _semver_compare("^0.0.3", "0.0.4")           # caret: < 0.0.4
+    assert _semver_compare("^1.2.3", "1.9.0")
+    assert not _semver_compare("^1.2.3", "2.0.0")
+    assert _semver_compare("~1", "1.5.0")                   # tilde major-only
+    assert not _semver_compare("~1", "2.0.0")
+    assert _semver_compare("~1.2", "1.2.9")
+    assert not _semver_compare("~1.2", "1.3.0")
