@@ -142,3 +142,38 @@ def test_node_axis_sharding_with_spread_constraints():
         results.append(np.asarray(out.node))
     np.testing.assert_array_equal(results[1], results[0])
     np.testing.assert_array_equal(results[2], results[0])
+
+
+def test_node_axis_sharding_bit_equal_all_ops():
+    """Same mesh-shape equality as above, but on the all-ops workload —
+    the sparse-slot column updates (dynamic-update-slice on the sharded
+    carries), affinity/anti-affinity/spread ops, and ports must survive
+    GSPMD resharding bit-for-bit too."""
+    import __graft_entry__ as ge
+    import jax.numpy as jnp
+    from open_simulator_tpu.engine.scheduler import device_arrays
+    from open_simulator_tpu.parallel.sweep import (
+        active_masks_for_counts,
+        batched_schedule,
+        shard_arrays,
+    )
+
+    snap = ge._synthetic_snapshot(n_nodes=8, n_pods=48, max_new=8, rich=True)
+    cfg = make_config(snap)
+    assert cfg.slot_paint and cfg.enable_anti_affinity and cfg.enable_spread
+    counts = [0, 2, 5, 8] * 2               # 8 lanes; 16 total nodes
+    masks = jnp.asarray(active_masks_for_counts(snap, counts))
+
+    results = []
+    for n_scen, n_node in [(1, 1), (4, 2), (2, 4)]:
+        mesh = make_mesh(n_scenario=n_scen, n_node=n_node)
+        arrs = shard_arrays(device_arrays(snap), mesh)
+        out = batched_schedule(arrs, masks, cfg, mesh=mesh)
+        results.append((np.asarray(out.node), np.asarray(out.fail_counts),
+                        np.asarray(out.state.headroom),
+                        np.asarray(out.state.term_block),
+                        np.asarray(out.state.group_count)))
+    base = results[0]
+    for got in results[1:]:
+        for a, b in zip(got, base):
+            np.testing.assert_array_equal(a, b)
