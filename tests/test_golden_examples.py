@@ -30,7 +30,7 @@ def _run_config(config_name):
     }
 
 
-CONFIGS = ["config.yaml", "gpushare-config.yaml", "openlocal-config.yaml", "stateful-config.yaml", "chart-config.yaml", "morepods-config.yaml", "constraints-config.yaml"]
+CONFIGS = ["config.yaml", "gpushare-config.yaml", "openlocal-config.yaml", "stateful-config.yaml", "chart-config.yaml", "morepods-config.yaml", "constraints-config.yaml", "controlplane-config.yaml"]
 
 
 def _golden_path(name):
