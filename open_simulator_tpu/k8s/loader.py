@@ -189,13 +189,18 @@ def make_valid_pod(pod: k8s.Pod) -> k8s.Pod:
     p.phase = "Pending" if not p.node_name else "Running"
     if not p.meta.name:
         raise PodValidationError("pod has no name")
-    if not _DNS1123.match(p.meta.name):
+    if len(p.meta.name) > 253 or not _DNS1123.match(p.meta.name):
         raise PodValidationError(
             f"pod name {p.meta.name!r} is not a valid DNS-1123 subdomain")
     if not _DNS1123_LABEL.match(p.meta.namespace):
         raise PodValidationError(
             f"pod {p.meta.name}: namespace {p.meta.namespace!r} is not a "
             f"valid DNS-1123 label")
+    if p.node_name and (len(p.node_name) > 253 or not _DNS1123.match(p.node_name)):
+        raise PodValidationError(
+            f"pod {p.key}: spec.nodeName {p.node_name!r} is not a valid "
+            f"DNS-1123 subdomain")
+    _validate_labels(p.key, p.meta.labels)
     if not p.containers:
         raise PodValidationError(f"pod {p.key} has no containers")
     seen_containers = set()
@@ -209,6 +214,46 @@ def make_valid_pod(pod: k8s.Pod) -> k8s.Pod:
                 raise PodValidationError(f"pod {p.key} negative request {name}")
             if name in c.limits and c.limits[name] < v:
                 raise PodValidationError(f"pod {p.key} request {name} exceeds limit")
+    # port validation runs on the RAW spec (Container.from_dict keeps only
+    # scheduling-relevant hostPorts; the vendored validateContainerPorts
+    # checks every declared port). hostPort dedup follows the vendored
+    # grouping: regular containers share one scope, each init container is
+    # checked in isolation (they run sequentially — validation.go
+    # checkHostPortConflicts call sites).
+    spec_raw = p.raw.get("spec") or {}
+
+    def _check_ports(containers_raw, shared_scope):
+        seen = set()
+        for c_raw in containers_raw:
+            if not shared_scope:
+                seen = set()
+            for port in c_raw.get("ports") or []:
+                proto = port.get("protocol") or "TCP"
+                if proto not in ("TCP", "UDP", "SCTP"):
+                    raise PodValidationError(
+                        f"pod {p.key}: invalid port protocol {proto!r}")
+                for fname in ("containerPort", "hostPort"):
+                    num = port.get(fname)
+                    if num is not None and not 0 < int(num) <= 65535:
+                        raise PodValidationError(
+                            f"pod {p.key}: {fname} {num} out of range 1-65535")
+                hp = port.get("hostPort")
+                if hp:
+                    key = (int(hp), proto, port.get("hostIP") or "")
+                    if key in seen:
+                        raise PodValidationError(
+                            f"pod {p.key}: duplicate hostPort {hp}/{proto}")
+                    seen.add(key)
+
+    _check_ports(spec_raw.get("containers") or [], shared_scope=True)
+    _check_ports(spec_raw.get("initContainers") or [], shared_scope=False)
+    seen_volumes = set()
+    for vol in (p.raw.get("spec") or {}).get("volumes") or []:
+        vname = vol.get("name", "")
+        if vname in seen_volumes:
+            raise PodValidationError(
+                f"pod {p.key}: duplicate volume name {vname!r}")
+        seen_volumes.add(vname)
     restart = (p.raw.get("spec") or {}).get("restartPolicy", "Always")
     if restart not in ("Always", "OnFailure", "Never"):
         raise PodValidationError(
@@ -242,6 +287,27 @@ _DNS1123 = re.compile(
     r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*$")
 _DNS1123_LABEL = re.compile(r"^[a-z0-9]([-a-z0-9]{0,61}[a-z0-9])?$")  # label (namespaces)
 _SELECTOR_OPS = {"In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"}
+# label selectors (pod affinity / spread / workloads) take the set-based
+# ops only — Gt/Lt are node-selector-exclusive (vendored
+# apis/meta/v1/validation ValidateLabelSelectorRequirement)
+_LABEL_SELECTOR_OPS = {"In", "NotIn", "Exists", "DoesNotExist"}
+# qualified label key: optional DNS-1123-subdomain prefix / name segment
+_LABEL_KEY = re.compile(
+    r"^([a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*/)?"
+    r"[A-Za-z0-9]([-A-Za-z0-9_.]{0,61}[A-Za-z0-9])?$")
+_LABEL_VALUE = re.compile(r"^([A-Za-z0-9]([-A-Za-z0-9_.]{0,61}[A-Za-z0-9])?)?$")
+
+
+def _validate_labels(owner: str, labels) -> None:
+    """metadata.labels syntax (vendored ValidateLabels): qualified keys
+    (prefix <= 253, name <= 63) and values <= 63 alnum/-_. chars."""
+    for k, v in (labels or {}).items():
+        prefix, _, name = k.rpartition("/")
+        if len(name) > 63 or len(prefix) > 253 or not _LABEL_KEY.match(k):
+            raise PodValidationError(f"{owner}: invalid label key {k!r}")
+        if len(str(v)) > 63 or not _LABEL_VALUE.match(str(v)):
+            raise PodValidationError(
+                f"{owner}: invalid label value {v!r} for key {k!r}")
 
 
 def _validate_selector_ops(p: k8s.Pod) -> None:
@@ -260,6 +326,29 @@ def _validate_selector_ops(p: k8s.Pod) -> None:
             if op in ("Exists", "DoesNotExist") and expr.get("values"):
                 raise PodValidationError(
                     f"pod {p.key}: nodeAffinity {op} must not set values")
+    # label selectors (pod (anti-)affinity terms + spread constraints) take
+    # the set-based ops only — Gt/Lt are node-selector-exclusive
+    selectors = []
+    for kind in ("podAffinity", "podAntiAffinity"):
+        block = aff.get(kind) or {}
+        for term in block.get("requiredDuringSchedulingIgnoredDuringExecution") or []:
+            selectors.append(term.get("labelSelector"))
+        for pref in block.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+            selectors.append((pref.get("podAffinityTerm") or {}).get("labelSelector"))
+    for tc in (p.raw.get("spec") or {}).get("topologySpreadConstraints") or []:
+        selectors.append(tc.get("labelSelector"))
+    for sel in selectors:
+        for expr in (sel or {}).get("matchExpressions") or []:
+            op = expr.get("operator", "")
+            if op not in _LABEL_SELECTOR_OPS:
+                raise PodValidationError(
+                    f"pod {p.key}: invalid labelSelector operator {op!r}")
+            if op in ("In", "NotIn") and not expr.get("values"):
+                raise PodValidationError(
+                    f"pod {p.key}: labelSelector {op} requires values")
+            if op in ("Exists", "DoesNotExist") and expr.get("values"):
+                raise PodValidationError(
+                    f"pod {p.key}: labelSelector {op} must not set values")
 
 
 def make_valid_node(node: k8s.Node) -> k8s.Node:
@@ -271,6 +360,10 @@ def make_valid_node(node: k8s.Node) -> k8s.Node:
     n = node.clone()
     if not n.name:
         raise PodValidationError("node has no name")
+    if len(n.name) > 253 or not _DNS1123.match(n.name):
+        raise PodValidationError(
+            f"node name {n.name!r} is not a valid DNS-1123 subdomain")
+    _validate_labels(f"node {n.name}", n.meta.labels)
     if "pods" not in n.allocatable:
         n.allocatable["pods"] = MAX_PODS_DEFAULT
     n.meta.labels.setdefault("kubernetes.io/hostname", n.name)

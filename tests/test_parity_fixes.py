@@ -232,6 +232,99 @@ def test_make_valid_pod_apiserver_validation_subset():
                     {"key": "k", "operator": "Exists", "values": ["x"]}]}]}}}}))
 
 
+def test_make_valid_pod_widened_checks():
+    """Late-r4 widening toward vendored ValidatePodCreate: label syntax,
+    hostPort ranges/duplicates/protocols, duplicate volume names, nodeName
+    syntax, name length, and the label-selector op set (no Gt/Lt — those
+    are node-selector-exclusive)."""
+    import pytest
+
+    from open_simulator_tpu.k8s.loader import PodValidationError, make_valid_pod
+    from open_simulator_tpu.k8s.objects import Pod
+
+    def pod(meta=None, spec=None):
+        d = {"metadata": {"name": "ok", **(meta or {})},
+             "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "1"}}}],
+                      **(spec or {})}}
+        return Pod.from_dict(d)
+
+    with pytest.raises(PodValidationError, match="DNS-1123"):
+        make_valid_pod(pod(meta={"name": "a" * 254}))
+    with pytest.raises(PodValidationError, match="invalid label key"):
+        make_valid_pod(pod(meta={"labels": {"-bad": "v"}}))
+    with pytest.raises(PodValidationError, match="invalid label value"):
+        make_valid_pod(pod(meta={"labels": {"app": "x" * 64}}))
+    make_valid_pod(pod(meta={"labels": {"example.com/app": "web_1.2-a"}}))
+    with pytest.raises(PodValidationError, match="nodeName"):
+        make_valid_pod(pod(spec={"nodeName": "Bad_Node"}))
+    with pytest.raises(PodValidationError, match="out of range"):
+        make_valid_pod(pod(spec={"containers": [{
+            "name": "c", "ports": [{"hostPort": 70000}]}]}))
+    with pytest.raises(PodValidationError, match="port protocol"):
+        make_valid_pod(pod(spec={"containers": [{
+            "name": "c", "ports": [{"hostPort": 80, "protocol": "ICMP"}]}]}))
+    with pytest.raises(PodValidationError, match="duplicate hostPort"):
+        make_valid_pod(pod(spec={"containers": [{
+            "name": "c",
+            "ports": [{"hostPort": 80}, {"hostPort": 80}]}]}))
+    # same hostPort under different protocols is legal
+    make_valid_pod(pod(spec={"containers": [{
+        "name": "c",
+        "ports": [{"hostPort": 80}, {"hostPort": 80, "protocol": "UDP"}]}]}))
+    # vendored grouping: init containers run sequentially, so an init
+    # container may share a hostPort with a regular container (and with
+    # another init container) — only regular containers conflict
+    make_valid_pod(pod(spec={
+        "containers": [{"name": "c", "ports": [{"hostPort": 80}]}],
+        "initContainers": [
+            {"name": "i1", "ports": [{"hostPort": 80}]},
+            {"name": "i2", "ports": [{"hostPort": 80}]},
+        ]}))
+    # protocol enum applies to ALL declared ports, not just hostPorts
+    with pytest.raises(PodValidationError, match="port protocol"):
+        make_valid_pod(pod(spec={"containers": [{
+            "name": "c", "ports": [{"containerPort": 8080, "protocol": "ICMP"}]}]}))
+    with pytest.raises(PodValidationError, match="containerPort"):
+        make_valid_pod(pod(spec={"containers": [{
+            "name": "c", "ports": [{"containerPort": 0}]}]}))
+    with pytest.raises(PodValidationError, match="duplicate volume"):
+        make_valid_pod(pod(spec={"volumes": [
+            {"name": "v", "emptyDir": {}}, {"name": "v", "emptyDir": {}}]}))
+    with pytest.raises(PodValidationError, match="labelSelector operator"):
+        make_valid_pod(pod(spec={"affinity": {"podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "topologyKey": "zone",
+                "labelSelector": {"matchExpressions": [
+                    {"key": "k", "operator": "Gt", "values": ["1"]}]}}]}}}))
+    with pytest.raises(PodValidationError, match="labelSelector In requires"):
+        make_valid_pod(pod(spec={"topologySpreadConstraints": [{
+            "maxSkew": 1, "topologyKey": "zone",
+            "whenUnsatisfiable": "ScheduleAnyway",
+            "labelSelector": {"matchExpressions": [
+                {"key": "k", "operator": "In"}]}}]}))
+
+
+def test_make_valid_node_name_and_labels():
+    """Node-side validation (vendored ValidateNode subset): DNS-1123 name
+    and metadata.labels syntax."""
+    import pytest
+
+    from open_simulator_tpu.k8s.loader import PodValidationError, make_valid_node
+    from open_simulator_tpu.k8s.objects import Node
+
+    def node(name="n0", labels=None):
+        return Node.from_dict({
+            "metadata": {"name": name, "labels": labels or {}},
+            "status": {"allocatable": {"cpu": "1", "memory": "1Gi"}},
+        })
+
+    make_valid_node(node(labels={"node-role.kubernetes.io/master": ""}))
+    with pytest.raises(PodValidationError, match="DNS-1123"):
+        make_valid_node(node(name="Bad_Node"))
+    with pytest.raises(PodValidationError, match="invalid label key"):
+        make_valid_node(node(labels={"-bad": "v"}))
+
+
 def test_namespace_is_dns1123_label_not_subdomain():
     """Review r4: namespaces are DNS-1123 LABELS (no dots, <=63 chars),
     stricter than object names (subdomains)."""
