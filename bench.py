@@ -184,6 +184,15 @@ def main():
         out["northstar_wide_scenarios_per_sec_per_chip"] = round(
             wide["scenarios"] / wide_dt, 1)
         out["northstar_wide_lanes"] = wide["scenarios"]
+        # the all-ops variant of the north-star shape (every gate on) —
+        # the series the round-5 latency lead is defined on (ROADMAP)
+        nr = PRESETS["northstar-rich"]
+        assert all(nr[k] == ns[k] for k in ("nodes", "pods", "max_new", "scenarios")), (
+            "northstar-rich must differ from northstar only in workload")
+        nr_snap = build(nr["nodes"], nr["pods"], nr["max_new"], rich=True)
+        nr_dt = run_batched(nr_snap, nr["scenarios"], fail_reasons=args.fail_reasons)
+        out["northstar_rich_scenarios_per_sec_per_chip"] = round(
+            nr["scenarios"] / nr_dt, 2)
     print(json.dumps(out))
 
 
