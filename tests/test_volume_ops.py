@@ -526,3 +526,60 @@ def test_dedup_gate_off_counts_every_mount():
                             make_config(snap, enable_vol_dedup=False))
     nodes_off = np.asarray(out_off.node)
     assert (nodes_off >= 0).sum() == 1 and (nodes_off == -1).sum() == 1
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_unique_volume_count_invariant_fuzz(seed):
+    """Random mixes of shared and exclusive CSI claims over limit-capped
+    nodes: every placement must keep each node's UNIQUE-volume attachment
+    count within its cap (the vendored counting), and pods sharing an
+    already-present volume must not be blocked by a full node that holds
+    only their own volume."""
+    from open_simulator_tpu.encode.snapshot import EncodeOptions, encode_cluster
+    from open_simulator_tpu.engine.scheduler import (
+        device_arrays, make_config, schedule_pods)
+
+    rng = np.random.RandomState(seed)
+    n_nodes, n_claims, n_pods = 4, 6, 24
+    cap = int(rng.randint(1, 4))
+    nodes = [
+        make_node(f"n{i}", labels={"kubernetes.io/hostname": f"n{i}"},
+                  extra_alloc={"attachable-volumes-csi-ebs.csi.aws.com": cap})
+        for i in range(n_nodes)
+    ]
+    pvcs_ = [pvc(f"c{j}", volume_name=f"ebs-{j}") for j in range(n_claims)]
+    pvs_ = [csi_pv(f"ebs-{j}", f"c{j}", modes=("ReadWriteMany",))
+            for j in range(n_claims)]
+    pods = [
+        claim_pod(f"p{i}", [f"c{rng.randint(n_claims)}"], cpu="10m")
+        for i in range(n_pods)
+    ]
+    snap = encode_cluster(nodes, pods, EncodeOptions(
+        pvcs=pvcs_, pvs=pvs_, storage_classes=[WFC_SC]))
+    cfg = make_config(snap)
+    assert cfg.enable_vol_limits
+    arrs = device_arrays(snap)
+    out = schedule_pods(arrs, arrs.active, cfg)
+    placed = np.asarray(out.node)
+
+    # invariant: unique volumes per node <= cap
+    pod_claim = [int(c[1:]) for c in
+                 (p.raw["spec"]["volumes"][0]["persistentVolumeClaim"]["claimName"]
+                  for p in pods)]
+    for ni in range(n_nodes):
+        vols = {pod_claim[pi] for pi in range(n_pods) if placed[pi] == ni}
+        assert len(vols) <= cap, (seed, ni, vols, cap)
+
+    # an unscheduled pod must not share a volume with EVERY node that has
+    # spare unique slots... stronger: if some node already holds the pod's
+    # volume, the pod cannot be unscheduled for volume reasons (it always
+    # fits there)
+    for pi in range(n_pods):
+        if placed[pi] >= 0:
+            continue
+        holders = [ni for ni in range(n_nodes)
+                   if pod_claim[pi] in {pod_claim[q] for q in range(pi)
+                                        if placed[q] == ni}]
+        assert not holders, (
+            f"pod p{pi} unscheduled although node(s) {holders} already "
+            f"hold volume ebs-{pod_claim[pi]}")
