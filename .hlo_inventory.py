@@ -1,0 +1,41 @@
+"""Dump the while-body instruction inventory for the rich north-star jit."""
+import os
+import re
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+
+import __graft_entry__ as ge
+from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
+from open_simulator_tpu.parallel.sweep import active_masks_for_counts
+
+N_NODES, N_PODS, LANES, MAX_NEW = 512, 1024, 8, 8  # small: same op structure
+
+snap = ge._synthetic_snapshot(n_nodes=N_NODES, n_pods=N_PODS, max_new=MAX_NEW, rich=True)
+cfg = make_config(snap)._replace(fail_reasons=False)
+arrs = device_arrays(snap)
+counts = [min(i % (MAX_NEW + 1), MAX_NEW) for i in range(LANES)]
+masks = jnp.asarray(active_masks_for_counts(snap, counts))
+fn = jax.jit(jax.vmap(lambda a: schedule_pods(arrs, a, cfg)))
+txt = fn.lower(masks).compile().as_text()
+
+# find the while body computation (largest computation named *body*)
+blocks = re.split(r"\n(?=%?\w[\w\.\-]* \(|ENTRY )", txt)
+body = max((b for b in blocks if re.match(r"%?\w*body", b)), key=len, default=None)
+print("n computations:", len(blocks))
+if body is None:
+    sys.exit("no body found")
+lines = body.splitlines()
+print("body header:", lines[0][:120])
+print("body instruction count:", len(lines))
+kinds = Counter()
+for ln in lines[1:]:
+    m = re.match(r"\s+(?:ROOT )?%?[\w\.\-]+ = \S+ ([\w\-]+)\(", ln)
+    if m:
+        kinds[m.group(1)] += 1
+for k, v in kinds.most_common(40):
+    print(f"{k:<32}{v}")

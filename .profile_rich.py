@@ -1,0 +1,65 @@
+"""Profile the all-ops north-star while body: per-op time + kernel counts.
+
+Scratch tool (not part of the package): parses the device trace json
+directly because tensorboard_plugin_profile is version-incompatible here.
+"""
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+
+import __graft_entry__ as ge
+from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
+from open_simulator_tpu.parallel.sweep import active_masks_for_counts
+
+N_NODES, N_PODS, LANES, MAX_NEW = int(sys.argv[1]) if len(sys.argv) > 1 else 5120, 51200, 64, 64
+N_NODES = 5120
+N_PODS = 51200
+
+snap = ge._synthetic_snapshot(n_nodes=N_NODES, n_pods=N_PODS, max_new=MAX_NEW, rich=True)
+cfg = make_config(snap)._replace(fail_reasons=False)
+arrs = device_arrays(snap)
+counts = [min(i % (MAX_NEW + 1), MAX_NEW) for i in range(LANES)]
+masks = jnp.asarray(active_masks_for_counts(snap, counts))
+fn = jax.jit(jax.vmap(lambda a: schedule_pods(arrs, a, cfg)))
+out = fn(masks); jax.block_until_ready(out.node)
+
+t0 = time.perf_counter(); out = fn(masks); jax.block_until_ready(out.node)
+wall = time.perf_counter() - t0
+print(f"wall: {wall:.3f}s  scen/s: {LANES/wall:.2f}", flush=True)
+
+trace_dir = "/tmp/richprof"
+os.system(f"rm -rf {trace_dir}")
+with jax.profiler.trace(trace_dir):
+    out = fn(masks); jax.block_until_ready(out.node)
+
+# find the trace json
+paths = glob.glob(f"{trace_dir}/plugins/profile/*/*.trace.json.gz")
+print("trace files:", paths, flush=True)
+ev_by_name = defaultdict(lambda: [0, 0.0])  # name -> [count, total_us]
+total_dur = 0.0
+for p in paths:
+    with gzip.open(p, "rt") as f:
+        data = json.load(f)
+    for ev in data.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "")
+        dur = ev.get("dur", 0)
+        # keep only device-side ops (pid names vary; filter by arg cat?)
+        ev_by_name[name][0] += 1
+        ev_by_name[name][1] += dur
+        total_dur += dur
+
+rows = sorted(ev_by_name.items(), key=lambda kv: -kv[1][1])[:60]
+print(f"{'name':<72} {'count':>8} {'total_ms':>10} {'us/call':>8}")
+for name, (cnt, tot) in rows:
+    print(f"{name[:72]:<72} {cnt:>8} {tot/1000:>10.1f} {tot/cnt:>8.2f}")

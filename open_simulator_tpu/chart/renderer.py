@@ -381,44 +381,161 @@ def _num(v):
             return 0
 
 
+def _semver_split(s: str):
+    """strip v/V and build metadata, split off the prerelease."""
+    core_meta = s.strip().lstrip("vV").split("+")[0]
+    if "-" in core_meta:
+        core, pre = core_meta.split("-", 1)
+    else:
+        core, pre = core_meta, ""
+    return core, pre
+
+
 def _semver_parse(s: str):
-    """-> ((major, minor, patch), n_specified); x/X/* parts read as -1."""
-    core = s.strip().lstrip("vV").split("-")[0].split("+")[0]
+    """Version string -> ((major, minor, patch), prerelease)."""
+    core, pre = _semver_split(s)
     parts = [p for p in core.split(".") if p != ""]
     out = []
     for part in (parts + ["0", "0", "0"])[:3]:
         digits = re.match(r"\d*", part).group()
-        out.append(-1 if part in ("x", "X", "*") else int(digits or 0))
-    return tuple(out), min(len(parts), 3)
+        out.append(int(digits or 0))
+    return tuple(out), pre
 
 
-def _semver_one(clause: str, v) -> bool:
+def _semver_constraint(s: str):
+    """Constraint operand -> (con, minor_dirty, patch_dirty, dirty, pre),
+    mirroring parseConstraint's dirty tracking (Masterminds constraints.go:
+    230-260): a missing or x/X/* part zeroes the operand and marks it dirty
+    instead of being a plain zero."""
+    core, pre = _semver_split(s)
+    parts = core.split(".") if core else []
+
+    def _x(p):
+        return p in ("x", "X", "*")
+
+    def _int(p):
+        return int(re.match(r"\d*", p).group() or 0)
+
+    if not parts or parts[0] == "" or _x(parts[0]):
+        return (0, 0, 0), False, False, True, pre
+    maj = _int(parts[0])
+    if len(parts) < 2 or parts[1] == "" or _x(parts[1]):
+        return (maj, 0, 0), True, False, True, pre
+    minor = _int(parts[1])
+    if len(parts) < 3 or parts[2] == "" or _x(parts[2]):
+        return (maj, minor, 0), False, True, True, pre
+    return (maj, minor, _int(parts[2])), False, False, False, pre
+
+
+def _pre_cmp(a: str, b: str) -> int:
+    """Prerelease precedence (comparePrerelease, version.go:472-512):
+    dot-separated identifiers, numeric < alphanumeric, release > prerelease."""
+    if a == b:
+        return 0
+    if a == "":
+        return 1   # release outranks any prerelease
+    if b == "":
+        return -1
+    ap, bp = a.split("."), b.split(".")
+    for i in range(max(len(ap), len(bp))):
+        x = ap[i] if i < len(ap) else ""
+        y = bp[i] if i < len(bp) else ""
+        if x == y:
+            continue
+        if x == "":
+            return -1  # fewer identifiers = lower precedence
+        if y == "":
+            return 1
+        xn, yn = x.isdigit(), y.isdigit()
+        if xn and yn:
+            return 1 if int(x) > int(y) else -1
+        if xn:
+            return -1  # numeric identifiers rank below alphanumeric
+        if yn:
+            return 1
+        return 1 if x > y else -1
+    return 0
+
+
+def _ver_cmp(v, vpre: str, o, opre: str) -> int:
+    if v != o:
+        return -1 if v < o else 1
+    return _pre_cmp(vpre, opre)
+
+
+def _semver_one(clause: str, v, vpre: str) -> bool:
+    """One constraint clause against version (v, vpre), following the
+    vendored constraint functions (constraints.go:284-545) including dirty
+    (partial / x) operands and the issue-21 prerelease rule."""
     clause = clause.strip()
-    if not clause or clause == "*":
+    if not clause:
         return True
     m = re.match(r"(>=|<=|!=|=|>|<|\^|~)?\s*(.*)$", clause)
     op = m.group(1) or "="
-    ref, n_spec = _semver_parse(m.group(2))
-    if op == "^":
-        # Masterminds caret: >= ref, < next increment of the LEFTMOST
-        # NONZERO element (^0.2.3 -> <0.3.0, ^0.0.3 -> <0.0.4)
-        if ref[0] > 0 or n_spec == 1:
-            hi = (ref[0] + 1, 0, 0)
-        elif ref[1] > 0 or n_spec == 2:
-            hi = (ref[0], ref[1] + 1, 0)
-        else:
-            hi = (ref[0], ref[1], ref[2] + 1)
-        return ref <= v < hi
-    if op == "~":
-        # Masterminds tilde: ~1 -> >=1 <2; ~1.2(/.3) -> >=1.2(.3) <1.3.0
-        hi = (ref[0] + 1, 0, 0) if n_spec == 1 else (ref[0], ref[1] + 1, 0)
-        return ref <= v < hi
-    if -1 in ref:  # wildcard: compare only the specified leading parts
-        k = ref.index(-1)
-        return v[:k] == ref[:k] if op == "=" else _semver_one(
-            op + ".".join(str(p) for p in ref[:k] + (0,) * (3 - k)), v)
-    return {"=": v == ref, "!=": v != ref, ">": v > ref, "<": v < ref,
-            ">=": v >= ref, "<=": v <= ref}[op]
+    con, minor_dirty, patch_dirty, dirty, cpre = _semver_constraint(m.group(2))
+    if vpre and not cpre:
+        # a prerelease version only matches clauses that opt into
+        # prereleases (every constraint function's leading check — the
+        # reason charts write '>=1.19-0' rather than '>=1.19')
+        return False
+    cmp = _ver_cmp(v, vpre, con, cpre)
+    if op == "~" or (op == "=" and dirty):
+        # constraintTilde; '=' with a dirty operand opts into it
+        # (constraintTildeOrEqual) — '=1.2' matches 1.2.5
+        if cmp < 0:
+            return False
+        if con == (0, 0, 0) and not minor_dirty and not patch_dirty:
+            return True
+        if v[0] != con[0]:
+            return False
+        return v[1] == con[1] or minor_dirty
+    if op == "=":
+        return cmp == 0
+    if op == "!=":
+        if dirty:
+            if con[0] != v[0]:
+                return True
+            if con[1] != v[1] and not minor_dirty:
+                return True
+            if minor_dirty:
+                return False
+            if con[2] != v[2] and not patch_dirty:
+                return True
+            if patch_dirty:
+                return _pre_cmp(vpre, cpre) != 0 if (vpre or cpre) else False
+        return cmp != 0
+    if op == ">":
+        if dirty:
+            # '>11' needs major > 11 (11.1.0 is NOT >11); '>11.1' needs
+            # minor > 1 (constraints.go:345-363)
+            if v[0] != con[0]:
+                return v[0] > con[0]
+            if minor_dirty:
+                return False
+            if patch_dirty:
+                return v[1] > con[1]
+        return cmp > 0
+    if op == "<":
+        return cmp < 0
+    if op == ">=":
+        return cmp >= 0
+    if op == "<=":
+        if dirty:
+            if v[0] > con[0]:
+                return False
+            return not (v[0] == con[0] and v[1] > con[1] and not minor_dirty)
+        return cmp <= 0
+    # op == "^" (constraintCaret): >= con, < next increment of the
+    # leftmost nonzero/dirty element
+    if cmp < 0:
+        return False
+    if con[0] > 0 or minor_dirty:
+        return v[0] == con[0]
+    if v[0] > 0:
+        return False
+    if con[1] > 0 or patch_dirty:
+        return v[1] == con[1]
+    return v[2] == con[2]
 
 
 def _semver_compare(constraint: str, version: str) -> bool:
@@ -427,11 +544,15 @@ def _semver_compare(constraint: str, version: str) -> bool:
     'op version' with whitespace between them is one clause (the common
     spaced form '>= 1.19-0'), so operators are glued to their operand
     before splitting."""
-    v, _ = _semver_parse(version)
+    v, vpre = _semver_parse(version)
     for alt in constraint.split("||"):
         alt = re.sub(r"(>=|<=|!=|=|>|<|\^|~)\s+", r"\1", alt.strip())
         clauses = [c for c in re.split(r"[,\s]+", alt) if c]
-        if all(_semver_one(c, v) for c in clauses):
+        if not clauses:
+            if not vpre:  # empty constraint = '*': releases only
+                return True
+            continue
+        if all(_semver_one(c, v, vpre) for c in clauses):
             return True
     return False
 
@@ -459,7 +580,10 @@ def _sprig_call(fn: str, vals, sc: _Scope):
         except ValueError:
             raise ChartError(f"{sc.origin}: fromJson: invalid JSON")
     if fn == "title":
-        return str(vals[0]).title()
+        # Go strings.Title upcases word-initial letters without touching
+        # the rest ('FOO bar' -> 'FOO Bar'); str.title would lowercase the
+        # remainder of each word
+        return re.sub(r"\b\w", lambda mm: mm.group().upper(), str(vals[0]))
     if fn == "contains":       # contains substr str
         return str(vals[0]) in str(vals[1])
     if fn == "hasPrefix":      # hasPrefix prefix str
@@ -486,11 +610,15 @@ def _sprig_call(fn: str, vals, sc: _Scope):
     if fn == "sub":
         return _num(vals[0]) - _num(vals[1])
     if fn == "div":
+        # Go integer division truncates toward zero (div -7 2 -> -3);
+        # Python // floors (-4)
         d = _num(vals[1])
-        return _num(vals[0]) // d if d else 0
+        return int(_num(vals[0]) / d) if d else 0
     if fn == "mod":
+        # Go % takes the dividend's sign (-7 mod 2 -> -1, not Python's 1)
         d = _num(vals[1])
-        return _num(vals[0]) % d if d else 0
+        a = _num(vals[0])
+        return a - int(a / d) * d if d else 0
     if fn == "add1":
         return _num(vals[0]) + 1
     if fn == "int":

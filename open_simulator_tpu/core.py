@@ -60,6 +60,10 @@ class SimulateResult:
     # WaitForFirstConsumer claim -> PV name chosen at bind (the PreBind
     # PVC.spec.volumeName write the reference's binder would do)
     volume_bindings: Dict[str, str] = field(default_factory=dict)
+    # pod key -> GPU device ids (with multiplicity) the engine allocated —
+    # the integer truth behind the gpu-index annotation (decode-side view of
+    # the Reserve allocation, open-gpu-share.go:147-188)
+    gpu_assignments: Dict[str, List[int]] = field(default_factory=dict)
 
     def placements(self) -> Dict[str, str]:
         return {sp.pod.key: sp.node_name for sp in self.scheduled_pods}
@@ -93,6 +97,7 @@ def decode_result(
     unscheduled: List[UnscheduledPod] = []
     pods_by_node: Dict[int, List[Pod]] = {}
     volume_bindings: Dict[str, str] = {}
+    gpu_assignments: Dict[str, List[int]] = {}
     forced = snapshot.arrays.forced_node
     for i, pod in enumerate(snapshot.pods):
         ni = int(node_assign[i])
@@ -105,6 +110,11 @@ def decode_result(
                         volume_bindings[claim_key] = (
                             snapshot.pv_names[int(vol_pick[i, j])])
             if gpu_pick is not None and pod.gpu_request()[0] > 0:
+                devs_int: List[int] = []
+                for d in np.nonzero(gpu_pick[i])[0]:
+                    devs_int += [int(d)] * int(gpu_pick[i][d])
+                if devs_int:
+                    gpu_assignments[pod.key] = devs_int
                 if bool(snapshot.arrays.gpu_has_forced[i]):
                     # user-pinned gpu-index is honored verbatim (the check
                     # is encode-time truth, NOT the annotation dict — decode
@@ -116,11 +126,9 @@ def decode_result(
                     # Reserve writes back (open-gpu-share.go:147-188);
                     # counts > 1 repeat the device id ("0-0-1"), matching
                     # the two-pointer's candDevIdList order
-                    devs: List[str] = []
-                    for d in np.nonzero(gpu_pick[i])[0]:
-                        devs += [str(d)] * int(gpu_pick[i][d])
-                    if devs:
-                        pod.meta.annotations[ANNO_GPU_INDEX] = "-".join(devs)
+                    if devs_int:
+                        pod.meta.annotations[ANNO_GPU_INDEX] = "-".join(
+                            str(d) for d in devs_int)
             scheduled.append(ScheduledPod(pod=pod, node_name=snapshot.node_names[ni]))
             pods_by_node.setdefault(ni, []).append(pod)
         else:
@@ -150,6 +158,7 @@ def decode_result(
         elapsed_s=elapsed_s,
         snapshot=snapshot,
         volume_bindings=volume_bindings,
+        gpu_assignments=gpu_assignments,
     )
 
 

@@ -123,6 +123,12 @@ class EngineConfig(NamedTuple):
     # values are bit-identical to the dense forms (each column is touched
     # at most once per pod, so the adds are the same adds).
     slot_paint: bool = False
+    # Existing-pods preference score via per-hit-term column gathers of the
+    # pref_paint carry instead of the dense [N, T2] mat-vec per step (a pod
+    # hits only a few preferred terms). make_config enables it when every
+    # pod fits the slot cap; values are identical (paint entries are
+    # integer-valued weight sums, so any summation order is exact).
+    pref_hit_slots: bool = False
     # Out-of-tree extension ops (engine/extensions.py ExtensionOp tuples) —
     # the WithFrameworkOutOfTreeRegistry analog
     # (pkg/simulator/simulator.go:188-195). Filter extensions append reason
@@ -142,6 +148,17 @@ class EngineConfig(NamedTuple):
     @property
     def enable_spread(self) -> bool:
         return self.enable_spread_hard or self.enable_spread_soft
+
+    @property
+    def maintain_dom_count(self) -> bool:
+        # The [K1, D, S] dom_count carry exists so pure-spread workloads
+        # avoid the [N, S] group_count carry. When group_count is
+        # maintained anyway (affinity/pref/hostname-spread), the spread
+        # ops read the batched gc-derived domain sums instead — identical
+        # integers — and the per-bind dom updates are dead weight, UNLESS
+        # an extension op may read the carry.
+        return self.enable_spread and (
+            not self.needs_group_count or bool(self.extensions))
 
     @property
     def needs_group_count(self) -> bool:
@@ -347,6 +364,7 @@ def _pod_xs(arrs: SnapshotArrays) -> Dict[str, jnp.ndarray]:
         "own_terms", "hit_terms",
         "spread_group", "spread_key", "spread_skew", "spread_hard", "spread_valid",
         "pref_group", "pref_key", "pref_weight", "pref_valid", "pref_tid", "hit_pref",
+        "hit_ptid",
         "gpu_mem", "gpu_cnt", "gpu_forced", "gpu_has_forced",
         "lvm_req", "sdev_req", "sdev_req_ssd",
         "vol_cid", "vol_pv_missing", "wfc_ccid", "wfc_valid", "vol_limit_req",
@@ -403,7 +421,8 @@ def _live_xs_names(cfg: EngineConfig, has_disabled: bool,
                  "spread_valid"}
     if cfg.enable_pref:
         live |= {"pref_group", "pref_key", "pref_weight", "pref_valid",
-                 "pref_tid", "hit_pref"}
+                 "pref_tid"}
+        live.add("hit_ptid" if cfg.pref_hit_slots else "hit_pref")
     if cfg.enable_gpu:
         live |= {"gpu_mem", "gpu_cnt", "gpu_forced", "gpu_has_forced"}
     if cfg.enable_storage:
@@ -422,7 +441,7 @@ def _live_xs_names(cfg: EngineConfig, has_disabled: bool,
 
 
 def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
-          hoisted, inv_alloc, state: SimState, x):
+          hoisted, inv_alloc, gcr_seg, state: SimState, x):
     n_nodes = arrs.alloc.shape[0]
     f32 = jnp.float32
     true_v = jnp.ones((n_nodes,), dtype=bool)  # identity-compared below
@@ -447,6 +466,39 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     cm_aff = arrs.class_affinity[_cid()] if cfg.enable_class_aff else true_v  # [N]
     cm_taint = arrs.class_taint[_cid()] if cfg.enable_class_taint else true_v
 
+    # ---- batched carry-column reads -----------------------------------
+    # Every selector-group column this pod reads — required (anti-)affinity
+    # terms, spread constraints, preferred terms — rides ONE gather of the
+    # group_count carry, and their per-domain aggregations share ONE
+    # matmul pair per topology key (previously each slot issued its own
+    # column gather + [N, D] mat-vec pair: the dependent-column chain the
+    # round-4 profile showed dominating the all-ops step). dc_all[:, q] is
+    # bit-identical to domain_count(gc[:, gid_q], key_q, ...): both sum the
+    # same exact-integer 0/1 increments in f32.
+    dc_all = nh_all = colsf = pd_stack = None
+    if gc is not None and gcr_seg is not None:
+        gidx = x["gcr_gid"]        # [Q] i32 selector-group column per slot
+        gkey = x["gcr_key"]        # [Q] i32 topology key per slot
+        cols = jnp.take(gc, jnp.maximum(gidx, 0), axis=1)        # [N, Q]
+        colsf = cols.astype(f32)
+        k1s = arrs.topo_onehot.shape[0]
+        pd_list = []
+        back = None
+        for kk in range(k1s):
+            ohk = arrs.topo_onehot[kk]                           # [N, D]
+            pdk = ohk.T @ colsf                                  # [D, Q]
+            pd_list.append(pdk)
+            bk = ohk @ pdk                                       # [N, Q]
+            if k1s == 1:
+                back = bk
+            else:
+                sel = (jnp.maximum(gkey - 1, 0) == kk).astype(f32)
+                back = bk * sel[None, :] if back is None else back + bk * sel[None, :]
+        dc_all = colsf if back is None else jnp.where(
+            (gkey == 0)[None, :], colsf, back)
+        nh_all = jnp.take(arrs.has_key, jnp.maximum(gkey, 0), axis=0) > 0  # [Q, N]
+        pd_stack = jnp.stack(pd_list) if pd_list else None       # [K1, D, Q]
+
     # ---- filter pipeline (ordered; see filter_op_table) ---------------
     ok_unsched = ~arrs.unschedulable if cfg.enable_unsched else true_v
     ok_aff = cm_aff
@@ -459,28 +511,44 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     # forces a copy. Full width it is; never-requested columns cost one
     # compare.
     fit = filters.fit_per_resource(state.headroom, x["req"])   # [N, R]
-    ok_pod_aff = (filters.pod_affinity_ok(
-        gc, arrs.topo_onehot, arrs.has_key,
-        x["aff_group"], x["aff_key"], x["aff_valid"], x["aff_self"],
-    ) if cfg.enable_pod_affinity else true_v)
+    # InterPodAffinity required terms off the batched domain sums
+    # (semantics: filters.pod_affinity_ok — every term needs a matching pod
+    # in the node's domain, with the first-pod self-match bootstrap)
+    ok_pod_aff = true_v
+    if cfg.enable_pod_affinity:
+        a0, a1 = gcr_seg["aff"]
+        if a1 > a0:
+            dc_a = dc_all[:, a0:a1]                              # [N, A]
+            totals = jnp.sum(colsf[:, a0:a1], axis=0)            # [A]
+            term_ok = nh_all[a0:a1].T & (
+                (dc_a > 0) | ((totals == 0) & x["aff_self"])[None, :])
+            ok_pod_aff = jnp.all(
+                jnp.where(x["aff_valid"][None, :], term_ok, True), axis=1)
     # term_block stays bf16: its only read is a nonnegative-counts > 0
     # test, which cannot false-positive in bf16
     if cfg.enable_anti_affinity:
         if cfg.slot_paint:
-            # reverse direction via per-hit-term column gathers (a pod
-            # hits only a few terms; the dense [N, T] matvec dominated
-            # the all-ops profile)
-            blocked = jnp.zeros((n_nodes,), dtype=bool)
-            for h in range(x["hit_tid"].shape[0]):
-                tid = x["hit_tid"][h]
-                colv = state.term_block[:, jnp.maximum(tid, 0)]
-                blocked |= (tid >= 0) & (colv > 0)
+            # reverse direction via ONE gather of the pod's hit-term
+            # columns (a pod hits only a few terms; the dense [N, T]
+            # matvec dominated the all-ops profile)
+            h_n = x["hit_tid"].shape[0]
+            if h_n:
+                tc = jnp.take(
+                    state.term_block, jnp.maximum(x["hit_tid"], 0), axis=1)
+                blocked = jnp.any(
+                    (x["hit_tid"] >= 0)[None, :] & (tc > 0), axis=1)
+            else:
+                blocked = jnp.zeros((n_nodes,), dtype=bool)
         else:
             blocked = filters.anti_blocked_dense(state.term_block, x["hit_terms"])
-        ok_pod_anti = filters.pod_anti_affinity_ok(
-            gc, arrs.topo_onehot, arrs.has_key,
-            x["anti_group"], x["anti_key"], x["anti_valid"], blocked,
-        )
+        b0, b1 = gcr_seg["anti"]
+        if b1 > b0:
+            dc_b = dc_all[:, b0:b1]                              # [N, B]
+            fwd_ok = jnp.all(
+                jnp.where(x["anti_valid"][None, :], dc_b == 0, True), axis=1)
+        else:
+            fwd_ok = true_v
+        ok_pod_anti = fwd_ok & ~blocked
     else:
         ok_pod_anti = true_v
 
@@ -495,7 +563,67 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     spread_raw = jnp.zeros((n_nodes,), f32)
     spread_node_ok = true_v
     any_soft = jnp.zeros((), dtype=bool)
-    if cfg.enable_spread:
+    if cfg.enable_spread and gc is not None:
+        # batched path: domain sums come from dc_all/pd_stack (identical
+        # integers to the dom_count carry, which goes unmaintained here);
+        # the per-constraint min reductions are batched into two kernels
+        big = jnp.float32(3.4e38)
+        ok_spread = true_v
+        s0, s1 = gcr_seg["spread"]
+        cs_n = s1 - s0
+        if cs_n:
+            skey = x["spread_key"]                           # [Cs]
+            dc_s = dc_all[:, s0:s1]                          # [N, Cs]
+            nh_s = nh_all[s0:s1]                             # [Cs, N]
+            if cfg.enable_spread_hard:
+                # minMatchNum over domains holding an eligible node
+                # (filtering.go), all constraints in one masked min each
+                k1sel = jnp.maximum(skey - 1, 0)             # [Cs]
+                if pd_stack is not None:
+                    pd_sel = pd_stack[k1sel, :, s0 + jnp.arange(cs_n)]  # [Cs, D]
+                    dhas_sel = hoisted.domain_has[_cid(), k1sel]        # [Cs, D]
+                    min_other = jnp.min(
+                        jnp.where(dhas_sel, pd_sel, big), axis=1)       # [Cs]
+                else:
+                    min_other = jnp.zeros((cs_n,), f32)
+                min_host = jnp.min(jnp.where(
+                    hoisted.elig_host[_cid()][:, None], colsf[:, s0:s1], big,
+                ), axis=0)                                   # [Cs]
+                min_val = jnp.where(skey == 0, min_host, min_other)
+                min_val = jnp.where(
+                    hoisted.any_elig[_cid(), skey], min_val, 0.0)
+                if cfg.slot_paint:
+                    m_gid = x["match_gid"]                   # [M]
+                    if m_gid.shape[0]:
+                        self_raw = jnp.any(
+                            (m_gid[:, None] >= 0)
+                            & (m_gid[:, None] == x["spread_group"][None, :]),
+                            axis=0)                          # [Cs]
+                    else:
+                        self_raw = jnp.zeros((cs_n,), dtype=bool)
+                else:
+                    self_raw = jnp.take(x["match_groups"], x["spread_group"])
+                self_m = self_raw & x["spread_valid"]
+                skew = dc_s + self_m[None, :].astype(f32) - min_val[None, :]
+                term_ok = nh_s.T & (skew <= x["spread_skew"][None, :])
+                applies = x["spread_valid"] & x["spread_hard"]
+                ok_spread = jnp.all(
+                    jnp.where(applies[None, :], term_ok, True), axis=1)
+            if cfg.enable_spread_soft:
+                # soft -> score pass 1 (topologyNormalizingWeight + the
+                # maxSkew-1 shift of scoreForCount, scoring.go:292); the
+                # accumulation stays a static per-constraint loop so f32
+                # sum order matches the pre-batching engine exactly
+                for c in range(cs_n):
+                    soft = x["spread_valid"][c] & ~x["spread_hard"][c]
+                    w = hoisted.log_dom[skey[c]]
+                    spread_raw += jnp.where(
+                        soft, dc_s[:, c] * w + (x["spread_skew"][c] - 1.0), 0.0)
+                    spread_node_ok &= ~soft | nh_s[c]
+                    any_soft |= soft
+    elif cfg.enable_spread:
+        # dom_count path (no [N, S] carry maintained): pure non-hostname
+        # spread reads the tiny [K1, D, S] per-domain table
         big = jnp.float32(3.4e38)
         ok_spread = true_v
         k1_static = arrs.topo_onehot.shape[0]
@@ -509,24 +637,14 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
             else:
                 dcol = state.dom_count[k1i, :, g]
                 oh = arrs.topo_onehot[k1i]
-            dc_nonhost = oh @ dcol                     # broadcast, no N-reduction
-            if gc is not None:
-                dc = jnp.where(kid == 0, gc[:, g].astype(f32), dc_nonhost)
-            else:
-                dc = dc_nonhost  # spread_hostname gate: no hostname terms
+            dc = oh @ dcol                     # broadcast, no N-reduction
             node_has = arrs.has_key[kid] > 0
             if cfg.enable_spread_hard:
                 # hard constraint (DoNotSchedule) -> filter; minMatchNum
                 # over domains holding an eligible node (filtering.go)
                 dhas = (hoisted.domain_has[_cid(), 0] if k1_static == 1
                         else hoisted.domain_has[_cid(), k1i])   # [D]
-                min_other = jnp.min(jnp.where(dhas, dcol, big))
-                if gc is not None:
-                    min_host = jnp.min(
-                        jnp.where(hoisted.elig_host[_cid()], gc[:, g].astype(f32), big))
-                    min_val = jnp.where(kid == 0, min_host, min_other)
-                else:
-                    min_val = min_other
+                min_val = jnp.min(jnp.where(dhas, dcol, big))
                 min_val = jnp.where(hoisted.any_elig[_cid(), kid], min_val, 0.0)
                 if cfg.slot_paint:
                     self_raw = jnp.zeros((), dtype=bool)
@@ -540,8 +658,6 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
                 applies = x["spread_valid"][c] & x["spread_hard"][c]
                 ok_spread &= jnp.where(applies, term_ok, True)
             if cfg.enable_spread_soft:
-                # soft constraint -> score pass 1 (topologyNormalizingWeight
-                # + the maxSkew-1 shift of scoreForCount, scoring.go:292)
                 soft = x["spread_valid"][c] & ~x["spread_hard"][c]
                 w = hoisted.log_dom[kid]
                 spread_raw += jnp.where(soft, dc * w + (x["spread_skew"][c] - 1.0), 0.0)

@@ -80,6 +80,8 @@ class ObjectMeta:
     annotations: Dict[str, str] = field(default_factory=dict)
     owner_kind: str = ""
     owner_name: str = ""
+    uid: str = ""
+    owner_uid: str = ""
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ObjectMeta":
@@ -93,6 +95,8 @@ class ObjectMeta:
             annotations=dict(d.get("annotations") or {}),
             owner_kind=owner.get("kind", ""),
             owner_name=owner.get("name", ""),
+            uid=d.get("uid", "") or "",
+            owner_uid=owner.get("uid", "") or "",
         )
 
 

@@ -99,10 +99,11 @@ def resource_scores_fused(
     reads |h_cpu - h_mem| directly (algebraically identical; float
     rounding differs at the ulp level, which only reorders ties that were
     already rounding-level). LeastAllocated's max(free, 0)*inv is
-    bit-identical to the used-form. Convention shift on pathological
-    nodes: where allocatable <= 0 the used-form scored the resource as 0%
-    utilized, the headroom form scores it 0% free — such nodes reject any
-    pod actually requesting the resource either way."""
+    bit-identical to the used-form. Pathological nodes (allocatable <= 0):
+    h is 0 there, which Least/Balanced read as 0% free (score 0 — matches
+    the reference), and Most would read as 100% used (full score); the
+    (inv_alloc > 0) mask keeps Most at 0 like mostRequestedScore's
+    capacity==0 early-out (most_allocated.go:49-51)."""
     ci, mi = cpu_mem_idx
     h_c = (headroom[:, ci] - req_p[ci]) * inv_alloc[:, ci]
     h_m = (headroom[:, mi] - req_p[mi]) * inv_alloc[:, mi]
@@ -114,8 +115,14 @@ def resource_scores_fused(
             (jnp.maximum(h_c, 0.0) + jnp.maximum(h_m, 0.0)) * (MAX_SCORE / 2.0)
         )
     if w_most:
+        # mostRequestedScore returns 0 when capacity == 0
+        # (most_allocated.go:49-51): h is 0 there (inv_alloc == 0), which
+        # would read as "fully used" = full score — mask those resources out
         out = out + w_most * (
-            (jnp.clip(1.0 - h_c, 0.0, 1.0) + jnp.clip(1.0 - h_m, 0.0, 1.0))
+            (
+                jnp.clip(1.0 - h_c, 0.0, 1.0) * (inv_alloc[:, ci] > 0)
+                + jnp.clip(1.0 - h_m, 0.0, 1.0) * (inv_alloc[:, mi] > 0)
+            )
             * (MAX_SCORE / 2.0)
         )
     return out

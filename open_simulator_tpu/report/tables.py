@@ -117,29 +117,43 @@ def report_pods(result: SimulateResult, app_only: bool = False) -> str:
 
 
 def report_gpu(result: SimulateResult) -> str:
-    """GPU device occupancy (--extended-resources gpu; apply.go reportGpu +
-    open-gpu-share NodeGpuInfo annotation export)."""
+    """GPU device occupancy (--extended-resources gpu; apply.go:399-446
+    GPU Node Resource table incl. the per-device "Pod List" column).
+
+    Occupancy comes from the engine's decoded integer allocations
+    (result.gpu_assignments, the gpu_pick truth) — the annotation string is
+    only a fallback for pods whose placement predates the decode (e.g. a
+    user-pinned gpu-index on an already-bound pod)."""
     rows = []
     for ns in result.node_status:
         cnt, per_mem = ns.node.gpu_info()
         if cnt == 0:
             continue
         dev_used = [0] * cnt
+        dev_pods: List[List[str]] = [[] for _ in range(cnt)]
         for p in ns.pods:
-            mem, n_dev = p.gpu_request()
-            idx = p.meta.annotations.get(ANNO_GPU_INDEX, "")
-            if mem and idx:
-                for tok in str(idx).split("-"):
-                    if tok.isdigit() and int(tok) < cnt:
-                        dev_used[int(tok)] += mem
+            mem, _n_dev = p.gpu_request()
+            if not mem:
+                continue
+            devices = result.gpu_assignments.get(p.key)
+            if devices is None:
+                idx = p.meta.annotations.get(ANNO_GPU_INDEX, "")
+                devices = [int(tok) for tok in str(idx).split("-") if tok.isdigit()]
+            for d in devices:
+                if 0 <= d < cnt:
+                    dev_used[d] += mem
+                    if p.key not in dev_pods[d]:
+                        dev_pods[d].append(p.key)
         for d in range(cnt):
             rows.append([
                 ns.node.name, f"gpu-{d}", str(per_mem), str(dev_used[d]),
-                _pct(dev_used[d], per_mem),
+                _pct(dev_used[d], per_mem), ", ".join(dev_pods[d]),
             ])
     if not rows:
         return ""
-    return format_table(["Node", "Device", "Mem Cap", "Mem Used", "Occupancy"], rows, "GPU")
+    return format_table(
+        ["Node", "Device", "Mem Cap", "Mem Used", "Occupancy", "Pod List"], rows, "GPU"
+    )
 
 
 def report_unscheduled(result: SimulateResult) -> str:

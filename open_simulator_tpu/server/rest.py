@@ -140,7 +140,7 @@ class SimulationServer:
                 raise ValueError(f"workload {kind} {ns}/{name} not found in cluster snapshot")
             # remove pods owned by the workload (re-rollout), then re-add it
             # with the requested replica count as an app to schedule
-            self._remove_owned_pods(cluster, kind, ns, name)
+            self._remove_owned_pods(cluster, workload, kind, ns, name)
             if replicas is not None:
                 workload.replicas = int(replicas)
             app_res = ClusterResources()
@@ -182,20 +182,39 @@ class SimulationServer:
         return None
 
     @staticmethod
-    def _remove_owned_pods(cluster: ClusterResources, kind: str, ns: str, name: str) -> None:
-        """Reference walks ReplicaSet ownership for Deployments
-        (removePodsOfApp, server.go:404-444); our expansion stamps direct
-        owner metadata, so matching (kind|via-RS, name) covers both."""
+    def _remove_owned_pods(cluster: ClusterResources, workload, kind: str, ns: str, name: str) -> None:
+        """Reference walks actual ReplicaSet ownership for Deployments
+        (removePodsOfApp, server.go:404-444): it lists the ReplicaSets
+        controlled by the Deployment, then removes the pods controlled by
+        those ReplicaSets — never by name prefix (Deployment ``web`` must
+        not touch ``web-frontend``'s pods)."""
+        wl_uid = getattr(workload.meta, "uid", "") if workload is not None else ""
+
+        def controlled_by_workload(m) -> bool:
+            if m.namespace != ns:
+                return False
+            if wl_uid and m.owner_uid:
+                return m.owner_uid == wl_uid
+            return m.owner_kind == kind and m.owner_name == name
+
+        rs_names = set()
+        rs_uids = set()
+        if kind == "Deployment":
+            for rs in cluster.replica_sets:
+                if controlled_by_workload(rs.meta):
+                    rs_names.add(rs.meta.name)
+                    if rs.meta.uid:
+                        rs_uids.add(rs.meta.uid)
+
         def owned(p) -> bool:
             if p.meta.namespace != ns:
                 return False
-            if p.meta.owner_kind == kind and p.meta.owner_name == name:
+            if controlled_by_workload(p.meta):
                 return True
-            # Deployment -> ReplicaSet -> Pod chains: RS names are prefixed
-            return (
-                kind == "Deployment"
-                and p.meta.owner_kind == "ReplicaSet"
-                and p.meta.owner_name.startswith(name + "-")
+            # Deployment -> ReplicaSet -> Pod: only via an RS object that is
+            # itself controlled by this Deployment (exact identity, no prefix)
+            return p.meta.owner_kind == "ReplicaSet" and (
+                p.meta.owner_name in rs_names or (p.meta.owner_uid and p.meta.owner_uid in rs_uids)
             )
 
         cluster.pods = [p for p in cluster.pods if not owned(p)]

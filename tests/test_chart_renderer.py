@@ -539,3 +539,61 @@ def test_semver_masterminds_edge_semantics():
     assert not _semver_compare("~1", "2.0.0")
     assert _semver_compare("~1.2", "1.2.9")
     assert not _semver_compare("~1.2", "1.3.0")
+
+
+def test_semver_dirty_and_prerelease_rules():
+    """ADVICE r4 #3: partial constraints are wildcards, not zero-padded,
+    and prerelease versions only match prerelease-aware clauses
+    (constraints.go:284-545)."""
+    from open_simulator_tpu.chart.renderer import _semver_compare
+
+    # '=' with a partial operand opts into tilde ('=1.2' matches 1.2.5)
+    assert _semver_compare("=1.2", "1.2.5")
+    assert _semver_compare("1.2", "1.2.5")
+    assert not _semver_compare("=1.2", "1.3.0")
+    assert _semver_compare("=1", "1.9.2")
+    # '>' with a dirty minor requires the NEXT major (>11 does not match 11.1.0)
+    assert not _semver_compare(">11", "11.1.0")
+    assert _semver_compare(">11", "12.0.0")
+    # '>' with a dirty patch requires a minor bump
+    assert not _semver_compare(">11.1", "11.1.5")
+    assert _semver_compare(">11.1", "11.2.0")
+    # prerelease versions fail release-only clauses (the '-0' idiom)
+    assert not _semver_compare(">=1.19", "1.19.3-gke.100")
+    assert _semver_compare(">=1.19-0", "1.19.3-gke.100")
+    assert not _semver_compare("*", "1.2.3-alpha")
+    assert _semver_compare("*", "1.2.3")
+    # prerelease precedence: numeric < alphanumeric, release > prerelease
+    assert _semver_compare(">1.0.0-alpha", "1.0.0-beta")
+    assert not _semver_compare(">1.0.0-beta", "1.0.0-alpha")
+    assert _semver_compare(">=1.0.0-0", "1.0.0")
+    # '<=' with dirty minor spans the major (<=11 matches 11.5.0)
+    assert _semver_compare("<=11", "11.5.0")
+    assert not _semver_compare("<=11", "12.0.0")
+    # '!=' with partial operand compares the specified parts only
+    assert not _semver_compare("!=1.2", "1.2.9")
+    assert _semver_compare("!=1.2", "1.3.0")
+
+
+def test_sprig_div_mod_title_go_semantics(tmp_path):
+    """ADVICE r4 #4: Go integer division truncates toward zero and
+    strings.Title only upcases word-initial letters."""
+    cm = textwrap.dedent("""\
+        apiVersion: v1
+        kind: ConfigMap
+        metadata:
+          name: arith
+          annotations:
+            divneg: {{ div -7 2 | quote }}
+            modneg: {{ mod -7 2 | quote }}
+            divpos: {{ div 7 2 | quote }}
+            modpos: {{ mod 7 2 | quote }}
+            title: {{ title "FOO bar" | quote }}
+    """)
+    docs = process_chart(write_chart(tmp_path, "", {"cm.yaml": cm}), release_name="r")
+    ann = docs[0]["metadata"]["annotations"]
+    assert ann["divneg"] == "-3"   # sprig: trunc toward zero, not floor -4
+    assert ann["modneg"] == "-1"   # dividend's sign, not Python's 1
+    assert ann["divpos"] == "3"
+    assert ann["modpos"] == "1"
+    assert ann["title"] == "FOO Bar"

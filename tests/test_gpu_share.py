@@ -111,3 +111,29 @@ def test_gpu_report():
     table = report_gpu(res)
     assert "gpu-0" in table and "gpu-1" in table
     assert "50.0%" in table  # 8/16 on the packed device
+    # the reference's per-device "Pod List" column (apply.go:405,435)
+    assert "Pod List" in table
+    assert "default/p0" in table
+
+
+def test_gpu_report_reads_decoded_picks_not_annotations():
+    """Occupancy comes from result.gpu_assignments (decoded gpu_pick ints),
+    not a re-parse of the annotation string the decode itself wrote."""
+    res = run([gpu_node("g0", gpus=2, mem_per_gpu=16)], [gpu_pod("p0", mem=8)])
+    assert res.gpu_assignments == {"default/p0": [0]}
+    # corrupt the annotation; the table must still show the true occupancy
+    sp = res.scheduled_pods[0]
+    sp.pod.meta.annotations[ANNO_GPU_INDEX] = "banana"
+    from open_simulator_tpu.report.tables import report_gpu
+
+    table = report_gpu(res)
+    assert "50.0%" in table and "default/p0" in table
+
+
+def test_gpu_assignments_multiplicity():
+    res = run(
+        [gpu_node("g0", gpus=4, mem_per_gpu=16)],
+        [gpu_pod("dist", mem=8, count=3)],
+    )
+    # two slots on dev 0 + one on dev 1, same order as the annotation "0-0-1"
+    assert res.gpu_assignments == {"default/dist": [0, 0, 1]}

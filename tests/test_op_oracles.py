@@ -253,15 +253,17 @@ def test_resource_scores_fused_matches_component_ops(seed):
             + wm * np.asarray(scores.most_allocated_score(
                 jnp.asarray(used), jnp.asarray(alloc), jnp.asarray(req), (0, 1)))
         )
-        # row 0 has a zero-capacity cpu: the component ops score it "0%
-        # utilized", the headroom form "0% free" (documented divergence on
-        # pathological nodes) — compare the healthy rows to the oracle and
-        # row 0 to the headroom-form expectation
+        # row 0 has a zero-capacity cpu: Least (h=0 -> 0 free) and Most
+        # (masked to 0 by inv_alloc > 0, like mostRequestedScore's
+        # capacity==0 early-out) agree with the component ops; only
+        # Balanced diverges there (component reads 0% utilized, headroom
+        # form 0% free) — compare healthy rows to the oracle and row 0 to
+        # the headroom-form expectation
         np.testing.assert_allclose(got[1:], want[1:], rtol=1e-4, atol=1e-3)
         h_m0 = (alloc[0, 1] - used[0, 1] - req[1]) * inv[0, 1]
         want0 = (
             wb * (1.0 - abs(0.0 - h_m0) * 0.5) * 100.0
             + wl * (max(h_m0, 0.0) * 50.0)
-            + wm * ((min(max(1.0 - 0.0, 0.0), 1.0) + min(max(1.0 - h_m0, 0.0), 1.0)) * 50.0)
+            + wm * ((0.0 + min(max(1.0 - h_m0, 0.0), 1.0)) * 50.0)
         )
         np.testing.assert_allclose(got[0], want0, rtol=1e-4, atol=1e-3)

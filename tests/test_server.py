@@ -115,6 +115,100 @@ def test_scale_apps(server_url):
     assert not out["unscheduled_pods"]
 
 
+def test_scale_apps_prefix_sharing_names_not_over_removed():
+    """Deployment `web` must not remove Deployment `web-frontend`'s pods:
+    ownership is walked through actual ReplicaSet identity (server.go:404-444),
+    never a name-prefix heuristic (RS `web-frontend-<hash>` starts with `web-`)."""
+    from open_simulator_tpu.k8s.loader import ClusterResources, demux_object, parse_yaml_documents
+
+    cluster_yaml = textwrap.dedent("""
+        apiVersion: apps/v1
+        kind: Deployment
+        metadata: {name: web, namespace: default, uid: d-web}
+        spec:
+          replicas: 1
+          template:
+            metadata: {labels: {app: web}}
+            spec:
+              containers: [{name: c, resources: {requests: {cpu: 100m}}}]
+        ---
+        apiVersion: apps/v1
+        kind: Deployment
+        metadata: {name: web-frontend, namespace: default, uid: d-webfe}
+        spec:
+          replicas: 1
+          template:
+            metadata: {labels: {app: webfe}}
+            spec:
+              containers: [{name: c, resources: {requests: {cpu: 100m}}}]
+        ---
+        apiVersion: apps/v1
+        kind: ReplicaSet
+        metadata:
+          name: web-6d4f8
+          namespace: default
+          uid: rs-web
+          ownerReferences: [{kind: Deployment, name: web, uid: d-web}]
+        spec:
+          replicas: 1
+          template:
+            metadata: {labels: {app: web}}
+            spec:
+              containers: [{name: c, resources: {requests: {cpu: 100m}}}]
+        ---
+        apiVersion: apps/v1
+        kind: ReplicaSet
+        metadata:
+          name: web-frontend-abc12
+          namespace: default
+          uid: rs-webfe
+          ownerReferences: [{kind: Deployment, name: web-frontend, uid: d-webfe}]
+        spec:
+          replicas: 1
+          template:
+            metadata: {labels: {app: webfe}}
+            spec:
+              containers: [{name: c, resources: {requests: {cpu: 100m}}}]
+        ---
+        apiVersion: v1
+        kind: Pod
+        metadata:
+          name: web-6d4f8-x1
+          namespace: default
+          ownerReferences: [{kind: ReplicaSet, name: web-6d4f8, uid: rs-web}]
+        spec:
+          containers: [{name: c, resources: {requests: {cpu: 100m}}}]
+        ---
+        apiVersion: v1
+        kind: Pod
+        metadata:
+          name: web-frontend-abc12-y1
+          namespace: default
+          ownerReferences: [{kind: ReplicaSet, name: web-frontend-abc12, uid: rs-webfe}]
+        spec:
+          containers: [{name: c, resources: {requests: {cpu: 100m}}}]
+        ---
+        apiVersion: v1
+        kind: Pod
+        metadata:
+          name: web-0
+          namespace: default
+          ownerReferences: [{kind: Deployment, name: web}]
+        spec:
+          containers: [{name: c, resources: {requests: {cpu: 100m}}}]
+    """)
+    cluster = ClusterResources()
+    for doc in parse_yaml_documents(cluster_yaml):
+        demux_object(doc, cluster)
+
+    workload = SimulationServer._pop_workload(cluster, "Deployment", "default", "web")
+    assert workload is not None
+    SimulationServer._remove_owned_pods(cluster, workload, "Deployment", "default", "web")
+    remaining = sorted(p.meta.name for p in cluster.pods)
+    # web's RS pod and direct-owned pod removed; web-frontend's pod kept
+    assert remaining == ["web-frontend-abc12-y1"]
+
+
 def test_scale_unknown_workload_400(server_url):
     with pytest.raises(urllib.error.HTTPError) as ei:
         _post(server_url + "/api/scale-apps", {

@@ -370,6 +370,24 @@ def test_attachable_volume_limits():
     assert not res2.unscheduled_pods
 
 
+def test_same_claim_mounted_twice_by_one_pod_attaches_once():
+    """A pod mounting one PVC through two volume entries is ONE attachment
+    (vendored limits count unique volume names, csi.go; ADVICE r4 #2 —
+    pinned by the per-pod claim dedup in analyze_volumes)."""
+    limited = make_node(
+        "n0", labels={"kubernetes.io/hostname": "n0"},
+        extra_alloc={"attachable-volumes-csi-ebs.csi.aws.com": 1})
+    pvcs_ = [pvc("c0", volume_name="ebs-0")]
+    pvs_ = [csi_pv("ebs-0", "c0")]
+    p = claim_pod("p0", ["c0", "c0"])  # two mounts, one claim
+    res = run([limited], [p], pvcs=pvcs_, pvs=pvs_)
+    assert not res.unscheduled_pods  # would fail at the limit if counted twice
+
+    # and a second pod sharing the claim still fits (unique per node)
+    res2 = run([limited], [p, claim_pod("p1", ["c0"])], pvcs=pvcs_, pvs=pvs_)
+    assert not res2.unscheduled_pods
+
+
 def test_dynamic_provision_counts_against_csi_limit():
     """WFC dynamic-provision claims count against the provisioner's CSI
     limit key."""
