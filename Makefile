@@ -1,0 +1,14 @@
+# Developer entry points. `make smoke` is the documented pre-PR check:
+# the tier-1 verify command from ROADMAP.md plus one chaos scenario
+# end to end (tools/smoke.sh).
+
+.PHONY: test smoke bench
+
+test:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+
+smoke:
+	bash tools/smoke.sh
+
+bench:
+	python bench.py

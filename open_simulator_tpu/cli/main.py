@@ -12,6 +12,21 @@ import os
 import sys
 
 from open_simulator_tpu import __version__
+from open_simulator_tpu.errors import SimulationError
+
+
+class _FaultAction(argparse.Action):
+    """Append (kind, target) pairs to one shared `events` list, preserving
+    command-line order across the three chaos flag types."""
+
+    def __init__(self, option_strings, dest, fault_kind=None, **kw):
+        self.fault_kind = fault_kind
+        super().__init__(option_strings, dest, **kw)
+
+    def __call__(self, parser, namespace, value, option_string=None):
+        events = getattr(namespace, self.dest, None) or []
+        events.append((self.fault_kind, value))
+        setattr(namespace, self.dest, events)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -46,6 +61,31 @@ def build_parser() -> argparse.ArgumentParser:
              "cluster access in this environment)")
     sp.add_argument("--master", default="", help="(unsupported here: no live cluster access)")
     sp.add_argument("--cluster-config", default="", help="cluster YAML dir serving as the live-cluster stand-in")
+    sp.add_argument("--max-body-mib", type=int, default=8,
+                    help="reject request bodies above this size with 413")
+    sp.add_argument("--request-timeout", type=float, default=300.0,
+                    help="per-request simulation deadline in seconds (504 past it)")
+
+    ch = sub.add_parser(
+        "chaos",
+        help="fault-injection re-simulation: kill nodes/zones and report the disruption")
+    ch.add_argument("--cluster-config", required=True, help="cluster YAML dir")
+    # one shared ordered list: faults are cumulative, so
+    # `--kill-zone z0 --drain-node n5` must run in command-line order
+    ch.add_argument("--kill-node", action=_FaultAction, fault_kind="kill_node",
+                    default=[], dest="events", metavar="NAME",
+                    help="fail this node (repeatable; events run in "
+                         "command-line order)")
+    ch.add_argument("--kill-zone", action=_FaultAction, fault_kind="kill_zone",
+                    dest="events", metavar="ZONE",
+                    help="fail every node in this zone (repeatable)")
+    ch.add_argument("--drain-node", action=_FaultAction, fault_kind="drain_node",
+                    dest="events", metavar="NAME",
+                    help="drain this node (repeatable)")
+    ch.add_argument("--zone-key", default="topology.kubernetes.io/zone",
+                    help="node label key that defines zones")
+    ch.add_argument("--json", action="store_true", help="emit the report as JSON")
+    ch.add_argument("--output-file", default="")
 
     mg = sub.add_parser("migrate", help="plan a defragmentation migration of placed pods")
     mg.add_argument("--cluster-config", required=True, help="cluster YAML dir (with placed pods)")
@@ -91,8 +131,32 @@ def main(argv=None) -> int:
         try:
             return Applier(opts).run()
         except Exception as e:  # surface config errors as exit-code-1 messages
+            # (a SimulationError formats itself as "[CODE] ref.field: ...")
             print(f"error: {e}", file=sys.stderr)
             return 1
+
+    if args.command == "chaos":
+        from open_simulator_tpu.k8s.loader import load_resources_from_directory
+        from open_simulator_tpu.resilience.chaos import ChaosPlan, FaultEvent, run_chaos
+
+        events = [FaultEvent(kind, target) for kind, target in args.events]
+        plan = ChaosPlan(events=events, zone_key=args.zone_key)
+        try:
+            cluster = load_resources_from_directory(args.cluster_config)
+            report = run_chaos(cluster, plan)
+        except SimulationError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        import json as _json
+
+        text = (_json.dumps(report.to_dict(), indent=2) if args.json
+                else report.format())
+        if args.output_file:
+            with open(args.output_file, "w", encoding="utf-8") as f:
+                f.write(text + "\n")
+        else:
+            print(text)
+        return 0
 
     if args.command == "migrate":
         from open_simulator_tpu.apply.migrate import plan_migration, report_migration
@@ -120,6 +184,8 @@ def main(argv=None) -> int:
             port=args.port,
             cluster_config=args.cluster_config,
             kubeconfig=args.kubeconfig,
+            max_body_bytes=args.max_body_mib * 1024 * 1024,
+            request_timeout_s=args.request_timeout,
         )
 
     if args.command == "gen-doc":

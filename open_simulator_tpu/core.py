@@ -249,17 +249,26 @@ def simulate(
     encode_options: Optional[EncodeOptions] = None,
     config_overrides: Optional[Dict] = None,
     preemption: bool = True,
+    validate: bool = True,
 ) -> SimulateResult:
     """Run one full simulation on the default device (TPU when present).
 
     preemption=True enables the DefaultPreemption PostFilter pass (a no-op
     unless some pod carries a nonzero priority, so the default costs nothing
-    on priority-free clusters — the reference's own fixtures are such)."""
+    on priority-free clusters — the reference's own fixtures are such).
+
+    validate=True runs the resilience admission pass first, so malformed
+    specs raise a structured SimulationError taxonomy (code + object ref +
+    hint) instead of a traceback from deep inside encode."""
     t0 = time.perf_counter()
     config_overrides = dict(config_overrides or {})
     preemption = preemption and not config_overrides.pop("_disable_preemption", False)
     nodes = [make_valid_node(n) for n in cluster.nodes]
     cluster = _with_nodes(cluster, nodes)
+    if validate:
+        from open_simulator_tpu.resilience.admission import admit
+
+        admit(cluster, apps)
     pods = build_pod_sequence(cluster, apps, use_greed=use_greed)
     encode_options = with_volume_objects(encode_options, cluster, apps)
     snapshot = encode_cluster(nodes, pods, encode_options)

@@ -175,7 +175,6 @@ class SnapshotArrays:
     pref_tid: np.ndarray       # [P, Ap] i32 registry id of each preferred term
     pref_term_key: np.ndarray  # [T2] i32 topo key per preferred term
     hit_pref: np.ndarray       # [P, T2] pod matches preferred term t2's selector
-    hit_ptid: np.ndarray       # [P, Hp<=9] i32 set-bit slots of hit_pref
     gpu_mem: np.ndarray        # [P] f32 per-device gpu memory request
     gpu_cnt: np.ndarray        # [P] f32 number of devices wanted
     gpu_forced: np.ndarray     # [P, G] i32 pre-pinned device multiplicities (gpu-index anno)
@@ -495,7 +494,6 @@ def encode_cluster(
     hit_pref_terms = np.zeros((len(pods), T2), dtype=bool)
     for (gid, kid), tid in pref_term_vocab.index.items():
         hit_pref_terms[:, tid] = match_groups[:, gid]
-    hit_ptid = slot_indices(hit_pref_terms)
 
     # ---- compat classes ------------------------------------------------
     class_vocab = _Vocab()
@@ -782,7 +780,6 @@ def encode_cluster(
         pref_tid=pref_tid.astype(np.int32),
         pref_term_key=pref_term_key_arr.astype(np.int32),
         hit_pref=hit_pref_terms,
-        hit_ptid=hit_ptid,
         gpu_mem=gpu_mem,
         gpu_cnt=gpu_cnt,
         gpu_forced=gpu_forced,

@@ -123,12 +123,6 @@ class EngineConfig(NamedTuple):
     # values are bit-identical to the dense forms (each column is touched
     # at most once per pod, so the adds are the same adds).
     slot_paint: bool = False
-    # Existing-pods preference score via per-hit-term column gathers of the
-    # pref_paint carry instead of the dense [N, T2] mat-vec per step (a pod
-    # hits only a few preferred terms). make_config enables it when every
-    # pod fits the slot cap; values are identical (paint entries are
-    # integer-valued weight sums, so any summation order is exact).
-    pref_hit_slots: bool = False
     # Out-of-tree extension ops (engine/extensions.py ExtensionOp tuples) —
     # the WithFrameworkOutOfTreeRegistry analog
     # (pkg/simulator/simulator.go:188-195). Filter extensions append reason
@@ -306,7 +300,7 @@ def _apply_prefix_chunk(arrs: SnapshotArrays, cfg: EngineConfig,
     if cfg.needs_group_count:
         gc = gc + jnp.matmul(oh.T, match, precision=hp).astype(gc.dtype)
     dom = state.dom_count
-    if cfg.enable_spread:
+    if cfg.maintain_dom_count:
         # dom_row per pod = topo_onehot[:, idx_i, :]  -> [K1, c, D]
         topo_sel = jnp.take(arrs.topo_onehot, idx, axis=1)
         dom = dom + jnp.einsum("akd,ks->ads", topo_sel, match, precision=hp)
@@ -364,7 +358,6 @@ def _pod_xs(arrs: SnapshotArrays) -> Dict[str, jnp.ndarray]:
         "own_terms", "hit_terms",
         "spread_group", "spread_key", "spread_skew", "spread_hard", "spread_valid",
         "pref_group", "pref_key", "pref_weight", "pref_valid", "pref_tid", "hit_pref",
-        "hit_ptid",
         "gpu_mem", "gpu_cnt", "gpu_forced", "gpu_has_forced",
         "lvm_req", "sdev_req", "sdev_req_ssd",
         "vol_cid", "vol_pv_missing", "wfc_ccid", "wfc_valid", "vol_limit_req",
@@ -421,8 +414,7 @@ def _live_xs_names(cfg: EngineConfig, has_disabled: bool,
                  "spread_valid"}
     if cfg.enable_pref:
         live |= {"pref_group", "pref_key", "pref_weight", "pref_valid",
-                 "pref_tid"}
-        live.add("hit_ptid" if cfg.pref_hit_slots else "hit_pref")
+                 "pref_tid", "hit_pref"}
     if cfg.enable_gpu:
         live |= {"gpu_mem", "gpu_cnt", "gpu_forced", "gpu_has_forced"}
     if cfg.enable_storage:
@@ -438,6 +430,21 @@ def _live_xs_names(cfg: EngineConfig, has_disabled: bool,
     return live
 
 
+def _gcr_segments(cfg: EngineConfig, arrs: SnapshotArrays) -> "dict | None":
+    """Static column segments of the batched carry-column gather the step
+    performs over the concatenated [aff | anti | spread] slot axis; None
+    when no live op consumes it (the gcr blocks in _step then compile
+    out and the gcr xs leaves are never built)."""
+    if not cfg.needs_group_count:
+        return None  # no group_count carry -> nothing to gather from
+    if not (cfg.enable_pod_affinity or cfg.enable_anti_affinity
+            or cfg.enable_spread):
+        return None
+    a_w = arrs.aff_group.shape[1]
+    b_w = arrs.anti_group.shape[1]
+    s_w = arrs.spread_group.shape[1]
+    return {"aff": (0, a_w), "anti": (a_w, a_w + b_w),
+            "spread": (a_w + b_w, a_w + b_w + s_w)}
 
 
 def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
@@ -465,6 +472,13 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
 
     cm_aff = arrs.class_affinity[_cid()] if cfg.enable_class_aff else true_v  # [N]
     cm_taint = arrs.class_taint[_cid()] if cfg.enable_class_taint else true_v
+
+    def _seg(name):
+        if gcr_seg is None:  # not assert: must survive python -O
+            raise AssertionError(
+                f"gcr_seg[{name!r}] read but no gcr plan was built — "
+                "_gcr_segments and _step disagree on the batched-read gates")
+        return gcr_seg[name]
 
     # ---- batched carry-column reads -----------------------------------
     # Every selector-group column this pod reads — required (anti-)affinity
@@ -516,7 +530,7 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     # in the node's domain, with the first-pod self-match bootstrap)
     ok_pod_aff = true_v
     if cfg.enable_pod_affinity:
-        a0, a1 = gcr_seg["aff"]
+        a0, a1 = _seg("aff")
         if a1 > a0:
             dc_a = dc_all[:, a0:a1]                              # [N, A]
             totals = jnp.sum(colsf[:, a0:a1], axis=0)            # [A]
@@ -541,7 +555,7 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
                 blocked = jnp.zeros((n_nodes,), dtype=bool)
         else:
             blocked = filters.anti_blocked_dense(state.term_block, x["hit_terms"])
-        b0, b1 = gcr_seg["anti"]
+        b0, b1 = _seg("anti")
         if b1 > b0:
             dc_b = dc_all[:, b0:b1]                              # [N, B]
             fwd_ok = jnp.all(
@@ -569,7 +583,7 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         # the per-constraint min reductions are batched into two kernels
         big = jnp.float32(3.4e38)
         ok_spread = true_v
-        s0, s1 = gcr_seg["spread"]
+        s0, s1 = _seg("spread")
         cs_n = s1 - s0
         if cs_n:
             skey = x["spread_key"]                           # [Cs]
@@ -943,10 +957,12 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
             ).astype(cdt)
     else:
         group_count = state.group_count  # untouched -> loop-invariant, no copy
-    if cfg.enable_spread:
+    if cfg.maintain_dom_count:
         # per-domain mirror of the group_count increment: the bound node's
         # [K1, D] domain rows (a gather, not a reduction) outer the match
-        # vector — K1*D*S adds on a table that stays tiny
+        # vector — K1*D*S adds on a table that stays tiny. Skipped when the
+        # spread ops read batched gc-derived domain sums instead (identical
+        # integers) and no extension can observe the carry.
         dom_row = arrs.topo_onehot[:, safe_node, :] * bound.astype(f32)  # [K1, D]
         if cfg.slot_paint:
             dom_count = state.dom_count
@@ -1126,7 +1142,21 @@ def schedule_pods(
     inv_alloc = jnp.where(arrs.alloc > 0, 1.0 / jnp.where(arrs.alloc > 0, arrs.alloc, 1.0), 0.0)
     if live is not None:
         xs = {k: v for k, v in xs.items() if k in live}
-    step = functools.partial(_step, scan_arrs, active, cfg, hoisted, inv_alloc)
+    gcr_seg = _gcr_segments(cfg, scan_arrs)
+    if gcr_seg is not None:
+        # concatenated per-pod slot columns for the batched carry-column
+        # read: [aff | anti | spread] selector-group ids + topology keys,
+        # one gather + one matmul pair per key per step (see _step)
+        xs["gcr_gid"] = jnp.concatenate(
+            [jnp.asarray(scan_arrs.aff_group, jnp.int32),
+             jnp.asarray(scan_arrs.anti_group, jnp.int32),
+             jnp.asarray(scan_arrs.spread_group, jnp.int32)], axis=1)
+        xs["gcr_key"] = jnp.concatenate(
+            [jnp.asarray(scan_arrs.aff_key, jnp.int32),
+             jnp.asarray(scan_arrs.anti_key, jnp.int32),
+             jnp.asarray(scan_arrs.spread_key, jnp.int32)], axis=1)
+    step = functools.partial(_step, scan_arrs, active, cfg, hoisted, inv_alloc,
+                             gcr_seg)
     final_state, (nodes, fail_counts, feasible, gpu_pick, vol_pick) = jax.lax.scan(
         step, state, xs, unroll=cfg.scan_unroll
     )

@@ -1,0 +1,88 @@
+"""Structured simulation error taxonomy (resilience layer, part 1).
+
+Every user-facing failure carries a machine-readable code, a reference to
+the offending object, the field path inside it, and a remediation hint —
+so the Simulator API, the CLI, and the REST server can all surface
+actionable diagnostics instead of deep encode/XLA tracebacks.
+
+This module is dependency-free on purpose: low-level parsers
+(k8s/quantity.py) raise these errors, and the resilience package
+re-exports them, without creating an import cycle.
+
+Codes (the taxonomy table lives in ARCHITECTURE.md "Resilience layer"):
+
+  E_QUANTITY           malformed resource quantity ("2x", "-1Gi", ...)
+  E_TOPOLOGY_KEY       empty / unknown topology key in an affinity or
+                       spread term
+  E_SELECTOR_CONFLICT  workload selector does not match its pod template
+                       labels (nothing the workload creates would ever
+                       match its own selector)
+  E_VOCAB_OVERFLOW     per-pod constraint slots or encoded vocabulary
+                       exceed the engine's admission caps
+  E_SPEC               other malformed spec (missing name, bad replicas,
+                       duplicate node, ...)
+  E_NO_NODES           cluster has zero nodes to encode
+  E_WORKLOAD_NOT_FOUND scale target absent from the cluster snapshot
+  E_PAYLOAD_TOO_LARGE  REST request body exceeds the configured cap
+  E_TIMEOUT            simulation exceeded the per-request deadline
+  E_BUSY               single-flight lock held by another simulation
+  E_BAD_REQUEST        unparsable request body
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class SimulationError(Exception):
+    """A structured, user-actionable simulation failure."""
+
+    code = "E_SPEC"
+
+    def __init__(self, message: str, code: Optional[str] = None,
+                 ref: str = "", field: str = "", hint: str = ""):
+        super().__init__(message)
+        self.message = message
+        if code is not None:
+            self.code = code
+        self.ref = ref        # e.g. "node/n0", "pod/default/web-0"
+        self.field = field    # e.g. "status.allocatable.cpu"
+        self.hint = hint
+
+    def __str__(self) -> str:
+        loc = self.ref + ("." + self.field if self.ref and self.field
+                          else self.field)
+        out = f"[{self.code}] " + (f"{loc}: " if loc else "") + self.message
+        if self.hint:
+            out += f" (hint: {self.hint})"
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"code": self.code, "ref": self.ref, "field": self.field,
+                "message": self.message, "hint": self.hint}
+
+
+class QuantityError(SimulationError, ValueError):
+    """Malformed k8s resource quantity. Subclasses ValueError so existing
+    `except ValueError` call sites keep working."""
+
+    code = "E_QUANTITY"
+
+
+class AdmissionError(SimulationError):
+    """Aggregate of every admission failure found in one validation pass."""
+
+    def __init__(self, errors: List[SimulationError]):
+        self.errors = list(errors)
+        first = self.errors[0] if self.errors else None
+        msg = (f"{len(self.errors)} admission error(s); first: {first}"
+               if first else "admission failed")
+        super().__init__(msg, code=first.code if first else "E_SPEC",
+                         ref=first.ref if first else "",
+                         field=first.field if first else "",
+                         hint=first.hint if first else "")
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = super().to_dict()
+        out["errors"] = [e.to_dict() for e in self.errors]
+        return out

@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Optional
 
 import yaml
 
+from open_simulator_tpu.errors import SimulationError
 from open_simulator_tpu.k8s import objects as k8s
 from open_simulator_tpu.k8s.objects import (
     ANNO_NODE_LOCAL_STORAGE,
@@ -168,8 +169,11 @@ def _match_node_local_storage(directory: str, res: ClusterResources) -> None:
             node.meta.annotations[ANNO_NODE_LOCAL_STORAGE] = json_by_name[node.name]
 
 
-class PodValidationError(ValueError):
-    pass
+class PodValidationError(SimulationError, ValueError):
+    """Spec-invariant violation caught at admission. Subclasses ValueError
+    so pre-taxonomy `except ValueError` call sites keep working."""
+
+    code = "E_SPEC"
 
 
 def make_valid_pod(pod: k8s.Pod) -> k8s.Pod:

@@ -47,14 +47,22 @@ MAX_PODS_DEFAULT = 110
 
 def parse_resource_list(d: Optional[Dict[str, Any]]) -> ResourceList:
     """Parse a k8s resources map into canonical integer units."""
+    from open_simulator_tpu.errors import QuantityError
+
     out: ResourceList = {}
     for name, qty in (d or {}).items():
-        if name == "cpu":
-            out[name] = cpu_to_milli(qty)
-        elif name in _MEM_LIKE:
-            out[name] = mem_to_mib(qty)
-        else:
-            out[name] = count_value(qty)
+        try:
+            if name == "cpu":
+                out[name] = cpu_to_milli(qty)
+            elif name in _MEM_LIKE:
+                out[name] = mem_to_mib(qty)
+            else:
+                out[name] = count_value(qty)
+        except QuantityError as e:
+            # attach the resource name so the error names its field even
+            # when raised deep inside a from_dict chain
+            raise QuantityError(e.message, field=e.field or name,
+                                ref=e.ref, hint=e.hint) from None
     return out
 
 

@@ -19,6 +19,8 @@ import math
 import re
 from fractions import Fraction
 
+from open_simulator_tpu.errors import QuantityError
+
 _BIN_SUFFIX = {
     "Ki": 1024,
     "Mi": 1024**2,
@@ -54,9 +56,19 @@ def parse_quantity(value) -> Fraction:
         try:
             return Fraction(float(s)).limit_denominator(10**9)
         except ValueError:
-            raise ValueError(f"invalid quantity: {value!r}") from None
+            raise QuantityError(
+                f"invalid quantity: {value!r}",
+                hint="use a k8s resource.Quantity like '1500m', '2Gi', "
+                     "'100M' or a plain number") from None
     digits, suffix = m.groups()
-    base = Fraction(digits) if "." not in digits else Fraction(digits)
+    try:
+        base = Fraction(digits)
+    except ValueError:
+        # the [0-9.]+ digit class admits multi-dot strings like "1.2.3"
+        raise QuantityError(
+            f"invalid quantity: {value!r}",
+            hint="use a k8s resource.Quantity like '1500m', '2Gi', "
+                 "'100M' or a plain number") from None
     if suffix in _BIN_SUFFIX:
         return base * _BIN_SUFFIX[suffix]
     return base * _DEC_SUFFIX[suffix]

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass, field
-from typing import List, NamedTuple, Optional, Sequence
+from typing import Dict, List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -53,6 +53,11 @@ class CapacityPlan:
     fail_counts: np.ndarray = field(repr=False, default=None)         # [S, P, OPS]
     gpu_pick: Optional[np.ndarray] = field(repr=False, default=None)  # [S, P, G]
     vol_pick: Optional[np.ndarray] = field(repr=False, default=None)  # [S, P, Lw]
+    # lane index -> error string for trials that failed even after the
+    # per-trial fallback; failed lanes report all_scheduled=False,
+    # satisfied=False, occupancy 0 (resilience: one bad trial no longer
+    # kills the sweep)
+    trial_errors: Dict[int, str] = field(default_factory=dict)
 
 
 def make_mesh(
@@ -170,6 +175,9 @@ def capacity_sweep(
     thresholds: SweepThresholds = SweepThresholds(),
     mesh: Optional[Mesh] = None,
     fail_reasons: bool = False,
+    retries: int = 2,
+    backoff_s: float = 0.05,
+    isolate_trials: bool = True,
 ) -> CapacityPlan:
     """Run the full sweep and pick the smallest satisfying node count.
 
@@ -177,26 +185,26 @@ def capacity_sweep(
     (EngineConfig.fail_reasons), so the what-if lanes run without it by
     default and CapacityPlan.fail_counts is zeros; callers that report
     reasons re-run just their decoded lane with reasons on (the applier
-    does). Pass fail_reasons=True to keep the accounting in every lane."""
+    does). Pass fail_reasons=True to keep the accounting in every lane.
+
+    Device execution is retried with exponential backoff (`retries`,
+    `backoff_s`); if the batched run still fails and `isolate_trials`,
+    each lane re-runs alone so one failing trial cannot kill the sweep —
+    failed lanes land in CapacityPlan.trial_errors instead."""
     arrs = device_arrays(snapshot)
     masks = active_masks_for_counts(snapshot, counts)
     sweep_cfg = cfg if fail_reasons else cfg._replace(fail_reasons=False)
-    out = batched_schedule(arrs, jnp.asarray(masks), sweep_cfg, mesh=mesh)
-
-    nodes = np.asarray(out.node)               # [S, P]
-    if fail_reasons:
-        fail = np.asarray(out.fail_counts)     # [S, P, OPS]
-    else:
-        # all-zero by construction; skip the device->host transfer
-        fail = np.zeros(out.fail_counts.shape, dtype=np.int32)
+    nodes, fail, headroom, vg_used_arr, gpu, vol, trial_errors = _execute_sweep(
+        arrs, masks, sweep_cfg, mesh, fail_reasons, retries, backoff_s,
+        isolate_trials)
     alloc = np.asarray(arrs.alloc)             # [N, R]
-    used = alloc[None] - np.asarray(out.state.headroom)   # [S, N, R]
+    used = alloc[None] - headroom              # [S, N, R]
 
     cpu_i = snapshot.resources.index("cpu")
     mem_i = snapshot.resources.index("memory")
     vg_cap = np.asarray(arrs.vg_cap)           # [N, V]
     has_storage = bool(np.any(vg_cap > 0))
-    vg_used_all = np.asarray(out.state.vg_used) if has_storage else None
+    vg_used_all = vg_used_arr if has_storage else None
 
     def occupancy(si, lane_active, ri) -> float:
         tot = float(np.sum(alloc[lane_active, ri]))
@@ -216,7 +224,7 @@ def capacity_sweep(
     all_scheduled, cpu_occ, mem_occ, satisfied = [], [], [], []
     for si in range(len(counts)):
         lane_active = masks[si]
-        ok = bool(np.all(nodes[si] >= 0))
+        ok = si not in trial_errors and bool(np.all(nodes[si] >= 0))
         c_pct = occupancy(si, lane_active, cpu_i)
         m_pct = occupancy(si, lane_active, mem_i)
         v_pct = vg_occupancy(si, lane_active) if has_storage else 0.0
@@ -245,6 +253,65 @@ def capacity_sweep(
         best_count=best,
         nodes_per_scenario=nodes,
         fail_counts=fail,
-        gpu_pick=np.asarray(out.gpu_pick) if cfg.enable_gpu else None,
-        vol_pick=np.asarray(out.vol_pick) if cfg.enable_pv_match else None,
+        gpu_pick=gpu if cfg.enable_gpu else None,
+        vol_pick=vol if cfg.enable_pv_match else None,
+        trial_errors=trial_errors,
     )
+
+
+def _execute_sweep(arrs, masks, sweep_cfg, mesh, fail_reasons,
+                   retries, backoff_s, isolate_trials):
+    """Run the batched sweep with retry; fall back to isolated per-lane
+    runs when the batch keeps failing. Returns host numpy
+    (nodes, fail, headroom, vg_used, gpu_pick, vol_pick, trial_errors);
+    failed lanes hold neutral values (all -1 nodes, pristine headroom)."""
+    from open_simulator_tpu.resilience.retry import run_with_retries
+
+    def host(out):
+        fail = (np.asarray(out.fail_counts) if fail_reasons
+                else np.zeros(out.fail_counts.shape, dtype=np.int32))
+        return (np.asarray(out.node), fail, np.asarray(out.state.headroom),
+                np.asarray(out.state.vg_used), np.asarray(out.gpu_pick),
+                np.asarray(out.vol_pick))
+
+    try:
+        out = run_with_retries(
+            lambda: batched_schedule(arrs, jnp.asarray(masks), sweep_cfg,
+                                     mesh=mesh),
+            retries=retries, backoff_s=backoff_s)
+        return host(out) + ({},)
+    except Exception:
+        if not isolate_trials:
+            raise
+
+    s, n_pods = masks.shape[0], arrs.req.shape[0]
+    alloc = np.asarray(arrs.alloc)
+    nodes = np.full((s, n_pods), -1, dtype=np.int32)
+    fail = np.zeros((s, n_pods, sweep_cfg.n_ops), dtype=np.int32)
+    headroom = np.broadcast_to(alloc, (s,) + alloc.shape).copy()
+    vg_used = np.zeros((s,) + np.asarray(arrs.vg_cap).shape, dtype=np.float32)
+    gpu = np.zeros((s, n_pods, arrs.gpu_slot.shape[1]), dtype=np.int32)
+    vol = np.full((s, n_pods, arrs.wfc_ccid.shape[1]), -1, dtype=np.int32)
+    trial_errors = {}
+    for si in range(s):
+        try:
+            out_i = run_with_retries(
+                lambda: batched_schedule(arrs, jnp.asarray(masks[si:si + 1]),
+                                         sweep_cfg, mesh=None),
+                retries=retries, backoff_s=backoff_s)
+            nodes_i, fail_i, hr_i, vg_i, gpu_i, vol_i = host(out_i)
+            nodes[si], fail[si], headroom[si], vg_used[si] = (
+                nodes_i[0], fail_i[0], hr_i[0], vg_i[0])
+            if gpu_i[0].shape == gpu[si].shape:
+                gpu[si] = gpu_i[0]
+            if vol_i[0].shape == vol[si].shape:
+                vol[si] = vol_i[0]
+        except Exception as e:  # noqa: BLE001 — isolate, record, continue
+            trial_errors[si] = f"{type(e).__name__}: {e}"
+    if len(trial_errors) == s:
+        # every lane failed — this is a systemic failure (dead device,
+        # engine bug), not a flaky trial; surface it instead of returning
+        # an all-failed plan with no diagnostics
+        raise RuntimeError(
+            f"all {s} sweep trials failed; first: {trial_errors[0]}")
+    return nodes, fail, headroom, vg_used, gpu, vol, trial_errors

@@ -1,0 +1,34 @@
+"""Resilience layer: spec admission, fault/chaos injection, hardened paths.
+
+Three parts (ARCHITECTURE.md "Resilience layer"):
+
+  errors     the structured SimulationError taxonomy (re-exported from
+             open_simulator_tpu.errors, which low-level parsers can
+             import without cycles)
+  admission  host-side pre-encode validation of nodes/workloads/apps —
+             malformed quantities, bad topology keys, conflicting
+             selectors, vocabulary-cap overflows all surface as
+             AdmissionError instead of deep encode/XLA tracebacks
+  chaos      ChaosPlan fault injection (node kill / zone outage / drain)
+             re-simulated through the engine's active-node mask, emitting
+             a deterministic DisruptionReport
+  retry      retry-with-backoff around flaky device execution
+"""
+
+from open_simulator_tpu.errors import (  # noqa: F401
+    AdmissionError,
+    QuantityError,
+    SimulationError,
+)
+from open_simulator_tpu.resilience.admission import (  # noqa: F401
+    admit,
+    validate_cluster,
+)
+from open_simulator_tpu.resilience.chaos import (  # noqa: F401
+    ChaosPlan,
+    DisruptionReport,
+    DisruptionStep,
+    FaultEvent,
+    run_chaos,
+)
+from open_simulator_tpu.resilience.retry import run_with_retries  # noqa: F401

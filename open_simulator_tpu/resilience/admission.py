@@ -1,0 +1,277 @@
+"""Admission validation: the host-side pre-encode pass.
+
+Walks nodes, cluster pods, workload templates, and app resources and
+collects every spec defect as a structured SimulationError (code, object
+ref, field path, remediation hint). `admit()` raises an AdmissionError
+aggregating them, so the Simulator API, core.simulate, the CLI, and the
+REST server all fail with actionable diagnostics instead of a traceback
+from deep inside encode/ or an XLA trace.
+
+Checks:
+  E_QUANTITY           negative resource quantities (malformed *syntax* is
+                       already structured at parse time, k8s/quantity.py)
+  E_TOPOLOGY_KEY       empty or syntactically invalid topologyKey on
+                       required (anti-)affinity terms / spread
+                       constraints; with strict_topology, also keys no
+                       node in the cluster carries
+  E_SELECTOR_CONFLICT  workload selector that cannot match its own pod
+                       template labels
+  E_VOCAB_OVERFLOW     per-pod constraint slots or the estimated selector
+                       vocabulary beyond the engine's admission caps
+  E_SPEC               negative replica counts, duplicate node names,
+                       nameless objects
+  E_NO_NODES           nothing to encode
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+from open_simulator_tpu.errors import AdmissionError, QuantityError, SimulationError
+from open_simulator_tpu.k8s import objects as k8s
+from open_simulator_tpu.k8s.loader import ClusterResources
+
+HOSTNAME_KEY = "kubernetes.io/hostname"
+
+# Engine admission caps. Per-pod constraint slots become static xs columns
+# of the scan ([P, A]/[P, B]/[P, Cs] widths are the max over pods), so one
+# pathological pod inflates every pod's step cost; the selector-group
+# vocabulary sizes the [N, S] group_count carry. The caps are far above
+# anything a real workload carries while keeping the carry bounded.
+MAX_TERMS_PER_POD = 64
+MAX_SELECTOR_GROUPS = 65536
+
+_LABEL_NAME = re.compile(r"^[A-Za-z0-9]([A-Za-z0-9_.\-]*[A-Za-z0-9])?$")
+_DNS_SUBDOMAIN = re.compile(r"^[a-z0-9]([a-z0-9.\-]*[a-z0-9])?$")
+
+
+def _valid_label_key(key: str) -> bool:
+    """k8s qualified-name syntax: [dns-subdomain/]name, name <= 63."""
+    if "/" in key:
+        prefix, _, name = key.partition("/")
+        if not prefix or len(prefix) > 253 or not _DNS_SUBDOMAIN.match(prefix):
+            return False
+    else:
+        name = key
+    return bool(name) and len(name) <= 63 and bool(_LABEL_NAME.match(name))
+
+
+def _template_pod(workload) -> Optional[k8s.Pod]:
+    """Parse one pod from a workload's template (may raise QuantityError)."""
+    template = getattr(workload, "template", None) or {}
+    if not template:
+        return None
+    meta = dict(template.get("metadata") or {})
+    meta.setdefault("name", workload.meta.name or "template")
+    return k8s.Pod.from_dict({"metadata": meta, "spec": template.get("spec") or {}})
+
+
+def _iter_workloads(res: ClusterResources) -> Iterator[Tuple[str, object]]:
+    for group, kind in ((res.deployments, "deployment"),
+                        (res.replica_sets, "replicaset"),
+                        (res.stateful_sets, "statefulset"),
+                        (res.daemon_sets, "daemonset")):
+        for wl in group:
+            ns = wl.meta.namespace or "default"
+            yield f"{kind}/{ns}/{wl.meta.name}", wl
+
+
+def _iter_pods(res: ClusterResources) -> Iterator[Tuple[str, k8s.Pod, List[SimulationError]]]:
+    """Yield (ref, pod, parse_errors) for direct pods, workload templates,
+    and job templates. Template parse failures (malformed quantities)
+    surface as errors attached to the owning workload instead of raising."""
+    for p in res.pods:
+        yield f"pod/{p.meta.namespace or 'default'}/{p.meta.name}", p, []
+    for ref, wl in _iter_workloads(res):
+        try:
+            tp = _template_pod(wl)
+        except QuantityError as e:
+            yield ref, None, [QuantityError(
+                e.message, ref=ref,
+                field="spec.template.spec.containers[].resources." + (e.field or ""),
+                hint=e.hint)]
+            continue
+        if tp is not None:
+            yield ref, tp, []
+    for job in res.jobs:
+        ns = job.meta.namespace or "default"
+        ref = f"job/{ns}/{job.meta.name}"
+        try:
+            tp = _template_pod(job)
+        except QuantityError as e:
+            yield ref, None, [QuantityError(
+                e.message, ref=ref,
+                field="spec.template.spec.containers[].resources." + (e.field or ""),
+                hint=e.hint)]
+            continue
+        if tp is not None:
+            yield ref, tp, []
+
+
+def _check_nodes(nodes: List[k8s.Node], errors: List[SimulationError]) -> None:
+    seen = set()
+    for n in nodes:
+        ref = f"node/{n.name}"
+        if not n.name:
+            errors.append(SimulationError(
+                "node has no name", code="E_SPEC", ref="node/",
+                field="metadata.name", hint="set metadata.name"))
+            continue
+        if n.name in seen:
+            errors.append(SimulationError(
+                f"duplicate node name {n.name!r}", code="E_SPEC", ref=ref,
+                field="metadata.name",
+                hint="node names must be unique within a cluster snapshot"))
+        seen.add(n.name)
+        for res, v in n.allocatable.items():
+            if v < 0:
+                errors.append(QuantityError(
+                    f"negative allocatable {res}={v}", ref=ref,
+                    field=f"status.allocatable.{res}",
+                    hint="allocatable quantities must be >= 0"))
+
+
+def _check_pod(ref: str, pod: k8s.Pod, known_keys: set,
+               strict_topology: bool, selector_keys: set,
+               errors: List[SimulationError]) -> None:
+    for c in pod.containers:
+        for res, v in list(c.requests.items()) + list(c.limits.items()):
+            if v < 0:
+                errors.append(QuantityError(
+                    f"negative request {res}={v}", ref=ref,
+                    field=f"spec.containers[].resources.requests.{res}",
+                    hint="resource requests must be >= 0"))
+
+    def check_key(key: str, field: str) -> None:
+        if not key:
+            errors.append(SimulationError(
+                "empty topologyKey", code="E_TOPOLOGY_KEY", ref=ref,
+                field=field,
+                hint=f"set a label key such as {HOSTNAME_KEY!r} or "
+                     "'topology.kubernetes.io/zone'"))
+        elif not _valid_label_key(key):
+            errors.append(SimulationError(
+                f"invalid topologyKey {key!r}", code="E_TOPOLOGY_KEY",
+                ref=ref, field=field,
+                hint="topology keys follow k8s label-key syntax "
+                     "([prefix/]name, name <= 63 chars)"))
+        elif strict_topology and key not in known_keys:
+            some = ", ".join(sorted(known_keys)[:4])
+            errors.append(SimulationError(
+                f"no node carries topology key {key!r}", code="E_TOPOLOGY_KEY",
+                ref=ref, field=field,
+                hint=f"node label keys present in this cluster: {some}"))
+
+    n_terms = 0
+    for t in pod.pod_affinity_required:
+        check_key(t.topology_key, "spec.affinity.podAffinity.required[].topologyKey")
+        n_terms += 1
+        if t.selector is not None:
+            selector_keys.add(t.selector.canonical_key(tuple(t.namespaces)))
+    for t in pod.pod_anti_affinity_required:
+        check_key(t.topology_key, "spec.affinity.podAntiAffinity.required[].topologyKey")
+        n_terms += 1
+        if t.selector is not None:
+            selector_keys.add(t.selector.canonical_key(tuple(t.namespaces)))
+    for t in pod.topology_spread:
+        check_key(t.topology_key, "spec.topologySpreadConstraints[].topologyKey")
+        n_terms += 1
+        if t.label_selector is not None:
+            selector_keys.add(t.label_selector.canonical_key(
+                (pod.meta.namespace or "default",)))
+    if n_terms > MAX_TERMS_PER_POD:
+        errors.append(SimulationError(
+            f"{n_terms} affinity/spread terms on one pod exceeds the "
+            f"admission cap ({MAX_TERMS_PER_POD})", code="E_VOCAB_OVERFLOW",
+            ref=ref, field="spec",
+            hint="constraint slots are encoded as static per-pod scan "
+                 "columns; split the constraints across workloads or raise "
+                 "resilience.admission.MAX_TERMS_PER_POD deliberately"))
+
+
+def _check_workload(ref: str, wl, errors: List[SimulationError]) -> None:
+    replicas = getattr(wl, "replicas", None)
+    if replicas is not None and replicas < 0:
+        errors.append(SimulationError(
+            f"negative replicas ({replicas})", code="E_SPEC", ref=ref,
+            field="spec.replicas", hint="replicas must be >= 0"))
+    selector = getattr(wl, "selector", None)
+    template = getattr(wl, "template", None) or {}
+    if selector is not None and selector.match_labels and template:
+        labels = ((template.get("metadata") or {}).get("labels")) or {}
+        mismatched = {k: v for k, v in selector.match_labels.items()
+                      if labels.get(k) != v}
+        if mismatched:
+            errors.append(SimulationError(
+                f"selector does not match the pod template labels "
+                f"(unmatched: {mismatched})", code="E_SELECTOR_CONFLICT",
+                ref=ref, field="spec.selector.matchLabels",
+                hint="every selector matchLabel must appear verbatim in "
+                     "spec.template.metadata.labels, or no pod this "
+                     "workload creates will ever match it"))
+
+
+def validate_cluster(
+    cluster: ClusterResources,
+    apps: Iterable = (),
+    strict_topology: bool = False,
+    require_nodes: bool = True,
+) -> List[SimulationError]:
+    """Collect every admission defect; empty list == admissible.
+
+    strict_topology additionally flags topology keys no node in the
+    cluster carries (off by default: a key that is merely absent makes
+    pods unschedulable — a legitimate simulation outcome — rather than
+    malformed)."""
+    errors: List[SimulationError] = []
+    if require_nodes and not cluster.nodes:
+        errors.append(SimulationError(
+            "cluster has no nodes", code="E_NO_NODES", ref="cluster",
+            field="nodes",
+            hint="add Node objects to the snapshot or pass new_nodes"))
+    _check_nodes(cluster.nodes, errors)
+    known_keys = {HOSTNAME_KEY}
+    for n in cluster.nodes:
+        known_keys.update(n.meta.labels.keys())
+
+    selector_keys: set = set()
+    sources = [("", cluster)] + [
+        (f"app/{getattr(a, 'name', '') or i}:", a.resources)
+        for i, a in enumerate(apps)
+    ]
+    for prefix, res in sources:
+        for ref, wl in _iter_workloads(res):
+            _check_workload(prefix + ref, wl, errors)
+        for ref, pod, parse_errs in _iter_pods(res):
+            errors.extend(parse_errs)
+            if pod is not None:
+                _check_pod(prefix + ref, pod, known_keys, strict_topology,
+                           selector_keys, errors)
+    if len(selector_keys) > MAX_SELECTOR_GROUPS:
+        errors.append(SimulationError(
+            f"{len(selector_keys)} distinct label selectors exceed the "
+            f"vocabulary cap ({MAX_SELECTOR_GROUPS})", code="E_VOCAB_OVERFLOW",
+            ref="cluster", field="",
+            hint="the selector vocabulary sizes the [N, S] group_count "
+                 "carry; deduplicate selectors across workloads"))
+    return errors
+
+
+def admit(cluster: ClusterResources, apps: Iterable = (),
+          strict_topology: bool = False, require_nodes: bool = True) -> None:
+    """Raise AdmissionError (a SimulationError) if validation finds defects."""
+    errors = validate_cluster(cluster, apps, strict_topology=strict_topology,
+                              require_nodes=require_nodes)
+    if errors:
+        raise AdmissionError(errors)
+
+
+def validate_app(app, cluster: ClusterResources) -> List[SimulationError]:
+    """Validate one AppResource against an already-admitted cluster
+    (Simulator.schedule_app: skip re-walking the cluster's own objects)."""
+    shim = ClusterResources()
+    shim.nodes = cluster.nodes  # node label keys feed the topology checks
+    errors = validate_cluster(shim, [app], require_nodes=False)
+    # node defects were already surfaced (or accepted) at cluster admission
+    return [e for e in errors if not e.ref.startswith("node/")]

@@ -1,0 +1,273 @@
+"""Fault/chaos injection: "what breaks when nodes *leave*?"
+
+The capacity sweep answers the additive question (how many nodes must I
+add); a ChaosPlan answers the dual. Each FaultEvent removes nodes from
+the engine's active-node mask — the same [N] bool the sweep's what-if
+lanes flip on — and the whole pod sequence is deterministically
+re-simulated against the shrunken cluster. Pods whose node died are
+"evicted" and either re-place elsewhere or become unschedulable; the
+per-event DisruptionStep records both, plus the capacity headroom lost.
+
+Everything is encoded ONCE: per event only the active mask and the
+forced-bind column change (pods pinned via spec.nodeName to a dead node
+are un-pinned so the scheduler may rescue them), so every re-simulation
+hits the same compiled scan. Determinism is the scan's own: identical
+masks -> identical placements, run to run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from open_simulator_tpu.errors import SimulationError
+
+ZONE_KEY_DEFAULT = "topology.kubernetes.io/zone"
+
+_KINDS = ("kill_node", "kill_zone", "drain_node")
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One fault: kill_node / drain_node target a node name; kill_zone
+    targets a zone label value (all nodes carrying it fail together)."""
+
+    kind: str
+    target: str
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FaultEvent":
+        return cls(kind=str(d.get("kind", "")), target=str(d.get("target", "")))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "target": self.target}
+
+
+@dataclass
+class ChaosPlan:
+    """An ordered fault sequence; faults are cumulative (a drained node
+    stays gone for every later event)."""
+
+    events: List[FaultEvent] = field(default_factory=list)
+    zone_key: str = ZONE_KEY_DEFAULT
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ChaosPlan":
+        return cls(
+            events=[FaultEvent.from_dict(e) for e in d.get("events") or []],
+            zone_key=d.get("zone_key") or ZONE_KEY_DEFAULT,
+        )
+
+    def validate(self) -> None:
+        if not self.events:
+            raise SimulationError(
+                "chaos plan has no events", code="E_SPEC", ref="chaos_plan",
+                field="events",
+                hint="add events like {kind: kill_node, target: <name>}")
+        for i, ev in enumerate(self.events):
+            if ev.kind not in _KINDS:
+                raise SimulationError(
+                    f"unknown fault kind {ev.kind!r}", code="E_SPEC",
+                    ref="chaos_plan", field=f"events[{i}].kind",
+                    hint=f"one of {', '.join(_KINDS)}")
+            if not ev.target:
+                raise SimulationError(
+                    "fault event has no target", code="E_SPEC",
+                    ref="chaos_plan", field=f"events[{i}].target",
+                    hint="kill_node/drain_node take a node name, "
+                         "kill_zone a zone label value")
+
+
+@dataclass
+class DisruptionStep:
+    """The measured impact of one fault event (cumulative cluster state)."""
+
+    event: FaultEvent
+    failed_nodes: List[str]
+    evicted_pods: List[str]
+    replaced: Dict[str, str]          # evicted pod key -> rescue node
+    lost_pods: List[str]              # evicted and now unschedulable
+    unschedulable_before: int
+    unschedulable_after: int
+    capacity_lost: Dict[str, float]   # resource -> allocatable removed
+    active_nodes: int
+
+    @property
+    def unschedulable_delta(self) -> int:
+        return self.unschedulable_after - self.unschedulable_before
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "event": self.event.to_dict(),
+            "failed_nodes": list(self.failed_nodes),
+            "evicted_pods": list(self.evicted_pods),
+            "replaced": dict(self.replaced),
+            "lost_pods": list(self.lost_pods),
+            "unschedulable_before": self.unschedulable_before,
+            "unschedulable_after": self.unschedulable_after,
+            "unschedulable_delta": self.unschedulable_delta,
+            "capacity_lost": dict(self.capacity_lost),
+            "active_nodes": self.active_nodes,
+        }
+
+
+@dataclass
+class DisruptionReport:
+    total_pods: int
+    baseline_unschedulable: int
+    steps: List[DisruptionStep] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "total_pods": self.total_pods,
+            "baseline_unschedulable": self.baseline_unschedulable,
+            "steps": [s.to_dict() for s in self.steps],
+        }
+
+    def format(self) -> str:
+        lines = [
+            f"chaos report: {self.total_pods} pods, "
+            f"{self.baseline_unschedulable} unschedulable at baseline",
+        ]
+        for i, s in enumerate(self.steps):
+            lines.append(
+                f"  [{i + 1}] {s.event.kind} {s.event.target}: "
+                f"{len(s.failed_nodes)} node(s) down, "
+                f"{len(s.evicted_pods)} evicted "
+                f"({len(s.replaced)} re-placed, {len(s.lost_pods)} lost), "
+                f"unschedulable {s.unschedulable_before} -> "
+                f"{s.unschedulable_after}, "
+                f"cpu -{s.capacity_lost.get('cpu', 0):.0f}m "
+                f"mem -{s.capacity_lost.get('memory', 0):.0f}Mi, "
+                f"{s.active_nodes} nodes left")
+        return "\n".join(lines)
+
+
+def _resolve_event(ev: FaultEvent, zone_key: str, node_names: List[str],
+                   node_labels: List[Dict[str, str]],
+                   alive: np.ndarray) -> List[int]:
+    if ev.kind in ("kill_node", "drain_node"):
+        if ev.target not in node_names:
+            raise SimulationError(
+                f"node {ev.target!r} not found in cluster", code="E_SPEC",
+                ref=f"node/{ev.target}", field="chaos_plan.events[].target",
+                hint="targets must name nodes present in the snapshot")
+        idx = node_names.index(ev.target)
+        return [idx] if alive[idx] else []
+    hit = [i for i, lb in enumerate(node_labels)
+           if alive[i] and lb.get(zone_key) == ev.target]
+    if not hit and not any(lb.get(zone_key) == ev.target for lb in node_labels):
+        raise SimulationError(
+            f"no node carries {zone_key}={ev.target!r}", code="E_SPEC",
+            ref="chaos_plan", field="events[].target",
+            hint=f"zone values present: "
+                 f"{sorted({lb.get(zone_key) for lb in node_labels if zone_key in lb})}")
+    return hit
+
+
+def run_chaos(
+    cluster,
+    plan: ChaosPlan,
+    apps: Iterable = (),
+    encode_options=None,
+    config_overrides: Optional[Dict] = None,
+    validate: bool = True,
+) -> DisruptionReport:
+    """Simulate the plan's fault sequence and report each event's blast
+    radius. Deterministic: same cluster + plan -> identical report."""
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.core import (
+        build_pod_sequence,
+        with_volume_objects,
+        _with_nodes,
+    )
+    from open_simulator_tpu.encode.snapshot import encode_cluster
+    from open_simulator_tpu.engine.scheduler import (
+        device_arrays,
+        make_config,
+        schedule_pods,
+    )
+    from open_simulator_tpu.k8s.loader import make_valid_node
+
+    plan.validate()
+    apps = list(apps)
+    if validate:
+        from open_simulator_tpu.resilience.admission import admit
+
+        admit(cluster, apps)
+
+    nodes = [make_valid_node(n) for n in cluster.nodes]
+    cluster = _with_nodes(cluster, nodes)
+    pods = build_pod_sequence(cluster, apps)
+    opts = with_volume_objects(encode_options, cluster, apps)
+    snapshot = encode_cluster(nodes, pods, opts)
+    # forced_prefix folds pinned pods outside the scan, but chaos un-pins
+    # pods bound to dead nodes — keep every pod inside the scan so a
+    # rescued pod is actually rescheduled
+    cfg = make_config(snapshot, **dict(config_overrides or {}))._replace(
+        forced_prefix=0)
+    arrs = device_arrays(snapshot)
+
+    node_names = list(snapshot.node_names)
+    node_labels = [n.meta.labels for n in snapshot.nodes]
+    alloc = np.asarray(snapshot.arrays.alloc)
+    resources = list(snapshot.resources)
+
+    active = np.array(np.asarray(arrs.active), dtype=bool, copy=True)
+    forced = np.array(np.asarray(arrs.forced_node), dtype=np.int32, copy=True)
+
+    out0 = schedule_pods(arrs, jnp.asarray(active), cfg)
+    assign = np.asarray(out0.node)
+    report = DisruptionReport(
+        total_pods=snapshot.n_pods,
+        baseline_unschedulable=int(np.sum(assign < 0)),
+    )
+
+    for ev in plan.events:
+        failed = _resolve_event(ev, plan.zone_key, node_names, node_labels,
+                                active)
+        failed_mask = np.zeros(len(node_names), dtype=bool)
+        failed_mask[failed] = True
+        active = active & ~failed_mask
+        # un-pin pods whose spec.nodeName died so the scan may rescue them —
+        # EXCEPT DaemonSet pods, which die with their node (the controller
+        # only ever runs them there); those become "node not found" (-2)
+        # and count as lost instead of migrating to an arbitrary node
+        pinned_dead = failed_mask[np.maximum(forced, 0)] & (forced >= 0)
+        is_ds = np.fromiter(
+            (p.meta.owner_kind == "DaemonSet" for p in snapshot.pods),
+            dtype=bool, count=snapshot.n_pods)
+        forced = np.where(pinned_dead, np.where(is_ds, np.int32(-2), np.int32(-1)),
+                          forced)
+        evicted_idx = np.nonzero((assign >= 0) & failed_mask[np.maximum(assign, 0)])[0]
+
+        arrs_ev = dataclasses.replace(arrs, forced_node=jnp.asarray(forced))
+        out = schedule_pods(arrs_ev, jnp.asarray(active), cfg)
+        new_assign = np.asarray(out.node)
+
+        replaced = {
+            snapshot.pods[i].key: node_names[int(new_assign[i])]
+            for i in evicted_idx if new_assign[i] >= 0
+        }
+        lost = [snapshot.pods[i].key for i in evicted_idx if new_assign[i] < 0]
+        cap_lost = {
+            r: float(np.sum(alloc[failed_mask, ri]))
+            for ri, r in enumerate(resources)
+        }
+        report.steps.append(DisruptionStep(
+            event=ev,
+            failed_nodes=[node_names[i] for i in failed],
+            evicted_pods=[snapshot.pods[i].key for i in evicted_idx],
+            replaced=replaced,
+            lost_pods=lost,
+            unschedulable_before=int(np.sum(assign < 0)),
+            unschedulable_after=int(np.sum(new_assign < 0)),
+            capacity_lost=cap_lost,
+            active_nodes=int(np.sum(active)),
+        ))
+        assign = new_assign
+    return report

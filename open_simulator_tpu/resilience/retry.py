@@ -1,0 +1,36 @@
+"""Retry-with-backoff around flaky device execution.
+
+Device execution can fail transiently (preempted TPU slice, OOM from a
+neighboring process, transport hiccups). A bounded exponential backoff
+turns those into latency instead of failures; persistent errors still
+propagate after the attempts are exhausted so real bugs surface.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Tuple, Type, TypeVar
+
+T = TypeVar("T")
+
+
+def run_with_retries(
+    fn: Callable[[], T],
+    retries: int = 2,
+    backoff_s: float = 0.05,
+    max_backoff_s: float = 2.0,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    sleep: Callable[[float], None] = time.sleep,
+) -> T:
+    """Call fn(); on a retryable exception wait backoff_s * 2^attempt
+    (capped) and try again, up to `retries` extra attempts. The last
+    failure is re-raised unchanged."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retry_on:
+            if attempt >= retries:
+                raise
+            sleep(min(backoff_s * (2.0 ** attempt), max_backoff_s))
+            attempt += 1

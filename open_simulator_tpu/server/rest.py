@@ -8,6 +8,17 @@ Endpoint parity with the reference (pkg/server/server.go:148-314):
   POST /api/scale-apps    -> simulate re-scaling existing workloads (their
                              current pods are removed first — the re-rollout
                              semantics of removePodsOfApp, server.go:404-444)
+  POST /api/chaos         -> fault-injection re-simulation (resilience/chaos):
+                             {"cluster": ..., "apps": [...], "plan":
+                              {"events": [{"kind": "kill_node", "target": "n0"}],
+                               "zone_key": "topology.kubernetes.io/zone"}}
+
+Hardened paths (resilience layer): request bodies above `max_body_bytes`
+are rejected 413 before being read; every simulation runs under
+`request_timeout_s` (timeout -> 504 while the stale computation finishes
+off-thread, keeping single-flight semantics); malformed specs surface as
+structured error bodies ({"error", "code", "ref", "field", "hint",
+"errors": [...]}) from the admission pass instead of 500 tracebacks.
 
 Differences, by design of this environment: the reference watches a live
 cluster through a kubeconfig; here the "live cluster" is a YAML snapshot
@@ -34,6 +45,7 @@ from typing import Any, Dict, List, Optional
 import yaml
 
 from open_simulator_tpu.core import AppResource, SimulateResult, simulate
+from open_simulator_tpu.errors import SimulationError
 from open_simulator_tpu.k8s.loader import (
     ClusterResources,
     demux_object,
@@ -45,12 +57,20 @@ from open_simulator_tpu.k8s.loader import (
 from open_simulator_tpu.k8s.objects import LABEL_APP_NAME, Node
 
 
+DEFAULT_MAX_BODY_BYTES = 8 * 1024 * 1024
+DEFAULT_REQUEST_TIMEOUT_S = 300.0
+
+
 class SimulationServer:
-    def __init__(self, cluster_config: str = "", kubeconfig: str = ""):
+    def __init__(self, cluster_config: str = "", kubeconfig: str = "",
+                 max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
+                 request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S):
         self.cluster_config = cluster_config
         # recorded API dump standing in for the reference's 10 live
         # informers (pkg/server/server.go:97-137; no cluster access here)
         self.kubeconfig = kubeconfig
+        self.max_body_bytes = int(max_body_bytes)
+        self.request_timeout_s = float(request_timeout_s)
         self._lock = threading.Lock()
         self._stats = {"requests": 0, "simulations": 0, "errors": 0,
                        "last_elapsed_s": 0.0, "started_at": time.time()}
@@ -109,9 +129,11 @@ class SimulationServer:
             return resolve_cluster_source(self.kubeconfig).load()
         if self.cluster_config:
             return load_resources_from_directory(self.cluster_config)
-        raise ValueError(
+        raise SimulationError(
             "no cluster snapshot: start with --cluster-config / --kubeconfig "
-            "(a recorded API dump) or pass request.cluster.yaml")
+            "(a recorded API dump) or pass request.cluster.yaml",
+            code="E_BAD_REQUEST", ref="request", field="cluster",
+            hint="include {\"cluster\": {\"yaml\": \"<multi-doc k8s yaml>\"}}")
 
     # ---- handlers ------------------------------------------------------
 
@@ -120,10 +142,22 @@ class SimulationServer:
         cluster = self.base_cluster(body.get("cluster"))
         cluster.nodes.extend(self._request_new_nodes(body.get("new_nodes")))
         apps = self._request_apps(body)
-        result = simulate(cluster, apps)
+        result = simulate(cluster, apps)  # simulate() runs admission first
         self._stats["simulations"] += 1
         self._stats["last_elapsed_s"] = round(result.elapsed_s, 3)
         return self._response(result, app_only=True)
+
+    def chaos(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Fault-injection re-simulation (resilience/chaos.py)."""
+        from open_simulator_tpu.resilience.chaos import ChaosPlan, run_chaos
+
+        self._stats["requests"] += 1
+        cluster = self.base_cluster(body.get("cluster"))
+        apps = self._request_apps(body)
+        plan = ChaosPlan.from_dict(body.get("plan") or {})
+        report = run_chaos(cluster, plan, apps)
+        self._stats["simulations"] += 1
+        return report.to_dict()
 
     def scale_apps(self, body: Dict[str, Any]) -> Dict[str, Any]:
         self._stats["requests"] += 1
@@ -137,7 +171,11 @@ class SimulationServer:
             replicas = entry.get("replicas")
             workload = self._pop_workload(cluster, kind, ns, name)
             if workload is None:
-                raise ValueError(f"workload {kind} {ns}/{name} not found in cluster snapshot")
+                raise SimulationError(
+                    f"workload {kind} {ns}/{name} not found in cluster snapshot",
+                    code="E_WORKLOAD_NOT_FOUND",
+                    ref=f"{kind.lower()}/{ns}/{name}", field="apps[].name",
+                    hint="scale targets must exist in the cluster snapshot")
             # remove pods owned by the workload (re-rollout), then re-add it
             # with the requested replica count as an app to schedule
             self._remove_owned_pods(cluster, workload, kind, ns, name)
@@ -283,48 +321,109 @@ def _make_handler(server: SimulationServer):
                 self._send(404, {"error": "not found"})
 
         def do_POST(self):
-            if self.path not in ("/api/deploy-apps", "/api/scale-apps"):
+            routes = {"/api/deploy-apps": server.deploy_apps,
+                      "/api/scale-apps": server.scale_apps,
+                      "/api/chaos": server.chaos}
+            handler_fn = routes.get(self.path)
+            if handler_fn is None:
                 self._send(404, {"error": "not found"})
                 return
             length = int(self.headers.get("Content-Length", 0))
+            if length > server.max_body_bytes:
+                # rejected BEFORE the body is read: an oversized payload
+                # costs the server a header parse, nothing more
+                server._stats["errors"] += 1
+                self._send(413, _err_payload(SimulationError(
+                    f"request body of {length} bytes exceeds the "
+                    f"{server.max_body_bytes}-byte cap",
+                    code="E_PAYLOAD_TOO_LARGE", ref="request",
+                    field="Content-Length",
+                    hint="split the request or raise --max-body-mib")))
+                return
             try:
                 body = json.loads(self.rfile.read(length) or b"{}")
             except json.JSONDecodeError as e:
-                self._send(400, {"error": f"bad json: {e}"})
+                self._send(400, _err_payload(SimulationError(
+                    f"bad json: {e}", code="E_BAD_REQUEST", ref="request",
+                    hint="the body must be a JSON object")))
                 return
             if not server._lock.acquire(blocking=False):
-                self._send(503, {"error": "a simulation is already running"})
+                self._send(503, _err_payload(SimulationError(
+                    "a simulation is already running", code="E_BUSY",
+                    hint="retry after the in-flight simulation finishes")))
                 return
-            # Compute under the lock, send after release — otherwise a client
-            # that pipelines its next request on seeing the response races the
-            # lock release and gets a spurious 503.
-            try:
-                if self.path == "/api/deploy-apps":
-                    code, payload = 200, server.deploy_apps(body)
-                else:
-                    code, payload = 200, server.scale_apps(body)
-            except ValueError as e:
+            # Compute in a worker under the lock; send after the work (or
+            # the deadline) — the lock is released by the WORKER when the
+            # computation truly ends, so a timed-out simulation keeps
+            # single-flight semantics (later requests see 503) instead of
+            # racing a zombie computation.
+            box: Dict[str, Any] = {}
+
+            def work():
+                try:
+                    try:
+                        box["resp"] = (200, handler_fn(body))
+                    except SimulationError as e:
+                        server._stats["errors"] += 1
+                        box["resp"] = (_status_for(e), _err_payload(e))
+                    except ValueError as e:
+                        server._stats["errors"] += 1
+                        box["resp"] = (400, {"error": str(e)})
+                    except Exception as e:  # noqa: BLE001 — 500 with message
+                        server._stats["errors"] += 1
+                        box["resp"] = (500, {"error": f"{type(e).__name__}: {e}"})
+                finally:
+                    server._lock.release()
+
+            t = threading.Thread(target=work, daemon=True)
+            t.start()
+            t.join(server.request_timeout_s)
+            if t.is_alive():
                 server._stats["errors"] += 1
-                code, payload = 400, {"error": str(e)}
-            except Exception as e:  # noqa: BLE001 — 500 with message, like gin recovery
-                server._stats["errors"] += 1
-                code, payload = 500, {"error": f"{type(e).__name__}: {e}"}
-            finally:
-                server._lock.release()
+                self._send(504, _err_payload(SimulationError(
+                    f"simulation exceeded the {server.request_timeout_s:.0f}s "
+                    "deadline", code="E_TIMEOUT",
+                    hint="shrink the request or raise --request-timeout; the "
+                         "stale computation finishes in the background")))
+                return
+            code, payload = box["resp"]
             self._send(code, payload)
 
     return Handler
 
 
+def _err_payload(e: SimulationError) -> Dict[str, Any]:
+    """Structured error body; `error` stays a plain string for pre-taxonomy
+    clients."""
+    out = e.to_dict()
+    out["error"] = e.message
+    return out
+
+
+_STATUS_BY_CODE = {
+    "E_PAYLOAD_TOO_LARGE": 413,
+    "E_TIMEOUT": 504,
+    "E_BUSY": 503,
+}
+
+
+def _status_for(e: SimulationError) -> int:
+    return _STATUS_BY_CODE.get(e.code, 400)
+
+
 def serve(address: str = "127.0.0.1", port: int = 8899, cluster_config: str = "",
-          kubeconfig: str = "") -> int:
+          kubeconfig: str = "",
+          max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
+          request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S) -> int:
     if kubeconfig:
         # validate up front so a real kubeconfig fails fast with the
         # record-a-dump recipe instead of 500s per request
         from open_simulator_tpu.k8s.cluster_source import resolve_cluster_source
 
         resolve_cluster_source(kubeconfig).load()
-    sim_server = SimulationServer(cluster_config=cluster_config, kubeconfig=kubeconfig)
+    sim_server = SimulationServer(cluster_config=cluster_config, kubeconfig=kubeconfig,
+                                  max_body_bytes=max_body_bytes,
+                                  request_timeout_s=request_timeout_s)
     httpd = ThreadingHTTPServer((address, port), _make_handler(sim_server))
     print(f"simon-tpu server listening on http://{address}:{port}")
     try:

@@ -45,6 +45,7 @@ class Simulator:
         encode_options: Optional[EncodeOptions] = None,
         config_overrides: Optional[Dict] = None,
         preemption: bool = True,
+        validate: bool = True,
     ):
         self._overrides = dict(config_overrides or {})
         self.preemption = preemption and not self._overrides.pop(
@@ -56,6 +57,11 @@ class Simulator:
         self._preempted_by: Dict[int, int] = {}
         self.cluster = cluster
         self.cluster.nodes = [make_valid_node(n) for n in cluster.nodes]
+        self._validate = validate
+        if validate:
+            from open_simulator_tpu.resilience.admission import admit
+
+            admit(self.cluster)
         self._encode_options = encode_options
         self._pods: List[Pod] = []
         self._apps: List[AppResource] = []
@@ -77,6 +83,13 @@ class Simulator:
     # -- reference: ScheduleApp (simulator.go:225) ----------------------
     def schedule_app(self, app: AppResource) -> SimulateResult:
         """Schedule one more app; returns only this app's placements."""
+        if self._validate:
+            from open_simulator_tpu.resilience.admission import (
+                AdmissionError, validate_app)
+
+            errors = validate_app(app, self.cluster)
+            if errors:
+                raise AdmissionError(errors)
         batch = expand_app_resources(app.resources, self.cluster.nodes, app.name)
         self._apps.append(app)
         _resolve_priorities(batch, self.cluster, self._apps)

@@ -173,8 +173,14 @@ def test_pre_round4_checkpoint_loads_and_resumes(tmp_path):
     state, _, meta = load_simulation(str(ckpt))
     assert np.asarray(state.dom_count).ndim == 3  # shape-safe fill
     state = resume_state(state, arrs, meta)
-    np.testing.assert_allclose(
-        np.asarray(state.dom_count), np.asarray(first.state.dom_count), atol=0)
+    # the rebuild contract: dom_count[k,d,s] = sum_n topo[k,n,d] * gc[n,s]
+    # (the carried table itself is unmaintained dead weight on the
+    # group_count path — EngineConfig.maintain_dom_count — so compare
+    # against the derived ground truth, not first.state.dom_count)
+    want_dom = np.einsum(
+        "knd,ns->kds", np.asarray(arrs.topo_onehot),
+        np.asarray(first.state.group_count, dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(state.dom_count), want_dom, atol=0)
     resumed = schedule_pods(
         slice_pods(arrs, k, snap.n_pods), arrs.active, cfg,
         state=SimState(*[np.asarray(v) for v in state]),

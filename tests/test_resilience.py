@@ -1,0 +1,322 @@
+"""Resilience layer: admission taxonomy, chaos injection, sweep isolation."""
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu.core import AppResource, simulate
+from open_simulator_tpu.errors import AdmissionError, QuantityError, SimulationError
+from open_simulator_tpu.k8s.loader import ClusterResources
+from open_simulator_tpu.resilience import (
+    ChaosPlan,
+    FaultEvent,
+    run_chaos,
+    run_with_retries,
+    validate_cluster,
+)
+from open_simulator_tpu.resilience.admission import MAX_TERMS_PER_POD
+from open_simulator_tpu.testing.builders import (
+    make_fake_deployment,
+    make_fake_node,
+    make_fake_pod,
+)
+
+
+def _cluster(n=4, cpu="4", zone_of=lambda i: f"z{i % 2}", pods=0):
+    c = ClusterResources()
+    c.nodes = [
+        make_fake_node(f"n{i}", cpu=cpu,
+                       labels={"topology.kubernetes.io/zone": zone_of(i)})
+        for i in range(n)
+    ]
+    c.pods = [make_fake_pod(f"p{i}", cpu="500m") for i in range(pods)]
+    return c
+
+
+# ---- admission error taxonomy ----------------------------------------
+
+
+def test_malformed_quantity_is_structured():
+    with pytest.raises(SimulationError) as ei:
+        make_fake_pod("bad", cpu="2x")
+    err = ei.value
+    assert err.code == "E_QUANTITY"
+    assert isinstance(err, ValueError)  # legacy except-ValueError paths
+    assert "cpu" in err.field
+    assert err.hint  # remediation present
+    d = err.to_dict()
+    assert d["code"] == "E_QUANTITY" and d["hint"]
+
+
+def test_multidot_quantity_is_structured():
+    # "1.2.3" passes the [0-9.]+ regex but is not a valid Fraction
+    with pytest.raises(QuantityError) as ei:
+        make_fake_pod("bad", cpu="1.2.3")
+    assert ei.value.code == "E_QUANTITY"
+
+
+def test_chaos_cli_preserves_event_order():
+    from open_simulator_tpu.cli.main import build_parser
+
+    args = build_parser().parse_args(
+        ["chaos", "--cluster-config", "x", "--drain-node", "n5",
+         "--kill-zone", "z0", "--kill-node", "n1"])
+    assert args.events == [("drain_node", "n5"), ("kill_zone", "z0"),
+                           ("kill_node", "n1")]
+
+
+def test_selector_conflict_detected():
+    c = _cluster()
+    dep = make_fake_deployment("web", replicas=2, match_labels={"app": "web"})
+    dep.template["metadata"]["labels"] = {"app": "other"}
+    c.deployments.append(dep)
+    errs = validate_cluster(c)
+    assert any(e.code == "E_SELECTOR_CONFLICT"
+               and e.ref == "deployment/default/web" for e in errs)
+
+
+def test_empty_and_invalid_topology_keys():
+    c = _cluster()
+    c.pods.append(make_fake_pod("s1", topology_spread=[{
+        "maxSkew": 1, "topologyKey": "", "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"app": "x"}}}]))
+    c.pods.append(make_fake_pod("s2", topology_spread=[{
+        "maxSkew": 1, "topologyKey": "bad key!!", "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"app": "x"}}}]))
+    errs = validate_cluster(c)
+    refs = {e.ref for e in errs if e.code == "E_TOPOLOGY_KEY"}
+    assert {"pod/default/s1", "pod/default/s2"} <= refs
+
+
+def test_strict_topology_flags_unknown_keys():
+    c = _cluster()
+    c.pods.append(make_fake_pod("s1", topology_spread=[{
+        "maxSkew": 1, "topologyKey": "example.com/rack",
+        "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"app": "x"}}}]))
+    assert not validate_cluster(c)  # cluster-relative absence is legal
+    errs = validate_cluster(c, strict_topology=True)
+    assert any(e.code == "E_TOPOLOGY_KEY" and "rack" in e.message for e in errs)
+
+
+def test_vocab_overflow_cap():
+    c = _cluster()
+    spread = [{
+        "maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+        "whenUnsatisfiable": "ScheduleAnyway",
+        "labelSelector": {"matchLabels": {"app": f"a{i}"}},
+    } for i in range(MAX_TERMS_PER_POD + 1)]
+    c.pods.append(make_fake_pod("fat", topology_spread=spread))
+    errs = validate_cluster(c)
+    assert any(e.code == "E_VOCAB_OVERFLOW" and e.ref == "pod/default/fat"
+               for e in errs)
+
+
+def test_negative_replicas_and_no_nodes():
+    c = ClusterResources()
+    dep = make_fake_deployment("w", replicas=1, match_labels={"app": "w"})
+    dep.replicas = -3
+    c.deployments.append(dep)
+    errs = validate_cluster(c)
+    codes = {e.code for e in errs}
+    assert "E_NO_NODES" in codes and "E_SPEC" in codes
+
+
+def test_simulate_raises_admission_error_not_traceback():
+    c = _cluster()
+    dep = make_fake_deployment("web", replicas=2, match_labels={"app": "web"})
+    dep.template["metadata"]["labels"] = {"app": "other"}
+    app = ClusterResources()
+    app.deployments.append(dep)
+    with pytest.raises(AdmissionError) as ei:
+        simulate(c, [AppResource(name="a", resources=app)])
+    agg = ei.value
+    assert isinstance(agg, SimulationError)
+    assert agg.errors and agg.errors[0].code == "E_SELECTOR_CONFLICT"
+    assert "errors" in agg.to_dict()
+
+
+def test_simulator_api_validates():
+    from open_simulator_tpu.simulator import Simulator
+
+    sim = Simulator(_cluster())
+    sim.run_cluster()
+    dep = make_fake_deployment("web", replicas=1, match_labels={"app": "web"})
+    dep.template["metadata"]["labels"] = {"app": "nope"}
+    app = ClusterResources()
+    app.deployments.append(dep)
+    with pytest.raises(AdmissionError):
+        sim.schedule_app(AppResource(name="bad", resources=app))
+
+
+# ---- chaos injection --------------------------------------------------
+
+
+def test_chaos_kill_node_evicts_and_replaces():
+    c = _cluster(n=4, pods=6)
+    plan = ChaosPlan(events=[FaultEvent("kill_node", "n0")])
+    rep = run_chaos(c, plan)
+    step = rep.steps[0]
+    assert step.failed_nodes == ["n0"]
+    # every pod that sat on n0 was evicted; cluster has ample headroom, so
+    # every evicted pod is rescued elsewhere
+    assert set(step.replaced) == set(step.evicted_pods)
+    assert not step.lost_pods and step.unschedulable_delta == 0
+    assert step.capacity_lost["cpu"] == 4000.0  # 4 cores in millicores
+    assert step.active_nodes == 3
+    assert all(node != "n0" for node in step.replaced.values())
+
+
+def test_chaos_zone_outage_loses_pods_when_capacity_gone():
+    # 2 nodes per zone, pods sized so one zone cannot absorb the other
+    c = _cluster(n=4, cpu="2", pods=0)
+    c.pods = [make_fake_pod(f"p{i}", cpu="1") for i in range(7)]
+    plan = ChaosPlan(events=[FaultEvent("kill_zone", "z1")])
+    rep = run_chaos(c, plan)
+    step = rep.steps[0]
+    assert len(step.failed_nodes) == 2
+    # 7 cores demanded, 4 cores left (minus the pods already on z0)
+    assert step.unschedulable_after > rep.baseline_unschedulable
+    assert step.lost_pods
+
+
+def test_chaos_is_deterministic():
+    c = _cluster(n=5, pods=9)
+    plan = ChaosPlan(events=[FaultEvent("kill_node", "n1"),
+                             FaultEvent("kill_zone", "z0"),
+                             FaultEvent("drain_node", "n3")])
+    r1 = run_chaos(c, plan)
+    r2 = run_chaos(c, plan)
+    assert r1.to_dict() == r2.to_dict()
+
+
+def test_chaos_rescues_pinned_pods():
+    c = _cluster(n=3)
+    c.pods = [make_fake_pod("pinned", cpu="500m", node_name="n0"),
+              make_fake_pod("free", cpu="500m")]
+    rep = run_chaos(c, ChaosPlan(events=[FaultEvent("kill_node", "n0")]))
+    step = rep.steps[0]
+    assert "default/pinned" in step.evicted_pods
+    assert step.replaced.get("default/pinned") in ("n1", "n2")
+
+
+def test_chaos_unknown_target_is_structured():
+    c = _cluster()
+    with pytest.raises(SimulationError) as ei:
+        run_chaos(c, ChaosPlan(events=[FaultEvent("kill_node", "ghost")]))
+    assert ei.value.code == "E_SPEC" and "ghost" in str(ei.value)
+    with pytest.raises(SimulationError):
+        run_chaos(c, ChaosPlan(events=[FaultEvent("explode", "n0")]))
+
+
+def test_chaos_cli_end_to_end(tmp_path, capsys):
+    from open_simulator_tpu.cli.main import main
+
+    yaml_text = "\n---\n".join(
+        f"apiVersion: v1\nkind: Node\nmetadata:\n  name: n{i}\n"
+        f"  labels: {{topology.kubernetes.io/zone: z{i % 2}}}\n"
+        "status:\n  allocatable: {cpu: '4', memory: 8Gi, pods: '110'}"
+        for i in range(3)
+    ) + "\n---\n" + (
+        "apiVersion: v1\nkind: Pod\nmetadata: {name: p0, namespace: default}\n"
+        "spec:\n  nodeName: n0\n  containers:\n    - name: c\n"
+        "      resources: {requests: {cpu: 500m}}"
+    )
+    (tmp_path / "cluster.yaml").write_text(yaml_text)
+    rc = main(["chaos", "--cluster-config", str(tmp_path), "--kill-node", "n0"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "kill_node n0" in out and "1 evicted" in out
+    # structured CLI error for a bad target
+    rc = main(["chaos", "--cluster-config", str(tmp_path), "--kill-node", "ghost"])
+    err = capsys.readouterr().err
+    assert rc == 1 and "[E_SPEC]" in err
+
+
+# ---- retry + sweep trial isolation ------------------------------------
+
+
+def test_run_with_retries_backs_off():
+    sleeps = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert run_with_retries(flaky, retries=3, backoff_s=0.1,
+                            sleep=sleeps.append) == "ok"
+    assert sleeps == [0.1, 0.2]  # exponential
+    with pytest.raises(RuntimeError):
+        run_with_retries(lambda: (_ for _ in ()).throw(RuntimeError("hard")),
+                         retries=1, backoff_s=0.0, sleep=lambda s: None)
+
+
+def test_sweep_isolates_failing_trial(monkeypatch):
+    from open_simulator_tpu.engine.scheduler import make_config
+    from open_simulator_tpu.parallel import sweep as sweep_mod
+    from open_simulator_tpu.testing.synthetic import synthetic_snapshot
+
+    snap = synthetic_snapshot(n_nodes=4, n_pods=8, max_new=2)
+    cfg = make_config(snap)
+    n_real = snap.n_real_nodes
+    real_batched = sweep_mod.batched_schedule
+
+    def chaotic_batched(arrs, masks, cfg_, mesh=None):
+        if masks.shape[0] > 1:
+            raise RuntimeError("injected: batch lane crashed")
+        count = int(np.asarray(masks[0]).sum()) - n_real
+        if count == 1:
+            raise RuntimeError("injected: trial for count=1 keeps dying")
+        return real_batched(arrs, masks, cfg_, mesh=mesh)
+
+    monkeypatch.setattr(sweep_mod, "batched_schedule", chaotic_batched)
+    plan = sweep_mod.capacity_sweep(snap, cfg, [0, 1, 2], backoff_s=0.0)
+    # the poisoned trial is isolated; the others completed for real
+    assert list(plan.trial_errors) == [1]
+    assert "keeps dying" in plan.trial_errors[1]
+    assert plan.all_scheduled[0] and plan.all_scheduled[2]
+    assert not plan.satisfied[1] and not plan.all_scheduled[1]
+    assert plan.best_count == 0
+    # failed lane reports neutral occupancy, not garbage
+    assert plan.cpu_occupancy_pct[1] == 0.0
+
+
+def test_sweep_raises_when_every_trial_fails(monkeypatch):
+    from open_simulator_tpu.engine.scheduler import make_config
+    from open_simulator_tpu.parallel import sweep as sweep_mod
+    from open_simulator_tpu.testing.synthetic import synthetic_snapshot
+
+    snap = synthetic_snapshot(n_nodes=4, n_pods=8, max_new=2)
+    cfg = make_config(snap)
+
+    def dead_device(*a, **kw):
+        raise RuntimeError("device gone")
+
+    monkeypatch.setattr(sweep_mod, "batched_schedule", dead_device)
+    # systemic failure must surface, not return an all-failed plan
+    with pytest.raises(RuntimeError, match="all 2 sweep trials failed"):
+        sweep_mod.capacity_sweep(snap, cfg, [0, 1], backoff_s=0.0)
+
+
+def test_sweep_retry_recovers_transient_failure(monkeypatch):
+    from open_simulator_tpu.engine.scheduler import make_config
+    from open_simulator_tpu.parallel import sweep as sweep_mod
+    from open_simulator_tpu.testing.synthetic import synthetic_snapshot
+
+    snap = synthetic_snapshot(n_nodes=4, n_pods=8, max_new=2)
+    cfg = make_config(snap)
+    real_batched = sweep_mod.batched_schedule
+    calls = {"n": 0}
+
+    def flaky_batched(arrs, masks, cfg_, mesh=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient device hiccup")
+        return real_batched(arrs, masks, cfg_, mesh=mesh)
+
+    monkeypatch.setattr(sweep_mod, "batched_schedule", flaky_batched)
+    plan = sweep_mod.capacity_sweep(snap, cfg, [0, 1], backoff_s=0.0)
+    assert not plan.trial_errors  # retry absorbed the hiccup
+    assert plan.best_count == 0

@@ -256,6 +256,107 @@ def test_debug_stats_endpoint():
     assert stats["last_elapsed_s"] > 0
 
 
+def _read_error(ei):
+    return json.loads(ei.value.read())
+
+
+def test_oversized_payload_413():
+    """Bodies above the cap are rejected with a structured 413 before the
+    server reads them (resilience: hardened serving path)."""
+    httpd = ThreadingHTTPServer(
+        ("127.0.0.1", 0), _make_handler(SimulationServer(max_body_bytes=256)))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(url + "/api/deploy-apps",
+                  {"cluster": {"yaml": CLUSTER_YAML}, "apps": []})
+        assert ei.value.code == 413
+        body = _read_error(ei)
+        assert body["code"] == "E_PAYLOAD_TOO_LARGE"
+        assert body["hint"] and isinstance(body["error"], str)
+    finally:
+        httpd.shutdown()
+
+
+def test_invalid_spec_yields_validation_body_not_500(server_url):
+    """A malformed quantity in the inline cluster surfaces the structured
+    taxonomy (code/ref/field/hint), not a 500 traceback."""
+    bad = CLUSTER_YAML.replace('cpu: "8"', 'cpu: "8xyz"', 1)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server_url + "/api/deploy-apps",
+              {"cluster": {"yaml": bad}, "apps": []})
+    assert ei.value.code == 400
+    body = _read_error(ei)
+    assert body["code"] == "E_QUANTITY"
+    assert "8xyz" in body["error"] and body["hint"]
+
+
+def test_admission_error_body_lists_every_defect(server_url):
+    """Selector conflicts found by the admission pass come back as one
+    structured body with the per-defect error list."""
+    conflicted = CLUSTER_YAML.replace(
+        "selector: {matchLabels: {app: existing}}",
+        "selector: {matchLabels: {app: mismatch}}", 1)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server_url + "/api/deploy-apps",
+              {"cluster": {"yaml": conflicted}, "apps": []})
+    assert ei.value.code == 400
+    body = _read_error(ei)
+    assert body["code"] == "E_SELECTOR_CONFLICT"
+    assert any(e["code"] == "E_SELECTOR_CONFLICT" for e in body["errors"])
+
+
+def test_request_timeout_504():
+    srv = SimulationServer(request_timeout_s=0.05)
+
+    def glacial(body):
+        import time as _t
+
+        _t.sleep(0.4)
+        return {}
+
+    srv.deploy_apps = glacial
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _make_handler(srv))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(url + "/api/deploy-apps", {"apps": []})
+        assert ei.value.code == 504
+        assert _read_error(ei)["code"] == "E_TIMEOUT"
+    finally:
+        httpd.shutdown()
+
+
+def test_chaos_endpoint(server_url):
+    out = _post(server_url + "/api/chaos", {
+        "cluster": {"yaml": CLUSTER_YAML},
+        "plan": {"events": [{"kind": "kill_node", "target": "s0"}]},
+    })
+    assert out["total_pods"] == 2  # the existing deployment's pods
+    [step] = out["steps"]
+    assert step["failed_nodes"] == ["s0"]
+    assert step["active_nodes"] == 1
+    # ample headroom on s1: every evicted pod is rescued
+    assert set(step["replaced"]) == set(step["evicted_pods"])
+    # deterministic: a second identical request returns the same report
+    assert out == _post(server_url + "/api/chaos", {
+        "cluster": {"yaml": CLUSTER_YAML},
+        "plan": {"events": [{"kind": "kill_node", "target": "s0"}]},
+    })
+
+
+def test_chaos_endpoint_bad_plan(server_url):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server_url + "/api/chaos",
+              {"cluster": {"yaml": CLUSTER_YAML}, "plan": {"events": []}})
+    assert ei.value.code == 400
+    assert _read_error(ei)["code"] == "E_SPEC"
+
+
 def test_deploy_apps_reports_volume_bindings():
     """WFC claim -> PV choices surface in the REST response."""
     from open_simulator_tpu.server.rest import SimulationServer

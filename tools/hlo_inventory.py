@@ -1,21 +1,30 @@
-"""Dump the while-body instruction inventory for the rich north-star jit."""
+"""Dump the while-body instruction inventory for the rich north-star jit.
+
+Usage: python tools/hlo_inventory.py [N_NODES] [N_PODS] [LANES] [MAX_NEW]
+"""
 import os
 import re
 import sys
 from collections import Counter
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
 
-import __graft_entry__ as ge
 from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
 from open_simulator_tpu.parallel.sweep import active_masks_for_counts
+from open_simulator_tpu.testing.synthetic import synthetic_snapshot
 
-N_NODES, N_PODS, LANES, MAX_NEW = 512, 1024, 8, 8  # small: same op structure
 
-snap = ge._synthetic_snapshot(n_nodes=N_NODES, n_pods=N_PODS, max_new=MAX_NEW, rich=True)
+def _arg(i: int, default: int) -> int:
+    return int(sys.argv[i]) if len(sys.argv) > i else default
+
+
+# small defaults: same op structure as the north-star shape
+N_NODES, N_PODS, LANES, MAX_NEW = _arg(1, 512), _arg(2, 1024), _arg(3, 8), _arg(4, 8)
+
+snap = synthetic_snapshot(n_nodes=N_NODES, n_pods=N_PODS, max_new=MAX_NEW, rich=True)
 cfg = make_config(snap)._replace(fail_reasons=False)
 arrs = device_arrays(snap)
 counts = [min(i % (MAX_NEW + 1), MAX_NEW) for i in range(LANES)]

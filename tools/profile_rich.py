@@ -2,6 +2,8 @@
 
 Scratch tool (not part of the package): parses the device trace json
 directly because tensorboard_plugin_profile is version-incompatible here.
+
+Usage: python tools/profile_rich.py [N_NODES] [N_PODS] [LANES] [MAX_NEW]
 """
 import glob
 import gzip
@@ -11,20 +13,24 @@ import sys
 import time
 from collections import defaultdict
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
 
-import __graft_entry__ as ge
 from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
 from open_simulator_tpu.parallel.sweep import active_masks_for_counts
+from open_simulator_tpu.testing.synthetic import synthetic_snapshot
 
-N_NODES, N_PODS, LANES, MAX_NEW = int(sys.argv[1]) if len(sys.argv) > 1 else 5120, 51200, 64, 64
-N_NODES = 5120
-N_PODS = 51200
 
-snap = ge._synthetic_snapshot(n_nodes=N_NODES, n_pods=N_PODS, max_new=MAX_NEW, rich=True)
+def _arg(i: int, default: int) -> int:
+    return int(sys.argv[i]) if len(sys.argv) > i else default
+
+
+N_NODES, N_PODS, LANES, MAX_NEW = (
+    _arg(1, 5120), _arg(2, 51200), _arg(3, 64), _arg(4, 64))
+
+snap = synthetic_snapshot(n_nodes=N_NODES, n_pods=N_PODS, max_new=MAX_NEW, rich=True)
 cfg = make_config(snap)._replace(fail_reasons=False)
 arrs = device_arrays(snap)
 counts = [min(i % (MAX_NEW + 1), MAX_NEW) for i in range(LANES)]
@@ -54,7 +60,6 @@ for p in paths:
             continue
         name = ev.get("name", "")
         dur = ev.get("dur", 0)
-        # keep only device-side ops (pid names vary; filter by arg cat?)
         ev_by_name[name][0] += 1
         ev_by_name[name][1] += dur
         total_dur += dur

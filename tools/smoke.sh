@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Pre-PR smoke check: the tier-1 verify command (ROADMAP.md) plus one
+# chaos scenario end to end. Run as `make smoke` or `bash tools/smoke.sh`.
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== tier-1 test suite (ROADMAP.md verify command) =="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+  2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: tier-1 suite exited $rc" >&2
+  exit "$rc"
+fi
+
+echo
+echo "== chaos scenario end to end (kill one node + one zone) =="
+env JAX_PLATFORMS=cpu python -m open_simulator_tpu.cli chaos \
+  --cluster-config examples/cluster/demo \
+  --kill-node worker-a-0 --kill-zone zone-b
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: chaos scenario exited $rc" >&2
+  exit "$rc"
+fi
+
+echo
+echo "smoke OK"
