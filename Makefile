@@ -1,11 +1,16 @@
 # Developer entry points. `make smoke` is the documented pre-PR check:
-# the tier-1 verify command from ROADMAP.md plus one chaos scenario
-# end to end (tools/smoke.sh).
+# graftlint + the tier-1 verify command from ROADMAP.md plus one chaos
+# scenario end to end (tools/smoke.sh).
 
-.PHONY: test smoke bench
+.PHONY: test lint smoke bench
 
 test:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+
+# static trace-safety / engine-contract analysis (rules GL1-GL5);
+# exits nonzero on any finding — see ARCHITECTURE.md "graftlint"
+lint:
+	python -m open_simulator_tpu.cli lint
 
 smoke:
 	bash tools/smoke.sh

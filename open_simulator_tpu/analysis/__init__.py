@@ -1,0 +1,47 @@
+"""graftlint: repo-specific static trace-safety and engine-contract
+analysis for the scan scheduler.
+
+The reference simulator leans on Go's compiler and `go vet` to keep its
+scheduler honest; a JAX re-expression has neither, and the failure mode
+is worse — a half-wired refactor traces fine, compiles fine, and only
+explodes (or silently mis-simulates) when the exact gate combination
+that exercises the dead wiring runs. graftlint is the missing vet pass:
+pure-AST rules (GL1-GL5, catalog in ARCHITECTURE.md) that pin the
+engine's cross-layer contracts — xs leaves, partial-into-scan arity,
+config-flag liveness, trace safety, compact-carry dtypes — so `make
+lint` fails the tree at the same places `go vet` would have.
+
+Entry points: `run_lint()` here, `simon-tpu lint` on the CLI,
+`make lint` / tools/smoke.sh in the workflow, and
+tests/test_graftlint.py in tier-1.
+"""
+
+from open_simulator_tpu.analysis.findings import (
+    RULE_CODES,
+    LintError,
+    LintFinding,
+)
+from open_simulator_tpu.analysis.report import (
+    DEFAULT_PATHS,
+    assert_clean,
+    format_json,
+    format_rules,
+    format_text,
+    run_lint,
+)
+from open_simulator_tpu.analysis.rules import RULES, LintContext, Rule
+
+__all__ = [
+    "DEFAULT_PATHS",
+    "LintContext",
+    "LintError",
+    "LintFinding",
+    "RULES",
+    "RULE_CODES",
+    "Rule",
+    "assert_clean",
+    "format_json",
+    "format_rules",
+    "format_text",
+    "run_lint",
+]

@@ -1,0 +1,106 @@
+"""graftlint findings: the static-analysis twin of the SimulationError
+taxonomy (errors.py).
+
+A LintFinding is to `simon-tpu lint` what a SimulationError is to the
+simulator API: a machine-readable code, a precise location (file:line:col
+span), the offending symbol, and a remediation hint — so a broken
+refactor of the scan scheduler fails in CI with an actionable message
+instead of a trace-time TypeError three layers deep (or worse, silence).
+
+Rule codes (catalog in ARCHITECTURE.md "Static analysis: graftlint"):
+
+  GL0  suppression hygiene   a `# graftlint: disable=...` comment with no
+                             one-line justification
+  GL1  xs-leaf contract      scan-step `x["key"]` reads vs the encoded xs
+                             dict: reads of never-encoded leaves, encoded
+                             leaves nothing reads, leaves not backed by a
+                             SnapshotArrays field
+  GL2  partial/scan arity    functools.partial bindings flowing into
+                             lax.scan must satisfy the step signature
+  GL3  dead flags            config fields/properties (EngineConfig,
+                             ChaosPlan) never referenced outside their
+                             class definition
+  GL4  trace safety          host-sync Python (`if`/`while`/`bool()`/
+                             `.item()`/`float()`/`np.*`, bare loops over
+                             traced axes) on traced values inside
+                             jit/scan/vmap-scoped functions
+  GL5  dtype/carry hygiene   carry NamedTuple fields whose init dtype is
+                             conditional (e.g. the compact_carry bf16
+                             path) updated without an `.astype(...)`
+                             guard — silent-promotion hazard
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List
+
+from open_simulator_tpu.errors import SimulationError
+
+RULE_CODES = ("GL0", "GL1", "GL2", "GL3", "GL4", "GL5")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class LintFinding:
+    """One diagnostic: a rule code anchored to a file:line:col span."""
+
+    path: str       # repo-relative posix path
+    line: int       # 1-based
+    col: int        # 1-based (ast cols are 0-based; shifted at creation)
+    code: str       # "GL1".."GL5" (or "GL0" for suppression hygiene)
+    symbol: str     # offending name: xs leaf, field, function, ...
+    message: str
+    hint: str = ""
+    end_line: int = 0
+    end_col: int = 0
+
+    @property
+    def span(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def format(self) -> str:
+        out = f"{self.span}: {self.code} [{self.symbol}] {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "code": self.code, "path": self.path, "line": self.line,
+            "col": self.col, "end_line": self.end_line, "end_col": self.end_col,
+            "symbol": self.symbol, "message": self.message, "hint": self.hint,
+        }
+
+
+def finding_at(node, path: str, code: str, symbol: str, message: str,
+               hint: str = "") -> LintFinding:
+    """LintFinding anchored at an ast node (cols shifted to 1-based)."""
+    return LintFinding(
+        path=path, line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0) + 1, code=code, symbol=symbol,
+        message=message, hint=hint,
+        end_line=getattr(node, "end_lineno", 0) or 0,
+        end_col=(getattr(node, "end_col_offset", 0) or -1) + 1,
+    )
+
+
+class LintError(SimulationError):
+    """Raised by callers that want a failing lint to surface through the
+    structured-error path (CLI exit formatting, REST bodies)."""
+
+    code = "E_LINT"
+
+    def __init__(self, findings: List[LintFinding]):
+        self.findings = list(findings)
+        first = self.findings[0] if self.findings else None
+        msg = (f"{len(self.findings)} lint finding(s); first: {first.format()}"
+               if first else "lint failed")
+        super().__init__(
+            msg, ref=first.span if first else "",
+            field=first.symbol if first else "",
+            hint=first.hint if first else "")
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = super().to_dict()
+        out["findings"] = [f.to_dict() for f in self.findings]
+        return out

@@ -1,0 +1,107 @@
+"""graftlint driver + reporters.
+
+`run_lint` parses the product tree (never importing it), runs the rule
+registry, applies suppressions, and returns sorted findings. The text
+reporter mirrors the compiler-style `file:line:col: CODE message` shape;
+the json reporter feeds CI and the tier-1 enforcement test.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, List, Optional, Sequence
+
+from open_simulator_tpu.analysis.findings import LintError, LintFinding
+from open_simulator_tpu.analysis.rules import RULES, LintContext, Rule
+from open_simulator_tpu.analysis.walker import Module, iter_py_files
+
+# What `simon-tpu lint` checks by default: the product tree. Tests and
+# examples are exercised by pytest itself; fixtures under tests/fixtures/
+# are deliberately-broken lint corpora.
+DEFAULT_PATHS = ("open_simulator_tpu", "tools", "bench.py", "__graft_entry__.py")
+
+
+def repo_root() -> str:
+    """The repository root: two levels above this package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def load_modules(root: Optional[str] = None,
+                 paths: Optional[Sequence[str]] = None) -> List[Module]:
+    root = root or repo_root()
+    subpaths = tuple(paths) if paths else DEFAULT_PATHS
+    modules = []
+    for fp in iter_py_files(root, subpaths):
+        modules.append(Module.parse(fp, root))
+    return modules
+
+
+def apply_suppressions(modules: Iterable[Module],
+                       findings: Iterable[LintFinding]) -> List[LintFinding]:
+    by_rel = {m.rel: m for m in modules}
+    out = []
+    for f in findings:
+        m = by_rel.get(f.path)
+        if m is not None:
+            if m.file_suppressed(f.code):
+                continue
+            if f.line in m.suppressed_lines(f.code):
+                continue
+        out.append(f)
+    return out
+
+
+def run_lint(root: Optional[str] = None,
+             paths: Optional[Sequence[str]] = None,
+             rules: Optional[Sequence[Rule]] = None,
+             codes: Optional[Sequence[str]] = None) -> List[LintFinding]:
+    """Lint `paths` (repo-relative files/dirs) under `root`; returns the
+    surviving findings sorted by (path, line, code)."""
+    modules = load_modules(root, paths)
+    ctx = LintContext(modules=modules)
+    active = list(rules) if rules is not None else list(RULES)
+    if codes:
+        wanted = set(codes)
+        active = [r for r in active if r.code in wanted]
+    findings: List[LintFinding] = []
+    for rule in active:
+        findings.extend(rule.check(ctx))
+    return sorted(apply_suppressions(modules, findings))
+
+
+def assert_clean(root: Optional[str] = None,
+                 paths: Optional[Sequence[str]] = None,
+                 rules: Optional[Sequence[Rule]] = None,
+                 codes: Optional[Sequence[str]] = None) -> None:
+    """run_lint with exception semantics: raises LintError (code E_LINT,
+    structured findings payload) unless the tree is clean. The CLI exits
+    through this so lint failures ride the same structured-error path as
+    every other SimulationError surface."""
+    findings = run_lint(root=root, paths=paths, rules=rules, codes=codes)
+    if findings:
+        raise LintError(findings)
+
+
+def format_text(findings: Sequence[LintFinding]) -> str:
+    if not findings:
+        return "graftlint: clean (0 findings)"
+    lines = [f.format() for f in findings]
+    lines.append(f"graftlint: {len(findings)} finding(s)")
+    return "\n".join(lines)
+
+
+def format_json(findings: Sequence[LintFinding]) -> str:
+    return json.dumps({
+        "findings": [f.to_dict() for f in findings],
+        "count": len(findings),
+        "clean": not findings,
+    }, indent=2)
+
+
+def format_rules() -> str:
+    lines = ["graftlint rules:"]
+    for r in RULES:
+        lines.append(f"  {r.code}  {r.name:<24} {r.summary}")
+    return "\n".join(lines)

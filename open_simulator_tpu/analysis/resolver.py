@@ -1,0 +1,609 @@
+"""Scope/signature resolution for graftlint rules.
+
+Everything here is *static*: imports are resolved to dotted names via the
+module's own import statements, `functools.partial` chains are resolved
+to local `def`s, and `lax.scan` call sites are paired with the functions
+and xs dicts that flow into them. The resolution is repo-shaped by
+design — it understands the engine's conventions (`_pod_xs` builder
+returning a dict of `getattr(arrs, name)` leaves, `_live_xs_names`
+returning the gate-dependent live set, `SnapshotArrays` as the backing
+store) because those conventions ARE the contract the rules enforce.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from open_simulator_tpu.analysis.walker import Module, const_str, dotted_name
+
+# Parameter names treated as static (non-traced) by default in the GL4
+# taint pass: engine convention keeps hashable config under these names.
+DEFAULT_STATIC_PARAMS = {"self", "cfg", "config"}
+
+# Attribute reads that yield static Python values even on traced arrays.
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size"}
+
+# Host-sync method calls on a traced value.
+SYNC_METHODS = {"item", "tolist", "numpy", "block_until_ready"}
+
+
+def import_map(module: Module) -> Dict[str, str]:
+    """Local name -> dotted module path, from the file's own imports."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                out[alias.asname or alias.name] = f"{node.module}.{alias.name}"
+    return out
+
+
+def full_name(node: ast.AST, imports: Dict[str, str]) -> str:
+    """Dotted name of a call target with the leading alias expanded:
+    `jnp.zeros` -> `jax.numpy.zeros`, `partial` -> `functools.partial`."""
+    dotted = dotted_name(node)
+    if not dotted:
+        return ""
+    head, _, rest = dotted.partition(".")
+    base = imports.get(head, head)
+    return f"{base}.{rest}" if rest else base
+
+
+def is_scan(call: ast.Call, imports: Dict[str, str]) -> bool:
+    return full_name(call.func, imports).endswith("lax.scan")
+
+
+def is_partial(call: ast.Call, imports: Dict[str, str]) -> bool:
+    return full_name(call.func, imports) == "functools.partial"
+
+
+def module_defs(module: Module) -> Dict[str, ast.FunctionDef]:
+    """All defs by bare name (module-level first; later defs with the
+    same name shadow earlier, matching runtime lookup closely enough)."""
+    out: Dict[str, ast.FunctionDef] = {}
+    for fn in module.functions():
+        if module.enclosing_class(fn) is None:
+            out.setdefault(fn.name, fn)
+    return out
+
+
+# ---- signatures ---------------------------------------------------------
+
+
+@dataclass
+class Signature:
+    name: str
+    pos_params: List[str]        # posonly + regular, in order
+    n_defaults: int
+    kwonly: List[str]
+    kwonly_defaults: int
+    has_vararg: bool
+    has_kwarg: bool
+
+    @property
+    def min_positional(self) -> int:
+        return len(self.pos_params) - self.n_defaults
+
+    @property
+    def max_positional(self) -> Optional[int]:
+        return None if self.has_vararg else len(self.pos_params)
+
+
+def signature_of(fn: ast.AST) -> Signature:
+    a = fn.args
+    pos = [p.arg for p in getattr(a, "posonlyargs", [])] + [p.arg for p in a.args]
+    return Signature(
+        name=getattr(fn, "name", "<lambda>"),
+        pos_params=pos, n_defaults=len(a.defaults),
+        kwonly=[p.arg for p in a.kwonlyargs],
+        kwonly_defaults=sum(1 for d in a.kw_defaults if d is not None),
+        has_vararg=a.vararg is not None, has_kwarg=a.kwarg is not None,
+    )
+
+
+# ---- scan sites ---------------------------------------------------------
+
+
+@dataclass
+class ScanSite:
+    call: ast.Call                    # the lax.scan(...) call
+    enclosing: Optional[ast.AST]      # function the call sits in
+    step_def: Optional[ast.AST]       # resolved def/lambda, if local
+    n_bound: int                      # positional args partial pre-bound
+    bound_kw: Tuple[str, ...]         # keywords partial pre-bound
+    partial_node: Optional[ast.Call]  # the partial(...) call, if any
+    xs_expr: Optional[ast.AST]        # 3rd arg / xs= keyword
+
+    # By the partial-into-scan convention the step's trailing two
+    # positional params are ALWAYS (carry, x) — resolved positionally from
+    # the end, so GL1/GL5 keep working even while the partial's arity is
+    # wrong (the round-5 regression shape GL2 reports).
+
+    @property
+    def carry_param(self) -> Optional[str]:
+        sig = signature_of(self.step_def) if self.step_def is not None else None
+        if sig and len(sig.pos_params) >= 2:
+            return sig.pos_params[-2]
+        return None
+
+    @property
+    def x_param(self) -> Optional[str]:
+        sig = signature_of(self.step_def) if self.step_def is not None else None
+        if sig and len(sig.pos_params) >= 1:
+            return sig.pos_params[-1]
+        return None
+
+
+def _local_assignments(scope: ast.AST, name: str) -> List[ast.AST]:
+    """Values assigned to bare `name` anywhere inside `scope`."""
+    out = []
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    out.append(node.value)
+    return out
+
+
+def _resolve_step(expr: ast.AST, module: Module, imports: Dict[str, str],
+                  defs: Dict[str, ast.FunctionDef],
+                  enclosing: Optional[ast.AST]):
+    """(step_def, n_bound, bound_kw, partial_node) for a scan's f arg."""
+    seen: Set[str] = set()
+    while True:
+        if isinstance(expr, ast.Lambda):
+            return expr, 0, (), None
+        if isinstance(expr, ast.Call) and is_partial(expr, imports):
+            target = expr.args[0] if expr.args else None
+            inner = _resolve_step(target, module, imports, defs, enclosing)
+            if inner is None:
+                return None, 0, (), expr
+            step_def, n_inner, kw_inner, _ = inner
+            return (step_def, n_inner + len(expr.args) - 1,
+                    kw_inner + tuple(k.arg for k in expr.keywords if k.arg),
+                    expr)
+        if isinstance(expr, ast.Name):
+            if expr.id in seen:
+                return None, 0, (), None
+            seen.add(expr.id)
+            if expr.id in defs:
+                return defs[expr.id], 0, (), None
+            if enclosing is not None:
+                vals = _local_assignments(enclosing, expr.id)
+                if len(vals) == 1:
+                    expr = vals[0]
+                    continue
+            return None, 0, (), None
+        return None, 0, (), None
+
+
+def scan_sites(module: Module) -> List[ScanSite]:
+    imports = import_map(module)
+    defs = module_defs(module)
+    sites = []
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Call) and is_scan(node, imports)):
+            continue
+        enclosing = module.enclosing_function(node)
+        step_expr = node.args[0] if node.args else None
+        step_def, n_bound, bound_kw, pnode = _resolve_step(
+            step_expr, module, imports, defs, enclosing)
+        xs_expr = node.args[2] if len(node.args) > 2 else None
+        if xs_expr is None:
+            for kw in node.keywords:
+                if kw.arg == "xs":
+                    xs_expr = kw.value
+        sites.append(ScanSite(call=node, enclosing=enclosing,
+                              step_def=step_def, n_bound=n_bound,
+                              bound_kw=bound_kw, partial_node=pnode,
+                              xs_expr=xs_expr))
+    return sites
+
+
+# ---- xs production / consumption (GL1) ----------------------------------
+
+
+@dataclass
+class ProducedLeaf:
+    key: str
+    node: ast.AST          # where the key is introduced (finding anchor)
+    field_backed: bool     # produced via getattr(arrs, name) names list
+    explicit: bool         # produced via a `xs["k"] = ...` assignment
+
+
+def _string_list_vars(fn: ast.AST) -> Dict[str, List[Tuple[str, ast.AST]]]:
+    """name -> [(string, const_node)] for list-of-str assignments."""
+    out: Dict[str, List[Tuple[str, ast.AST]]] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, (ast.List, ast.Tuple)):
+            items = [(const_str(e), e) for e in node.value.elts]
+            if items and all(s is not None for s, _ in items):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = items  # type: ignore[assignment]
+    return out
+
+
+def _dict_builder_keys(fn: ast.AST) -> List[ProducedLeaf]:
+    """Keys produced by a dict-builder function (`_pod_xs` shape):
+    `{k: getattr(o, k) for k in names}` + literal keys + d["k"] assigns."""
+    leaves: List[ProducedLeaf] = []
+    str_lists = _string_list_vars(fn)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.DictComp):
+            # {k: getattr(obj, k) for k in names}
+            gen = node.generators[0] if node.generators else None
+            uses_getattr = (
+                isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id == "getattr")
+            if gen is not None and uses_getattr and isinstance(gen.iter, ast.Name):
+                for s, n in str_lists.get(gen.iter.id, []):
+                    leaves.append(ProducedLeaf(s, n, field_backed=True,
+                                               explicit=False))
+        elif isinstance(node, ast.Dict):
+            for k in node.keys:
+                s = const_str(k) if k is not None else None
+                if s is not None:
+                    leaves.append(ProducedLeaf(s, k, field_backed=False,
+                                               explicit=False))
+        elif isinstance(node, ast.Assign) and isinstance(node.targets[0], ast.Subscript):
+            sub = node.targets[0]
+            s = const_str(sub.slice)
+            if s is not None:
+                leaves.append(ProducedLeaf(s, node, field_backed=False,
+                                           explicit=False))
+    return leaves
+
+
+def produced_leaves(site: ScanSite, module: Module,
+                    defs: Dict[str, ast.FunctionDef]
+                    ) -> Optional[List[ProducedLeaf]]:
+    """Every xs key encoded for this scan site; None when the xs value is
+    opaque (a bare parameter, an expression we cannot resolve) — GL1 then
+    skips the site instead of flagging every read as unencoded."""
+    leaves: List[ProducedLeaf] = []
+    if not isinstance(site.xs_expr, ast.Name) or site.enclosing is None:
+        if isinstance(site.xs_expr, ast.Dict):
+            for k in site.xs_expr.keys:
+                s = const_str(k) if k is not None else None
+                if s is not None:
+                    leaves.append(ProducedLeaf(s, k, False, explicit=True))
+            return leaves
+        return None
+    xs_name = site.xs_expr.id
+    found_assign = False
+    for node in ast.walk(site.enclosing):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            if any(isinstance(t, ast.Name) and t.id == xs_name for t in targets):
+                found_assign = True
+                v = node.value
+                if isinstance(v, ast.Call) and isinstance(v.func, ast.Name) \
+                        and v.func.id in defs:
+                    leaves.extend(_dict_builder_keys(defs[v.func.id]))
+                elif isinstance(v, ast.Dict):
+                    # a literal xs dict at the scan site is an explicit
+                    # encode: unread keys are dead per-step slices
+                    for k in v.keys:
+                        s = const_str(k) if k is not None else None
+                        if s is not None:
+                            leaves.append(ProducedLeaf(s, k, False, True))
+                # dict-comprehension reassignment (the live filter) keeps keys
+            elif (isinstance(targets[0], ast.Subscript)
+                  and isinstance(targets[0].value, ast.Name)
+                  and targets[0].value.id == xs_name):
+                found_assign = True
+                s = const_str(targets[0].slice)
+                if s is not None:
+                    leaves.append(ProducedLeaf(s, node, field_backed=False,
+                                               explicit=True))
+    return leaves if found_assign else None
+
+
+def consumed_leaves(site: ScanSite) -> Dict[str, List[ast.AST]]:
+    """xs keys the step function reads: x["k"] subscripts + x.get("k")."""
+    out: Dict[str, List[ast.AST]] = {}
+    x_name = site.x_param
+    if site.step_def is None or x_name is None:
+        return out
+    for node in ast.walk(site.step_def):
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == x_name):
+            s = const_str(node.slice)
+            if s is not None:
+                out.setdefault(s, []).append(node)
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "get"
+              and isinstance(node.func.value, ast.Name)
+              and node.func.value.id == x_name and node.args):
+            s = const_str(node.args[0])
+            if s is not None:
+                out.setdefault(s, []).append(node)
+    return out
+
+
+def live_set_names(module: Module) -> Dict[str, ast.AST]:
+    """Leaf names declared live by a `_live_xs_names` function: every
+    string constant inside a set display or `.add(...)` call."""
+    defs = module_defs(module)
+    fn = defs.get("_live_xs_names")
+    out: Dict[str, ast.AST] = {}
+    if fn is None:
+        return out
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Set):
+            for e in node.elts:
+                s = const_str(e)
+                if s is not None:
+                    out.setdefault(s, e)
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "add" and node.args):
+            s = const_str(node.args[0])
+            if s is not None:
+                out.setdefault(s, node.args[0])
+    return out
+
+
+def class_fields(module: Module, class_name: str) -> Optional[Set[str]]:
+    """Annotated field names of a class, or None if the class is absent."""
+    for cls in module.classes():
+        if cls.name == class_name:
+            fields: Set[str] = set()
+            for stmt in cls.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                    fields.add(stmt.target.id)
+            return fields
+    return None
+
+
+# ---- traced-function discovery (GL4) ------------------------------------
+
+
+@dataclass
+class TracedFn:
+    fn: ast.AST                 # FunctionDef or Lambda
+    module: Module
+    static_params: Set[str]
+    evidence: str               # why we believe it traces
+
+
+def _decorator_static_argnames(dec: ast.Call) -> Set[str]:
+    for kw in dec.keywords:
+        if kw.arg in ("static_argnames", "static_argnums") \
+                and isinstance(kw.value, (ast.Tuple, ast.List)):
+            return {s for s in (const_str(e) for e in kw.value.elts)
+                    if s is not None}
+    return set()
+
+
+def traced_functions(module: Module) -> List[TracedFn]:
+    imports = import_map(module)
+    defs = module_defs(module)
+    found: Dict[ast.AST, TracedFn] = {}
+
+    def add(fn: ast.AST, evidence: str, extra_static: Set[str] = frozenset()):
+        if fn is None or fn in found:
+            return
+        static = set(DEFAULT_STATIC_PARAMS) | set(extra_static)
+        static |= module.static_params_for(fn)
+        found[fn] = TracedFn(fn=fn, module=module, static_params=static,
+                             evidence=evidence)
+
+    # decorated defs
+    for fn in module.functions():
+        for dec in fn.decorator_list:
+            if full_name(dec, imports) == "jax.jit":
+                add(fn, "jax.jit decorator")
+            elif isinstance(dec, ast.Call):
+                fname = full_name(dec.func, imports)
+                if fname == "jax.jit":
+                    add(fn, "jax.jit decorator", _decorator_static_argnames(dec))
+                elif fname == "functools.partial" and dec.args and \
+                        full_name(dec.args[0], imports) == "jax.jit":
+                    add(fn, "partial(jax.jit) decorator",
+                        _decorator_static_argnames(dec))
+
+    # scan steps (through partials)
+    for site in scan_sites(module):
+        if site.step_def is not None:
+            add(site.step_def, "lax.scan step")
+
+    # functions/lambdas passed to jax.jit / jax.vmap / pmap
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = full_name(node.func, imports)
+        if fname not in ("jax.jit", "jax.vmap", "jax.pmap"):
+            continue
+        for arg in node.args[:1]:
+            if isinstance(arg, ast.Lambda):
+                add(arg, f"{fname} argument")
+            elif isinstance(arg, ast.Name) and arg.id in defs:
+                add(defs[arg.id], f"{fname} argument")
+            elif isinstance(arg, ast.Call) and is_partial(arg, imports) \
+                    and arg.args and isinstance(arg.args[0], ast.Name) \
+                    and arg.args[0].id in defs:
+                add(defs[arg.args[0].id], f"partial into {fname}")
+    return list(found.values())
+
+
+# ---- taint engine (GL4) -------------------------------------------------
+
+
+@dataclass
+class HostSync:
+    node: ast.AST
+    kind: str      # short description of the host-sync construct
+    symbol: str
+
+
+class TaintChecker:
+    """Flow-insensitive, monotone taint over one traced function.
+
+    Parameters (minus the static set) seed the taint; assignments
+    propagate it; `.shape`/`.dtype`-style reads, `is`/`in` comparisons
+    and container displays launder it (documented heuristics — a linter
+    for THIS repo's idioms, not a sound dataflow analysis). Sinks are
+    the Python constructs that force a concrete value out of a tracer.
+    """
+
+    def __init__(self, traced: TracedFn, imports: Dict[str, str]):
+        self.fn = traced.fn
+        self.imports = imports
+        self.tainted: Set[str] = set()
+        params = signature_of(traced.fn)
+        for p in (params.pos_params + params.kwonly):
+            if p not in traced.static_params:
+                self.tainted.add(p)
+        va = traced.fn.args.vararg
+        if va is not None:
+            self.tainted.add(va.arg)
+
+    # -- expression taint --
+
+    def taint(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.taint(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.taint(node.value) or self.taint(node.slice)
+        if isinstance(node, ast.Call):
+            fname = full_name(node.func, self.imports)
+            if fname in ("len", "range", "int", "float", "bool", "enumerate",
+                         "zip", "isinstance", "type", "min", "max"):
+                # host-returning builtins; tainted args are sink-checked
+                if fname in ("min", "max", "zip", "enumerate"):
+                    return any(self.taint(a) for a in node.args)
+                return False
+            parts = [self.taint(a) for a in node.args]
+            parts += [self.taint(k.value) for k in node.keywords]
+            if isinstance(node.func, ast.Attribute):
+                parts.append(self.taint(node.func.value))
+            return any(parts)
+        if isinstance(node, ast.BinOp):
+            return self.taint(node.left) or self.taint(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.taint(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.taint(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            host_ops = (ast.Is, ast.IsNot, ast.In, ast.NotIn)
+            if all(isinstance(op, host_ops) for op in node.ops):
+                return False
+            return self.taint(node.left) or any(self.taint(c) for c in node.comparators)
+        if isinstance(node, ast.IfExp):
+            return self.taint(node.body) or self.taint(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set, ast.Dict,
+                             ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            return False  # container truthiness is host-safe
+        if isinstance(node, ast.Starred):
+            return self.taint(node.value)
+        if isinstance(node, (ast.Slice,)):
+            return any(self.taint(p) for p in
+                       (node.lower, node.upper, node.step) if p is not None)
+        if isinstance(node, ast.JoinedStr):
+            return False
+        if isinstance(node, ast.Lambda):
+            return False
+        return False
+
+    # -- propagation --
+
+    def _assign_target(self, target: ast.AST, is_tainted: bool) -> None:
+        if not is_tainted:
+            return
+        if isinstance(target, ast.Name):
+            self.tainted.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._assign_target(e, True)
+        elif isinstance(target, ast.Starred):
+            self._assign_target(target.value, True)
+
+    def propagate_once(self) -> int:
+        before = len(self.tainted)
+        for node in ast.walk(self.fn):
+            if isinstance(node, ast.Assign):
+                t = self.taint(node.value)
+                for tgt in node.targets:
+                    self._assign_target(tgt, t)
+            elif isinstance(node, ast.AugAssign):
+                if self.taint(node.value) or self.taint(node.target):
+                    self._assign_target(node.target, True)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                self._assign_target(node.target, self.taint(node.value))
+            elif isinstance(node, ast.For):
+                # iterating a traced array yields traced rows
+                self._assign_target(node.target, self.taint(node.iter))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node is not self.fn:
+                    # nested defs close over the scope; conservatively
+                    # treat their params as traced
+                    for p in node.args.args:
+                        self.tainted.add(p.arg)
+        return len(self.tainted) - before
+
+    # -- sinks --
+
+    def find_syncs(self) -> List[HostSync]:
+        for _ in range(10):
+            if self.propagate_once() == 0:
+                break
+        out: List[HostSync] = []
+
+        def emit(node, kind, symbol):
+            out.append(HostSync(node=node, kind=kind, symbol=symbol))
+
+        for node in ast.walk(self.fn):
+            if isinstance(node, (ast.If, ast.While)) and self.taint(node.test):
+                kw = "if" if isinstance(node, ast.If) else "while"
+                emit(node.test, f"Python `{kw}` on a traced value", kw)
+            elif isinstance(node, ast.IfExp) and self.taint(node.test):
+                emit(node.test, "conditional expression on a traced value",
+                     "ifexp")
+            elif isinstance(node, ast.Assert) and self.taint(node.test):
+                emit(node.test, "assert on a traced value", "assert")
+            elif isinstance(node, ast.BoolOp) and \
+                    any(self.taint(v) for v in node.values[:-1]):
+                emit(node, "and/or forces bool() of a traced value", "boolop")
+            elif isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not) \
+                    and self.taint(node.operand):
+                emit(node, "`not` forces bool() of a traced value", "not")
+            if isinstance(node, ast.For) and self.taint(node.iter):
+                emit(node.iter, "bare Python loop over a traced value", "for")
+            if not isinstance(node, ast.Call):
+                continue
+            fname = full_name(node.func, self.imports)
+            if fname in ("bool", "float", "int") and \
+                    any(self.taint(a) for a in node.args):
+                emit(node, f"host conversion `{fname}()` of a traced value",
+                     fname)
+            elif fname == "range" and any(self.taint(a) for a in node.args):
+                emit(node, "Python loop bound derived from a traced value",
+                     "range")
+            elif fname.startswith("numpy.") and (
+                    any(self.taint(a) for a in node.args)
+                    or any(self.taint(k.value) for k in node.keywords)):
+                emit(node, f"`{fname}` call on a traced value (host sync)",
+                     fname.replace("numpy.", "np."))
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in SYNC_METHODS \
+                    and self.taint(node.func.value):
+                emit(node, f"`.{node.func.attr}()` on a traced value",
+                     node.func.attr)
+        return out

@@ -1,0 +1,447 @@
+"""graftlint rule registry: GL0-GL5.
+
+Each rule is a function over a LintContext (every parsed module) that
+yields LintFindings with precise spans and remediation hints. The rules
+encode THIS repo's engine contracts — the xs-leaf protocol between
+`_pod_xs`/`_live_xs_names` and the scan step, the partial-into-scan
+calling convention, the gate-flag lifecycle, trace safety inside
+jit/scan scope, and the compact-carry dtype discipline. See
+ARCHITECTURE.md "Static analysis: graftlint" for the catalog and the
+round-5 incident each rule is pinned to.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from open_simulator_tpu.analysis.findings import LintFinding, finding_at
+from open_simulator_tpu.analysis.resolver import (
+    TaintChecker,
+    class_fields,
+    consumed_leaves,
+    import_map,
+    live_set_names,
+    module_defs,
+    produced_leaves,
+    scan_sites,
+    signature_of,
+    traced_functions,
+)
+from open_simulator_tpu.analysis.walker import Module
+
+# xs keys the engine introduces host-side (not SnapshotArrays-backed) and
+# keys whose underscore prefix marks them internal to the scan protocol.
+_INTERNAL_LEAF_PREFIX = "_"
+
+# Config-like classes whose fields/properties GL3 audits for deadness.
+DEAD_FLAG_CLASSES = ("EngineConfig", "ChaosPlan")
+
+# The dataclass that must back every field-derived xs leaf (GL1c).
+BACKING_CLASS = "SnapshotArrays"
+
+
+@dataclass
+class LintContext:
+    modules: List[Module]
+    dead_flag_classes: Tuple[str, ...] = DEAD_FLAG_CLASSES
+    backing_class: str = BACKING_CLASS
+
+    def backing_fields(self, prefer: Module) -> Optional[Set[str]]:
+        """Field set of the backing class: module-local first (fixtures
+        carry their own miniature SnapshotArrays), then repo-wide."""
+        local = class_fields(prefer, self.backing_class)
+        if local is not None:
+            return local
+        for m in self.modules:
+            fields = class_fields(m, self.backing_class)
+            if fields is not None:
+                return fields
+        return None
+
+
+@dataclass
+class Rule:
+    code: str
+    name: str
+    summary: str
+    check: Callable[[LintContext], List[LintFinding]]
+
+
+# ---- GL0: suppression hygiene -------------------------------------------
+
+
+def check_gl0(ctx: LintContext) -> List[LintFinding]:
+    out = []
+    for m in ctx.modules:
+        for d in m.unjustified_directives():
+            out.append(LintFinding(
+                path=m.rel, line=d.line, col=1, code="GL0",
+                symbol=",".join(d.codes),
+                message="suppression without a justification",
+                hint="append a one-line reason: "
+                     "# graftlint: disable=GLn <why this is safe>"))
+    return out
+
+
+# ---- GL1: xs-leaf contract ----------------------------------------------
+
+
+def check_gl1(ctx: LintContext) -> List[LintFinding]:
+    out: List[LintFinding] = []
+    for m in ctx.modules:
+        sites = scan_sites(m)
+        if not sites:
+            continue
+        defs = module_defs(m)
+        live = live_set_names(m)
+        backing = ctx.backing_fields(m)
+        for site in sites:
+            produced = produced_leaves(site, m, defs)
+            if produced is None:  # opaque xs (bare param): nothing to check
+                continue
+            produced_keys = {p.key for p in produced}
+            consumed = consumed_leaves(site)
+            step_name = getattr(site.step_def, "name", "<step>")
+
+            # (a) read but never encoded — the round-5 gcr_gid/gcr_key bug
+            for key, nodes in consumed.items():
+                if key not in produced_keys:
+                    out.append(finding_at(
+                        nodes[0], m.rel, "GL1", key,
+                        f"scan step `{step_name}` reads xs leaf {key!r} "
+                        "that is never encoded into the xs dict",
+                        hint="encode it where the scan's xs are built "
+                             "(xs[{!r}] = ...) or add it to the _pod_xs "
+                             "names list".format(key)))
+
+            # (b) encoded/declared-live but never read
+            for p in produced:
+                if p.explicit and p.key not in consumed:
+                    out.append(finding_at(
+                        p.node, m.rel, "GL1", p.key,
+                        f"xs leaf {p.key!r} is encoded for the scan but "
+                        f"`{step_name}` never reads it",
+                        hint="drop the dead encode (it costs a per-step "
+                             "slice) or wire the read it was meant for"))
+            for key, node in live.items():
+                if key not in consumed:
+                    out.append(finding_at(
+                        node, m.rel, "GL1", key,
+                        f"xs leaf {key!r} is declared live by "
+                        f"_live_xs_names but `{step_name}` never reads it",
+                        hint="remove it from the live set (dead leaves are "
+                             "sliced every scan step) or add the missing "
+                             "x[{!r}] consumer".format(key)))
+                if key not in produced_keys and not key.startswith(
+                        _INTERNAL_LEAF_PREFIX):
+                    out.append(finding_at(
+                        node, m.rel, "GL1", key,
+                        f"xs leaf {key!r} is declared live but nothing "
+                        "produces it",
+                        hint="add it to the _pod_xs names list or encode "
+                             "it explicitly before the scan"))
+
+            # (c) field-backed leaves must exist on SnapshotArrays
+            if backing is not None:
+                seen: Set[str] = set()
+                for p in produced:
+                    if p.field_backed and p.key not in backing \
+                            and p.key not in seen \
+                            and not p.key.startswith(_INTERNAL_LEAF_PREFIX):
+                        seen.add(p.key)
+                        out.append(finding_at(
+                            p.node, m.rel, "GL1", p.key,
+                            f"xs leaf {p.key!r} is not backed by a "
+                            f"{ctx.backing_class} field",
+                            hint=f"add the array to {ctx.backing_class} "
+                                 "(encode layer) or remove the stale name"))
+    return out
+
+
+# ---- GL2: partial/scan arity --------------------------------------------
+
+
+def check_gl2(ctx: LintContext) -> List[LintFinding]:
+    out: List[LintFinding] = []
+    for m in ctx.modules:
+        for site in scan_sites(m):
+            if site.step_def is None or isinstance(site.step_def, ast.Lambda):
+                if isinstance(site.step_def, ast.Lambda):
+                    sig = signature_of(site.step_def)
+                    if len(sig.pos_params) != 2 and not sig.has_vararg:
+                        out.append(finding_at(
+                            site.call, m.rel, "GL2", "<lambda>",
+                            f"lax.scan step lambda takes "
+                            f"{len(sig.pos_params)} args; scan passes "
+                            "exactly 2 (carry, x)",
+                            hint="bind extra operands with functools."
+                                 "partial or close over them"))
+                continue
+            sig = signature_of(site.step_def)
+            anchor = site.partial_node or site.call
+            bad_kw = [k for k in site.bound_kw
+                      if k not in sig.pos_params and k not in sig.kwonly
+                      and not sig.has_kwarg]
+            for k in bad_kw:
+                out.append(finding_at(
+                    anchor, m.rel, "GL2", sig.name,
+                    f"partial binds keyword {k!r} that `{sig.name}` "
+                    "does not accept",
+                    hint=f"check the step signature: {sig.name}"
+                         f"({', '.join(sig.pos_params)})"))
+            # positional accounting: partial-bound + the 2 scan supplies
+            kw_hitting_pos = sum(1 for k in site.bound_kw
+                                 if k in sig.pos_params)
+            supplied = site.n_bound + 2 + kw_hitting_pos
+            sig_str = f"{sig.name}({', '.join(sig.pos_params)})"
+            if supplied < sig.min_positional:
+                # partial binds the LEADING params; scan fills the trailing
+                # (carry, x) pair — so the unbound ones sit in between
+                n_lead = site.n_bound + kw_hitting_pos
+                missing = [p for p in
+                           sig.pos_params[n_lead:sig.min_positional - 2]
+                           if p not in site.bound_kw]
+                out.append(finding_at(
+                    anchor, m.rel, "GL2", sig.name,
+                    f"scan step `{sig.name}` takes {sig.min_positional} "
+                    f"required args but only {supplied} are supplied "
+                    f"({site.n_bound + kw_hitting_pos} bound by partial "
+                    "+ 2 from scan) — this TypeErrors at trace time",
+                    hint=f"bind the missing operand(s) "
+                         f"{', '.join(missing) or '?'} in the partial; "
+                         f"signature: {sig_str}"))
+            elif sig.max_positional is not None and supplied > sig.max_positional:
+                out.append(finding_at(
+                    anchor, m.rel, "GL2", sig.name,
+                    f"scan step `{sig.name}` accepts at most "
+                    f"{sig.max_positional} positional args but {supplied} "
+                    "are supplied "
+                    f"({site.n_bound + kw_hitting_pos} bound by partial "
+                    "+ 2 from scan)",
+                    hint=f"drop the extra partial binding(s); "
+                         f"signature: {sig_str}"))
+    return out
+
+
+# ---- GL3: dead config flags ---------------------------------------------
+
+
+def check_gl3(ctx: LintContext) -> List[LintFinding]:
+    out: List[LintFinding] = []
+    # target classes and their members
+    targets: List[Tuple[Module, ast.ClassDef]] = []
+    for m in ctx.modules:
+        for cls in m.classes():
+            if cls.name in ctx.dead_flag_classes:
+                targets.append((m, cls))
+    if not targets:
+        return out
+
+    for m, cls in targets:
+        members: Dict[str, ast.AST] = {}
+        prop_bodies: Dict[str, ast.AST] = {}
+        for stmt in cls.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                if not stmt.target.id.startswith("_"):
+                    members[stmt.target.id] = stmt
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                is_prop = any(
+                    isinstance(d, ast.Name) and d.id == "property"
+                    for d in stmt.decorator_list)
+                if is_prop and not stmt.name.startswith("_"):
+                    members[stmt.name] = stmt
+                    prop_bodies[stmt.name] = stmt
+        if not members:
+            continue
+
+        # external references: any attribute load of a member name outside
+        # this class's body (constructor keywords / _replace() are writes
+        # and deliberately do NOT count — a set-but-never-read flag is dead)
+        external: Set[str] = set()
+        intra: Dict[str, Set[str]] = {name: set() for name in members}
+        lo, hi = cls.lineno, cls.end_lineno or cls.lineno
+        for mod in ctx.modules:
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Attribute)
+                        and node.attr in members):
+                    continue
+                inside = (mod is m and lo <= getattr(node, "lineno", 0) <= hi)
+                if not inside:
+                    external.add(node.attr)
+                    continue
+                encl = mod.enclosing_function(node)
+                if encl is not None and getattr(encl, "name", "") in prop_bodies:
+                    intra[getattr(encl, "name")].add(node.attr)
+
+        # fixpoint: a member read by an externally-alive property is alive
+        alive = set(external) & set(members)
+        changed = True
+        while changed:
+            changed = False
+            for prop, reads in intra.items():
+                if prop in alive:
+                    new = (reads & set(members)) - alive
+                    if new:
+                        alive |= new
+                        changed = True
+
+        for name, node in sorted(members.items()):
+            if name not in alive:
+                kind = "property" if name in prop_bodies else "field"
+                out.append(finding_at(
+                    node, m.rel, "GL3", f"{cls.name}.{name}",
+                    f"{kind} `{cls.name}.{name}` is never read outside "
+                    "its definition (dead flag)",
+                    hint="delete it, or wire the feature it was meant to "
+                         "gate; if it is intentional public API, suppress "
+                         "with # graftlint: disable=GL3 <why>"))
+    return out
+
+
+# ---- GL4: trace safety --------------------------------------------------
+
+
+def check_gl4(ctx: LintContext) -> List[LintFinding]:
+    out: List[LintFinding] = []
+    for m in ctx.modules:
+        imports = import_map(m)
+        for traced in traced_functions(m):
+            name = getattr(traced.fn, "name", "<lambda>")
+            for sync in TaintChecker(traced, imports).find_syncs():
+                out.append(finding_at(
+                    sync.node, m.rel, "GL4", sync.symbol,
+                    f"{sync.kind} inside traced function `{name}` "
+                    f"({traced.evidence})",
+                    hint="hoist the host computation out of jit/scan "
+                         "scope, use lax/jnp primitives, or mark a truly "
+                         "static parameter with "
+                         "# graftlint: static=<param> on the def"))
+    return out
+
+
+# ---- GL5: dtype & carry hygiene -----------------------------------------
+
+
+def _conditional_dtype_fields(m: Module) -> Dict[str, List[str]]:
+    """carry-class name -> fields whose init dtype is an IfExp-assigned
+    variable (the compact_carry bf16|f32 pattern)."""
+    class_names = {c.name for c in m.classes()}
+    out: Dict[str, List[str]] = {}
+    for fn in module_defs(m).values():
+        cond_vars: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.IfExp):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        cond_vars.add(t.id)
+        if not cond_vars:
+            continue
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                    and node.func.id in class_names):
+                continue
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue
+                names = {n.id for n in ast.walk(kw.value)
+                         if isinstance(n, ast.Name)}
+                if names & cond_vars:
+                    out.setdefault(node.func.id, []).append(kw.arg)
+    return out
+
+
+def _mentions(node: ast.AST, carry: str, fld: str, aliases: Set[str]) -> bool:
+    """Direct mention of the carry field: state.F, an alias name, or a
+    subscript of either."""
+    if isinstance(node, ast.Attribute):
+        return (node.attr == fld and isinstance(node.value, ast.Name)
+                and node.value.id == carry)
+    if isinstance(node, ast.Name):
+        return node.id in aliases
+    if isinstance(node, ast.Subscript):
+        return _mentions(node.value, carry, fld, aliases)
+    return False
+
+
+def _is_astype(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype")
+
+
+def check_gl5(ctx: LintContext) -> List[LintFinding]:
+    out: List[LintFinding] = []
+    for m in ctx.modules:
+        poly = _conditional_dtype_fields(m)
+        if not poly:
+            continue
+        for site in scan_sites(m):
+            if site.step_def is None or isinstance(site.step_def, ast.Lambda):
+                continue
+            carry = site.carry_param
+            if carry is None:
+                continue
+            step_name = getattr(site.step_def, "name", "<step>")
+            for cls_name, fields in poly.items():
+                for fld in sorted(set(fields)):
+                    aliases: Set[str] = set()
+                    for node in ast.walk(site.step_def):
+                        if isinstance(node, ast.Assign):
+                            v = node.value
+                            cands = [v]
+                            if isinstance(v, ast.IfExp):
+                                cands = [v.body, v.orelse]
+                            if any(_mentions(c, carry, fld, set()) for c in cands):
+                                for t in node.targets:
+                                    if isinstance(t, ast.Name):
+                                        aliases.add(t.id)
+                    for node in ast.walk(site.step_def):
+                        if not (isinstance(node, ast.BinOp) and isinstance(
+                                node.op, (ast.Add, ast.Sub, ast.Mult))):
+                            continue
+                        left_m = _mentions(node.left, carry, fld, aliases)
+                        right_m = _mentions(node.right, carry, fld, aliases)
+                        if left_m == right_m:  # neither, or field+field
+                            continue
+                        other = node.right if left_m else node.left
+                        if _is_astype(other) or isinstance(other, ast.Constant):
+                            continue
+                        out.append(finding_at(
+                            node, m.rel, "GL5", f"{cls_name}.{fld}",
+                            f"carry field `{fld}` has a conditional init "
+                            f"dtype but `{step_name}` updates it without "
+                            "an .astype(...) guard — the carry can "
+                            "silently promote (bf16 -> f32) and break the "
+                            "scan dtype contract",
+                            hint="wrap the added term in .astype("
+                                 f"state.{fld}.dtype) like the other "
+                                 "compact-carry updates"))
+    return out
+
+
+RULES: List[Rule] = [
+    Rule("GL0", "suppression-hygiene",
+         "graftlint suppressions must carry a one-line justification",
+         check_gl0),
+    Rule("GL1", "xs-leaf-contract",
+         "scan-step x[...] reads and the encoded xs dict must agree, and "
+         "field-derived leaves must exist on SnapshotArrays",
+         check_gl1),
+    Rule("GL2", "partial-scan-arity",
+         "functools.partial bindings into lax.scan must satisfy the step "
+         "function's signature",
+         check_gl2),
+    Rule("GL3", "dead-flags",
+         "EngineConfig/ChaosPlan fields and properties must be read "
+         "somewhere outside their definition",
+         check_gl3),
+    Rule("GL4", "trace-safety",
+         "no host-sync Python (if/while/bool()/float()/.item()/np.*) on "
+         "traced values inside jit/scan/vmap scope",
+         check_gl4),
+    Rule("GL5", "dtype-carry-hygiene",
+         "conditional-dtype carry fields must be updated through "
+         ".astype(...) guards",
+         check_gl5),
+]

@@ -1,0 +1,199 @@
+"""AST walking layer: parsed modules, parent links, suppressions.
+
+graftlint never imports the code it checks — everything below is
+`ast.parse` over source text, so linting the engine costs milliseconds
+and cannot trip XLA, device init, or import-time side effects.
+
+Suppression grammar (one directive per comment):
+
+    # graftlint: disable=GL4 reading a host scalar is intended here
+    # graftlint: disable=GL1,GL3 <why>
+    # graftlint: disable-file=GL4 <why>
+
+`disable` applies to findings on the same line, or — when the comment
+is a standalone line — to the next non-blank, non-comment line.
+`disable-file` applies to the whole file for the listed codes. A
+directive with no justification text is itself reported (GL0): a
+suppression is a reviewed exception, and the review belongs in the code.
+
+Static-parameter annotation (consumed by the GL4 taint pass):
+
+    # graftlint: static=cfg,gcr_seg
+
+placed on (or directly under) a `def` line, naming parameters that hold
+static Python values (hashable config, slice plans) rather than traced
+arrays.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+_DIRECTIVE_RE = re.compile(
+    r"#\s*graftlint:\s*(disable-file|disable|static)=([\w,]+)\s*(.*)$")
+
+
+@dataclass
+class Directive:
+    kind: str            # "disable" | "disable-file" | "static"
+    codes: Tuple[str, ...]   # rule codes (or param names for "static")
+    reason: str
+    line: int            # 1-based line the comment sits on
+    standalone: bool     # comment is the whole line
+
+
+@dataclass
+class Module:
+    """One parsed source file plus the lookaside tables every rule needs."""
+
+    path: str                  # absolute
+    rel: str                   # repo-relative posix path (finding spans)
+    source: str
+    tree: ast.Module
+    directives: List[Directive] = field(default_factory=list)
+    parents: Dict[ast.AST, ast.AST] = field(default_factory=dict)
+
+    # ---- construction --------------------------------------------------
+
+    @classmethod
+    def parse(cls, path: str, root: str) -> "Module":
+        with tokenize.open(path) as f:   # honors PEP-263 encodings
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        mod = cls(path=path, rel=rel, source=source, tree=tree)
+        mod._link_parents()
+        mod._scan_directives()
+        return mod
+
+    def _link_parents(self) -> None:
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+
+    def _scan_directives(self) -> None:
+        for i, text in enumerate(self.source.splitlines(), start=1):
+            m = _DIRECTIVE_RE.search(text)
+            if not m:
+                continue
+            kind, codes, reason = m.group(1), m.group(2), m.group(3).strip()
+            self.directives.append(Directive(
+                kind=kind,
+                codes=tuple(c.strip() for c in codes.split(",") if c.strip()),
+                reason=reason, line=i,
+                standalone=text.lstrip().startswith("#"),
+            ))
+
+    # ---- suppression resolution ---------------------------------------
+
+    def suppressed_lines(self, code: str) -> Set[int]:
+        """Lines on which findings of `code` are suppressed."""
+        lines = self.source.splitlines()
+        out: Set[int] = set()
+        for d in self.directives:
+            if d.kind != "disable" or code not in d.codes:
+                continue
+            out.add(d.line)
+            if d.standalone:
+                # the directive governs the next real code line
+                j = d.line  # 1-based index of the comment line itself
+                while j < len(lines):
+                    nxt = lines[j].strip()
+                    j += 1
+                    if nxt and not nxt.startswith("#"):
+                        out.add(j)
+                        break
+        return out
+
+    def file_suppressed(self, code: str) -> bool:
+        return any(d.kind == "disable-file" and code in d.codes
+                   for d in self.directives)
+
+    def unjustified_directives(self) -> List[Directive]:
+        return [d for d in self.directives
+                if d.kind in ("disable", "disable-file") and not d.reason]
+
+    # ---- scope helpers -------------------------------------------------
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def functions(self) -> Iterator[ast.FunctionDef]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    def classes(self) -> Iterator[ast.ClassDef]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                yield node
+
+    def static_params_for(self, fn: ast.AST) -> Set[str]:
+        """Parameter names a `# graftlint: static=a,b` directive marks
+        static for this def (directive on the def line or inside the
+        def's first three lines)."""
+        lo = getattr(fn, "lineno", 0)
+        body = getattr(fn, "body", None)  # stmt list for defs, expr for lambdas
+        first = body[0] if isinstance(body, list) and body else body
+        hi = getattr(first, "lineno", lo) + 2
+        out: Set[str] = set()
+        for d in self.directives:
+            if d.kind == "static" and lo <= d.line <= hi:
+                out |= set(d.codes)
+        return out
+
+
+# ---- small expression utilities shared by resolver/rules ----------------
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.lax.scan' for nested Attribute/Name chains, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def iter_py_files(root: str, subpaths: Tuple[str, ...]) -> Iterator[str]:
+    """Yield .py files under root restricted to `subpaths` (files or
+    directories, repo-relative)."""
+    for sp in subpaths:
+        full = os.path.join(root, sp)
+        if os.path.isfile(full) and full.endswith(".py"):
+            yield full
+        elif os.path.isdir(full):
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in ("__pycache__", ".git"))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)

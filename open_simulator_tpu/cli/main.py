@@ -91,6 +91,30 @@ def build_parser() -> argparse.ArgumentParser:
     mg.add_argument("--cluster-config", required=True, help="cluster YAML dir (with placed pods)")
     mg.add_argument("--output-file", default="")
 
+    lt = sub.add_parser(
+        "lint",
+        help="run graftlint: repo-specific static trace-safety and "
+             "engine-contract analysis (rules GL1-GL5)",
+        description="graftlint: pure-AST static analysis of the scan "
+                    "scheduler's cross-layer contracts — xs-leaf "
+                    "wiring (GL1), partial-into-scan arity (GL2), dead "
+                    "config flags (GL3), trace safety (GL4), compact-"
+                    "carry dtype hygiene (GL5). Exits 0 on a clean "
+                    "tree, 1 on findings. Catalog: ARCHITECTURE.md §7.")
+    lt.add_argument(
+        "paths", nargs="*", metavar="PATH",
+        help="files/dirs to lint, relative to the repo root "
+             "(default: the product tree — open_simulator_tpu/, tools/, "
+             "bench.py)")
+    lt.add_argument("--format", choices=("text", "json"), default="text",
+                    help="finding output format")
+    lt.add_argument("--select", default="",
+                    help="comma list of rule codes to run (e.g. GL1,GL4); "
+                         "default all")
+    lt.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    lt.add_argument("--output-file", default="")
+
     sub.add_parser("version", help="print version")
 
     gd = sub.add_parser("gen-doc", help="generate markdown docs for the CLI")
@@ -115,6 +139,46 @@ def main(argv=None) -> int:
     if args.command == "version":
         print(f"simon-tpu version {__version__}")
         return 0
+
+    if args.command == "lint":
+        # analysis/ is pure-AST stdlib: linting never imports jax or the
+        # code under analysis, so this path stays fast and side-effect-free
+        from open_simulator_tpu.analysis import (
+            RULE_CODES,
+            LintError,
+            assert_clean,
+            format_json,
+            format_rules,
+            format_text,
+        )
+
+        if args.list_rules:
+            print(format_rules())
+            return 0
+        codes = tuple(c.strip() for c in args.select.split(",") if c.strip())
+        unknown = [c for c in codes if c not in RULE_CODES]
+        if unknown:
+            # an unchecked typo here would silently run ZERO rules and
+            # report the tree clean — fail loudly instead
+            print(f"error: unknown rule code(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(RULE_CODES)})", file=sys.stderr)
+            return 2
+        try:
+            assert_clean(paths=args.paths or None, codes=codes or None)
+            findings = []
+        except LintError as e:
+            findings = e.findings
+        except (OSError, SyntaxError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        text = (format_json(findings) if args.format == "json"
+                else format_text(findings))
+        if args.output_file:
+            with open(args.output_file, "w", encoding="utf-8") as f:
+                f.write(text + "\n")
+        else:
+            print(text)
+        return 1 if findings else 0
 
     if args.command == "apply":
         from open_simulator_tpu.apply.applier import Applier, ApplyOptions

@@ -403,10 +403,14 @@ def _live_xs_names(cfg: EngineConfig, has_disabled: bool,
         live.add("ports")
     if cfg.needs_group_count or cfg.enable_spread:
         live.add("match_gid" if cfg.slot_paint else "match_groups")
+    # aff_group/aff_key (and anti_*) are NOT live as their own leaves: the
+    # step reads those columns through the concatenated gcr_gid/gcr_key
+    # batched-gather leaves built in schedule_pods (graftlint GL1 keeps
+    # this honest — a dead leaf here is sliced every scan step for nothing)
     if cfg.enable_pod_affinity:
-        live |= {"aff_group", "aff_key", "aff_valid", "aff_self"}
+        live |= {"aff_valid", "aff_self"}
     if cfg.enable_anti_affinity:
-        live |= {"anti_group", "anti_key", "anti_valid"}
+        live.add("anti_valid")
         live |= ({"own_tid", "hit_tid"} if cfg.slot_paint
                  else {"own_terms", "hit_terms"})
     if cfg.enable_spread:
@@ -449,6 +453,9 @@ def _gcr_segments(cfg: EngineConfig, arrs: SnapshotArrays) -> "dict | None":
 
 def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
           hoisted, inv_alloc, gcr_seg, state: SimState, x):
+    # graftlint: static=cfg,gcr_seg (hashable EngineConfig + host dict of
+    # int column segments — Python control flow on them is gate selection,
+    # not a trace-time host sync)
     n_nodes = arrs.alloc.shape[0]
     f32 = jnp.float32
     true_v = jnp.ones((n_nodes,), dtype=bool)  # identity-compared below

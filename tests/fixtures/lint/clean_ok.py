@@ -1,0 +1,47 @@
+"""Control fixture: a miniature, fully-contract-honest scan module.
+
+graftlint must report NOTHING here — every xs leaf is produced, live,
+backed and consumed; the partial satisfies the step signature; no host
+syncs; no conditional carry dtypes.
+
+Never executed — parsed by graftlint only (tests/test_graftlint.py).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+class SnapshotArrays:
+    req: object
+    ports: object
+
+
+def _pod_xs(arrs):
+    names = [
+        "req",
+        "ports",
+    ]
+    xs = {k: getattr(arrs, k) for k in names}
+    xs["_pod_index"] = 7
+    return xs
+
+
+def _live_xs_names(cfg):
+    live = {"req", "_pod_index"}
+    if cfg.enable_ports:
+        live.add("ports")
+    return live
+
+
+def _step(weights, state, x):
+    used = x["req"] * weights + x["ports"].sum() + x["_pod_index"]
+    return state + used.sum(), used
+
+
+def schedule(arrs, cfg, weights):
+    xs = _pod_xs(arrs)
+    live = _live_xs_names(cfg)
+    xs = {k: v for k, v in xs.items() if k in live}
+    step = functools.partial(_step, weights)
+    return jax.lax.scan(step, jnp.zeros(()), xs)

@@ -1,0 +1,54 @@
+"""Round-5 regression fixture: the exact bug shape PR 1 had to repair.
+
+Round 5 landed a scan-scheduler refactor where (a) the step read
+`x["gcr_gid"]` / `x["gcr_key"]` leaves that schedule_pods never encoded,
+(b) a leaf was encoded that nothing consumed, (c) `functools.partial`
+bound only 5 of the step's 8 parameters — and the tree imported clean,
+silently breaking all 154 engine tests. graftlint must fail this shape
+loudly: GL1 in both directions, GL2 on the arity.
+
+Never executed — parsed by graftlint only (tests/test_graftlint.py).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+class SnapshotArrays:
+    req: object
+    aff_group: object
+    aff_key: object
+
+
+def _pod_xs(arrs):
+    names = [
+        "req",
+        "aff_group",
+    ]
+    xs = {k: getattr(arrs, k) for k in names}
+    return xs
+
+
+def _live_xs_names(cfg):
+    live = {"req"}
+    if cfg.enable_pod_affinity:
+        live.add("aff_group")  # GL1: declared live, step reads gcr_* instead
+    return live
+
+
+def _step(arrs, active, cfg, hoisted, inv_alloc, gcr_seg, state, x):
+    cols = jnp.take(state, x["gcr_gid"], axis=1)  # GL1a: never encoded
+    keys = x["gcr_key"]  # GL1a: never encoded
+    new_state = state + cols.sum() + keys.sum() + x["req"].sum()
+    return new_state, new_state
+
+
+def schedule_pods(arrs, active, cfg, hoisted, inv_alloc):
+    xs = _pod_xs(arrs)
+    xs["gcr_dead"] = arrs.aff_key  # GL1b: encoded but never read
+    live = _live_xs_names(cfg)
+    xs = {k: v for k, v in xs.items() if k in live}
+    # the round-5 TypeError: 8-arg step with only 5 bound (missing gcr_seg)
+    step = functools.partial(_step, arrs, active, cfg, hoisted, inv_alloc)
+    return jax.lax.scan(step, jnp.zeros(()), xs)

@@ -1,0 +1,32 @@
+"""GL1 fixture: every direction of the xs-leaf contract broken at once.
+
+Never executed — parsed by graftlint only (tests/test_graftlint.py).
+"""
+import jax
+import jax.numpy as jnp
+
+
+class SnapshotArrays:
+    req: object
+    ports: object
+
+
+def _pod_xs(arrs):
+    names = [
+        "req",
+        "ports",
+        "ghost_field",  # GL1c: not a SnapshotArrays field
+    ]
+    xs = {k: getattr(arrs, k) for k in names}
+    return xs
+
+
+def _step(state, x):
+    fit = x["req"] + x["missing_leaf"]  # GL1a: read but never encoded
+    return state + fit.sum(), fit
+
+
+def run(arrs):
+    xs = _pod_xs(arrs)
+    xs["dead_leaf"] = arrs.ports  # GL1b: encoded but never read
+    return jax.lax.scan(_step, jnp.zeros(()), xs)

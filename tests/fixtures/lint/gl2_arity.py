@@ -1,0 +1,27 @@
+"""GL2 fixture: partial-into-scan arity broken three ways.
+
+Never executed — parsed by graftlint only (tests/test_graftlint.py).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _step(table, weight, state, x):
+    return state + table[x] * weight, state
+
+
+def run_underbound(xs):
+    step = functools.partial(_step, jnp.ones((4,)))  # binds 1, needs 2
+    return jax.lax.scan(step, jnp.zeros(()), xs)
+
+
+def run_overbound(xs):
+    step = functools.partial(_step, 1.0, 2.0, 3.0)  # binds 3, one too many
+    return jax.lax.scan(step, jnp.zeros(()), xs)
+
+
+def run_bad_keyword(xs):
+    step = functools.partial(_step, 1.0, weight=2.0, gain=3.0)  # no `gain`
+    return jax.lax.scan(step, jnp.zeros(()), xs)

@@ -1,0 +1,26 @@
+"""GL3 fixture: a config class with one dead field and one dead property.
+
+Never executed — parsed by graftlint only (tests/test_graftlint.py).
+"""
+from typing import NamedTuple
+
+
+class EngineConfig(NamedTuple):
+    n_resources: int
+    enable_gpu: bool = False
+    stale_knob: bool = True  # GL3: set by nobody's reader
+
+    @property
+    def doubled(self) -> int:
+        # alive: read by consume() below; keeps n_resources alive too
+        return self.n_resources * 2
+
+    @property
+    def unused_prop(self) -> bool:  # GL3: never referenced anywhere
+        return self.enable_gpu
+
+
+def consume(cfg: EngineConfig) -> int:
+    if cfg.enable_gpu:
+        return cfg.doubled
+    return 0

@@ -1,0 +1,37 @@
+"""GL4 fixture: the host-sync catalog inside jit/scan scope.
+
+Never executed — parsed by graftlint only (tests/test_graftlint.py).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def kernel(a, b, mode):
+    if mode == "fast":  # ok: `mode` is a declared static argname
+        b = b * 2.0
+    if a.sum() > 0:  # GL4: Python `if` on a traced value
+        b = b + 1.0
+    while b.max() > 1.0:  # GL4: Python `while` on a traced value
+        b = b * 0.5
+    n = float(jnp.sum(a))  # GL4: float() host conversion
+    h = a.item()  # GL4: .item() host sync
+    w = np.asarray(b)  # GL4: numpy call on a traced value
+    for i in range(jnp.argmax(a)):  # GL4: loop bound from a traced value
+        n = n + i
+    for kk in range(a.shape[0]):  # ok: shapes are static
+        n = n + kk
+    return b + n + h + w
+
+
+def _step(state, x):
+    if x["flag"]:  # GL4: `if` on a traced xs leaf inside the scan step
+        state = state + 1.0
+    return state, state
+
+
+def run(xs):
+    return jax.lax.scan(_step, jnp.zeros(()), xs)

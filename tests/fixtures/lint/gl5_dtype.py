@@ -1,0 +1,34 @@
+"""GL5 fixture: the compact-carry bf16 promotion hazard.
+
+Never executed — parsed by graftlint only (tests/test_graftlint.py).
+"""
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SimState(NamedTuple):
+    headroom: object     # always f32
+    group_count: object  # bf16 | f32 depending on compact_carry
+
+
+def init_state(arrs, cfg):
+    f32 = jnp.float32
+    cdt = jnp.bfloat16 if cfg.compact_carry else f32
+    return SimState(
+        headroom=jnp.zeros((4, 2), f32),
+        group_count=jnp.zeros((4, 3), cdt),
+    )
+
+
+def _step(state, x):
+    paint = x["match"]
+    headroom = state.headroom + paint  # ok: dtype is unconditionally f32
+    guarded = state.group_count + paint.astype(state.group_count.dtype)  # ok
+    bad = state.group_count + paint  # GL5: silent bf16 -> f32 promotion
+    return SimState(headroom=headroom, group_count=bad + guarded * 0), headroom
+
+
+def run(arrs, cfg, xs):
+    return jax.lax.scan(_step, init_state(arrs, cfg), xs)

@@ -1,0 +1,18 @@
+"""Suppression fixture: justified vs unjustified disables.
+
+The first host sync carries a justified suppression (no finding); the
+second suppresses GL4 without a reason — the GL4 finding is swallowed
+but GL0 flags the naked directive.
+
+Never executed — parsed by graftlint only (tests/test_graftlint.py).
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def summarize(a):
+    # graftlint: disable=GL4 debug helper: the host read is the point
+    total = float(jnp.sum(a))
+    bad = int(jnp.max(a))  # graftlint: disable=GL4
+    return total + bad

@@ -1,0 +1,218 @@
+"""graftlint: rule fixtures fire at the right spans; the repo is clean.
+
+The fixture corpus under tests/fixtures/lint/ is parsed, never imported:
+each file is a deliberately-broken miniature of the engine's scan
+conventions. The round-5 gcr regression fixture pins the exact bug shape
+(ADVICE.md high finding) that motivated the analysis layer — reverting
+the PR-1 gcr_seg wiring reproduces it, and GL1/GL2 must fail it loudly.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from open_simulator_tpu.analysis import (
+    RULE_CODES,
+    RULES,
+    LintError,
+    assert_clean,
+    format_json,
+    format_text,
+    run_lint,
+)
+from open_simulator_tpu.analysis.report import repo_root
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "lint")
+
+
+def lint_fixture(name, codes=None):
+    return run_lint(root=FIXTURES, paths=[name], codes=codes)
+
+
+def line_of(name, needle, nth=1):
+    """1-based line of the nth occurrence of `needle` in a fixture."""
+    seen = 0
+    with open(os.path.join(FIXTURES, name), encoding="utf-8") as f:
+        for i, ln in enumerate(f, 1):
+            if needle in ln:
+                seen += 1
+                if seen == nth:
+                    return i
+    raise AssertionError(f"{needle!r} (#{nth}) not in {name}")
+
+
+def by_symbol(findings, symbol):
+    out = [f for f in findings if f.symbol == symbol]
+    assert out, (f"no finding for {symbol!r}; got "
+                 f"{[(f.code, f.symbol, f.line) for f in findings]}")
+    return out
+
+
+# ---- rule-by-rule fixtures ----------------------------------------------
+
+
+def test_gl1_fires_on_all_three_contract_directions():
+    fs = lint_fixture("gl1_xs_contract.py")
+    assert {f.code for f in fs} == {"GL1"}
+    missing = by_symbol(fs, "missing_leaf")[0]
+    assert missing.line == line_of("gl1_xs_contract.py", 'x["missing_leaf"]')
+    assert "never encoded" in missing.message
+    dead = by_symbol(fs, "dead_leaf")[0]
+    assert dead.line == line_of("gl1_xs_contract.py", 'xs["dead_leaf"]')
+    assert "never reads" in dead.message
+    ghost = by_symbol(fs, "ghost_field")[0]
+    assert ghost.line == line_of("gl1_xs_contract.py", '"ghost_field"')
+    assert "SnapshotArrays" in ghost.message
+    assert len(fs) == 3
+
+
+def test_gl2_underbound_overbound_and_bad_keyword():
+    fs = lint_fixture("gl2_arity.py")
+    assert {f.code for f in fs} == {"GL2"}
+    lines = sorted(f.line for f in fs)
+    assert lines == sorted([
+        line_of("gl2_arity.py", "partial(_step, jnp.ones((4,)))"),
+        line_of("gl2_arity.py", "partial(_step, 1.0, 2.0, 3.0)"),
+        line_of("gl2_arity.py", "partial(_step, 1.0, weight=2.0, gain=3.0)"),
+    ])
+    under = [f for f in fs if "only 3 are supplied" in f.message]
+    assert under and "weight" in under[0].hint
+    over = [f for f in fs if "at most 4" in f.message]
+    assert over
+    badkw = [f for f in fs if "'gain'" in f.message]
+    assert badkw
+
+
+def test_gl3_flags_dead_field_and_property_only():
+    fs = lint_fixture("gl3_dead_flag.py")
+    assert {f.code for f in fs} == {"GL3"}
+    symbols = {f.symbol for f in fs}
+    assert symbols == {"EngineConfig.stale_knob", "EngineConfig.unused_prop"}
+    knob = by_symbol(fs, "EngineConfig.stale_knob")[0]
+    assert knob.line == line_of("gl3_dead_flag.py", "stale_knob")
+
+
+def test_gl4_flags_every_host_sync_kind():
+    fs = lint_fixture("gl4_trace.py")
+    assert {f.code for f in fs} == {"GL4"}
+    kinds = sorted(f.symbol for f in fs)
+    assert kinds == ["float", "if", "if", "item", "np.asarray",
+                     "range", "while"]
+    # the static-argname branch and the shape-bounded loop stay silent
+    ok_line = line_of("gl4_trace.py", 'mode == "fast"')
+    shp_line = line_of("gl4_trace.py", "range(a.shape[0])")
+    assert all(f.line not in (ok_line, shp_line) for f in fs)
+    # scan-step `if` is anchored inside _step
+    step_if = line_of("gl4_trace.py", 'if x["flag"]')
+    assert any(f.line == step_if for f in fs)
+
+
+def test_gl5_flags_unguarded_conditional_dtype_update_only():
+    fs = lint_fixture("gl5_dtype.py")
+    assert [f.code for f in fs] == ["GL5"]
+    f = fs[0]
+    assert f.symbol == "SimState.group_count"
+    assert f.line == line_of("gl5_dtype.py", "bad = state.group_count + paint")
+    assert "astype" in f.hint
+
+
+def test_clean_fixture_is_clean():
+    assert lint_fixture("clean_ok.py") == []
+
+
+def test_suppression_swallows_finding_and_gl0_flags_naked_directive():
+    fs = lint_fixture("suppressed.py")
+    assert [f.code for f in fs] == ["GL0"]
+    assert fs[0].line == line_of("suppressed.py", "int(jnp.max(a))")
+
+
+# ---- the round-5 regression shape ---------------------------------------
+
+
+def test_gcr_regression_shape_fails_gl1_and_gl2():
+    fs = lint_fixture("gcr_regression.py")
+    codes = {f.code for f in fs}
+    assert codes == {"GL1", "GL2"}
+    # GL1 both directions, with actionable spans
+    gid = by_symbol(fs, "gcr_gid")[0]
+    assert gid.code == "GL1"
+    assert gid.line == line_of("gcr_regression.py",
+                               'jnp.take(state, x["gcr_gid"]')
+    key = by_symbol(fs, "gcr_key")[0]
+    assert key.line == line_of("gcr_regression.py", 'keys = x["gcr_key"]')
+    dead = by_symbol(fs, "gcr_dead")[0]
+    assert dead.line == line_of("gcr_regression.py", 'xs["gcr_dead"]')
+    live_dead = by_symbol(fs, "aff_group")[0]
+    assert "declared live" in live_dead.message
+    # GL2: 5 of 8 bound -> trace-time TypeError, caught statically
+    arity = by_symbol(fs, "_step")[0]
+    assert arity.code == "GL2"
+    assert arity.line == line_of("gcr_regression.py",
+                                 "functools.partial(_step, arrs")
+    assert "gcr_seg" in arity.hint
+    assert "TypeError" in arity.message
+
+
+# ---- whole-repo enforcement ---------------------------------------------
+
+
+def test_repo_tree_is_lint_clean():
+    fs = run_lint()
+    assert fs == [], "graftlint findings at HEAD:\n" + format_text(fs)
+
+
+def test_assert_clean_raises_structured_lint_error():
+    with pytest.raises(LintError) as exc:
+        assert_clean(root=FIXTURES, paths=["gl5_dtype.py"])
+    err = exc.value
+    assert err.code == "E_LINT"
+    d = err.to_dict()
+    assert d["findings"][0]["code"] == "GL5"
+    assert "gl5_dtype.py" in str(err)
+    # and the clean control fixture does not raise
+    assert_clean(root=FIXTURES, paths=["clean_ok.py"])
+
+
+def test_rule_catalog_is_complete():
+    assert tuple(r.code for r in RULES) == RULE_CODES
+    parsed = json.loads(format_json([]))
+    assert parsed["clean"] is True
+
+
+def test_cli_lint_json_clean_tree():
+    """Tier-1 enforcement: `simon-tpu lint --format json` exits 0 at HEAD."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "open_simulator_tpu.cli", "lint",
+         "--format", "json"],
+        cwd=repo_root(), capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["clean"] is True and payload["count"] == 0
+
+
+def test_cli_lint_rejects_unknown_rule_code():
+    """A mistyped --select must exit 2, not silently run zero rules."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "open_simulator_tpu.cli", "lint",
+         "--select", "GL9"],
+        cwd=repo_root(), capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "unknown rule code" in proc.stderr
+
+
+def test_cli_lint_fails_on_regression_fixture():
+    proc = subprocess.run(
+        [sys.executable, "-m", "open_simulator_tpu.cli", "lint",
+         "--format", "json", "tests/fixtures/lint/gcr_regression.py"],
+        cwd=repo_root(), capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["count"] >= 5
+    assert {f["code"] for f in payload["findings"]} == {"GL1", "GL2"}

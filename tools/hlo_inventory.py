@@ -1,6 +1,10 @@
 """Dump the while-body instruction inventory for the rich north-star jit.
 
-Usage: python tools/hlo_inventory.py [N_NODES] [N_PODS] [LANES] [MAX_NEW]
+Usage:
+    python tools/hlo_inventory.py [--nodes N] [--pods P] [--lanes L] [--max-new M]
+
+(Bare positional integers from the pre-argparse CLI are still accepted:
+`python tools/hlo_inventory.py 512 1024 8 8`.)
 """
 import os
 import re
@@ -9,42 +13,37 @@ from collections import Counter
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-import jax.numpy as jnp
-
-from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
-from open_simulator_tpu.parallel.sweep import active_masks_for_counts
-from open_simulator_tpu.testing.synthetic import synthetic_snapshot
+from tools._harness import build_jit_harness, parse_shape_args
 
 
-def _arg(i: int, default: int) -> int:
-    return int(sys.argv[i]) if len(sys.argv) > i else default
+def main(argv=None) -> int:
+    # small defaults: same op structure as the north-star shape
+    args = parse_shape_args(
+        "while-body HLO instruction inventory for the north-star scan jit",
+        nodes=512, pods=1024, lanes=8, max_new=8, argv=argv)
+    masks, fn = build_jit_harness(args)
+    txt = fn.lower(masks).compile().as_text()
+
+    # find the while body computation (largest computation named *body*)
+    blocks = re.split(r"\n(?=%?\w[\w\.\-]* \(|ENTRY )", txt)
+    body = max((b for b in blocks if re.match(r"%?\w*body", b)),
+               key=len, default=None)
+    print("n computations:", len(blocks))
+    if body is None:
+        print("no body found", file=sys.stderr)
+        return 1
+    lines = body.splitlines()
+    print("body header:", lines[0][:120])
+    print("body instruction count:", len(lines))
+    kinds = Counter()
+    for ln in lines[1:]:
+        m = re.match(r"\s+(?:ROOT )?%?[\w\.\-]+ = \S+ ([\w\-]+)\(", ln)
+        if m:
+            kinds[m.group(1)] += 1
+    for k, v in kinds.most_common(40):
+        print(f"{k:<32}{v}")
+    return 0
 
 
-# small defaults: same op structure as the north-star shape
-N_NODES, N_PODS, LANES, MAX_NEW = _arg(1, 512), _arg(2, 1024), _arg(3, 8), _arg(4, 8)
-
-snap = synthetic_snapshot(n_nodes=N_NODES, n_pods=N_PODS, max_new=MAX_NEW, rich=True)
-cfg = make_config(snap)._replace(fail_reasons=False)
-arrs = device_arrays(snap)
-counts = [min(i % (MAX_NEW + 1), MAX_NEW) for i in range(LANES)]
-masks = jnp.asarray(active_masks_for_counts(snap, counts))
-fn = jax.jit(jax.vmap(lambda a: schedule_pods(arrs, a, cfg)))
-txt = fn.lower(masks).compile().as_text()
-
-# find the while body computation (largest computation named *body*)
-blocks = re.split(r"\n(?=%?\w[\w\.\-]* \(|ENTRY )", txt)
-body = max((b for b in blocks if re.match(r"%?\w*body", b)), key=len, default=None)
-print("n computations:", len(blocks))
-if body is None:
-    sys.exit("no body found")
-lines = body.splitlines()
-print("body header:", lines[0][:120])
-print("body instruction count:", len(lines))
-kinds = Counter()
-for ln in lines[1:]:
-    m = re.match(r"\s+(?:ROOT )?%?[\w\.\-]+ = \S+ ([\w\-]+)\(", ln)
-    if m:
-        kinds[m.group(1)] += 1
-for k, v in kinds.most_common(40):
-    print(f"{k:<32}{v}")
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -3,7 +3,12 @@
 Scratch tool (not part of the package): parses the device trace json
 directly because tensorboard_plugin_profile is version-incompatible here.
 
-Usage: python tools/profile_rich.py [N_NODES] [N_PODS] [LANES] [MAX_NEW]
+Usage:
+    python tools/profile_rich.py [--nodes N] [--pods P] [--lanes L] [--max-new M]
+                                 [--trace-dir DIR]
+
+(Bare positional integers from the pre-argparse CLI are still accepted:
+`python tools/profile_rich.py 5120 51200 64 64`.)
 """
 import glob
 import gzip
@@ -15,56 +20,60 @@ from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-import jax.numpy as jnp
-
-from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
-from open_simulator_tpu.parallel.sweep import active_masks_for_counts
-from open_simulator_tpu.testing.synthetic import synthetic_snapshot
+from tools._harness import build_jit_harness, parse_shape_args
 
 
-def _arg(i: int, default: int) -> int:
-    return int(sys.argv[i]) if len(sys.argv) > i else default
+def main(argv=None) -> int:
+    args = parse_shape_args(
+        "per-op device-trace profile of the north-star scan jit",
+        nodes=5120, pods=51200, lanes=64, max_new=64,
+        extra_flags=(("--trace-dir", dict(
+            default="/tmp/richprof",
+            help="where the jax profiler trace is written")),),
+        argv=argv)
+
+    import jax
+
+    masks, fn = build_jit_harness(args)
+    out = fn(masks)
+    jax.block_until_ready(out.node)
+
+    t0 = time.perf_counter()
+    out = fn(masks)
+    jax.block_until_ready(out.node)
+    wall = time.perf_counter() - t0
+    print(f"wall: {wall:.3f}s  scen/s: {args.lanes / wall:.2f}", flush=True)
+
+    trace_dir = args.trace_dir
+    for old in glob.glob(f"{trace_dir}/plugins/profile/*/*.trace.json.gz"):
+        os.remove(old)
+    with jax.profiler.trace(trace_dir):
+        out = fn(masks)
+        jax.block_until_ready(out.node)
+
+    # find the trace json
+    paths = glob.glob(f"{trace_dir}/plugins/profile/*/*.trace.json.gz")
+    print("trace files:", paths, flush=True)
+    ev_by_name = defaultdict(lambda: [0, 0.0])  # name -> [count, total_us]
+    total_dur = 0.0
+    for p in paths:
+        with gzip.open(p, "rt") as f:
+            data = json.load(f)
+        for ev in data.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            name = ev.get("name", "")
+            dur = ev.get("dur", 0)
+            ev_by_name[name][0] += 1
+            ev_by_name[name][1] += dur
+            total_dur += dur
+
+    rows = sorted(ev_by_name.items(), key=lambda kv: -kv[1][1])[:60]
+    print(f"{'name':<72} {'count':>8} {'total_ms':>10} {'us/call':>8}")
+    for name, (cnt, tot) in rows:
+        print(f"{name[:72]:<72} {cnt:>8} {tot/1000:>10.1f} {tot/cnt:>8.2f}")
+    return 0
 
 
-N_NODES, N_PODS, LANES, MAX_NEW = (
-    _arg(1, 5120), _arg(2, 51200), _arg(3, 64), _arg(4, 64))
-
-snap = synthetic_snapshot(n_nodes=N_NODES, n_pods=N_PODS, max_new=MAX_NEW, rich=True)
-cfg = make_config(snap)._replace(fail_reasons=False)
-arrs = device_arrays(snap)
-counts = [min(i % (MAX_NEW + 1), MAX_NEW) for i in range(LANES)]
-masks = jnp.asarray(active_masks_for_counts(snap, counts))
-fn = jax.jit(jax.vmap(lambda a: schedule_pods(arrs, a, cfg)))
-out = fn(masks); jax.block_until_ready(out.node)
-
-t0 = time.perf_counter(); out = fn(masks); jax.block_until_ready(out.node)
-wall = time.perf_counter() - t0
-print(f"wall: {wall:.3f}s  scen/s: {LANES/wall:.2f}", flush=True)
-
-trace_dir = "/tmp/richprof"
-os.system(f"rm -rf {trace_dir}")
-with jax.profiler.trace(trace_dir):
-    out = fn(masks); jax.block_until_ready(out.node)
-
-# find the trace json
-paths = glob.glob(f"{trace_dir}/plugins/profile/*/*.trace.json.gz")
-print("trace files:", paths, flush=True)
-ev_by_name = defaultdict(lambda: [0, 0.0])  # name -> [count, total_us]
-total_dur = 0.0
-for p in paths:
-    with gzip.open(p, "rt") as f:
-        data = json.load(f)
-    for ev in data.get("traceEvents", []):
-        if ev.get("ph") != "X":
-            continue
-        name = ev.get("name", "")
-        dur = ev.get("dur", 0)
-        ev_by_name[name][0] += 1
-        ev_by_name[name][1] += dur
-        total_dur += dur
-
-rows = sorted(ev_by_name.items(), key=lambda kv: -kv[1][1])[:60]
-print(f"{'name':<72} {'count':>8} {'total_ms':>10} {'us/call':>8}")
-for name, (cnt, tot) in rows:
-    print(f"{name[:72]:<72} {cnt:>8} {tot/1000:>10.1f} {tot/cnt:>8.2f}")
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,9 +1,19 @@
 #!/usr/bin/env bash
-# Pre-PR smoke check: the tier-1 verify command (ROADMAP.md) plus one
-# chaos scenario end to end. Run as `make smoke` or `bash tools/smoke.sh`.
+# Pre-PR smoke check: graftlint, the tier-1 verify command (ROADMAP.md),
+# plus one chaos scenario end to end. Run as `make smoke` or
+# `bash tools/smoke.sh`.
 set -u
 cd "$(dirname "$0")/.."
 
+echo "== graftlint (static trace-safety / engine-contract analysis) =="
+python -m open_simulator_tpu.cli lint
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: graftlint exited $rc" >&2
+  exit "$rc"
+fi
+
+echo
 echo "== tier-1 test suite (ROADMAP.md verify command) =="
 set -o pipefail
 rm -f /tmp/_t1.log
