@@ -32,10 +32,31 @@ def build(n_nodes: int, n_pods: int, max_new: int, rich: bool = False):
         n_nodes=n_nodes, n_pods=n_pods, max_new=max_new, rich=rich)
 
 
-def run_batched(snapshot, n_scenarios: int, fail_reasons: bool = False):
+BENCH_SECONDS = "simon_bench_seconds"
+
+
+def _bench_gauge():
+    from open_simulator_tpu.telemetry import gauge
+
+    return gauge(
+        BENCH_SECONDS,
+        "best-of-5 batched sweep wall time per workload shape (bench.py)",
+        labelnames=("shape",))
+
+
+def shape_label(nodes: int, pods: int, scenarios: int, rich: bool = False) -> str:
+    return f"{nodes}n_x{pods}p_x{scenarios}s" + ("_allops" if rich else "")
+
+
+def run_batched(snapshot, n_scenarios: int, fail_reasons: bool = False,
+                shape: str = "") -> float:
     """Time the capacity-sweep product path: what-if lanes run with
     fail_reasons off (the applier re-runs only the decoded lane with
-    reasons on — not part of the per-lane sweep cost; parallel/sweep.py)."""
+    reasons on — not part of the per-lane sweep cost; parallel/sweep.py).
+
+    The measured best lands in the simon_bench_seconds{shape} gauge and
+    is read BACK from the registry by main() — the BENCH json line and a
+    /metrics scrape of this process report one source of truth."""
     import jax
     import jax.numpy as jnp
 
@@ -58,6 +79,9 @@ def run_batched(snapshot, n_scenarios: int, fail_reasons: bool = False):
         out = fn(masks)
         jax.block_until_ready(out.node)
         best = min(best, time.perf_counter() - t0)
+    label = shape or shape_label(snapshot.n_real_nodes, snapshot.n_pods,
+                                 n_scenarios)
+    _bench_gauge().labels(shape=label).set(best)
     return best
 
 
@@ -142,7 +166,12 @@ def main():
     rich = preset.get("rich", False)
 
     snapshot = build(args.nodes, args.pods, args.max_new, rich=rich)
-    dt = run_batched(snapshot, args.scenarios, fail_reasons=args.fail_reasons)
+    label = shape_label(args.nodes, args.pods, args.scenarios, rich)
+    # run_batched sets simon_bench_seconds{shape=label} to the same value
+    # it returns, so the JSON below and a /metrics scrape of this process
+    # report one source of truth
+    dt = run_batched(snapshot, args.scenarios, fail_reasons=args.fail_reasons,
+                     shape=label)
     pods_per_sec = args.pods * args.scenarios / dt
     scenarios_per_sec = args.scenarios / dt
 
@@ -150,8 +179,7 @@ def main():
     vs = pods_per_sec / base_rate if base_rate > 0 else 0.0
 
     out = {
-        "metric": f"pods_scheduled_per_sec@{args.nodes}n_x{args.pods}p_x{args.scenarios}s"
-                  + ("_allops" if rich else ""),
+        "metric": f"pods_scheduled_per_sec@{label}",
         "value": round(pods_per_sec, 1),
         "unit": "pods/s",
         "vs_baseline": round(vs, 2),
@@ -172,7 +200,9 @@ def main():
         # 256-lane point records the per-chip ceiling (lane amortization).
         ns = PRESETS["northstar"]
         ns_snap = build(ns["nodes"], ns["pods"], ns["max_new"])
-        ns_dt = run_batched(ns_snap, ns["scenarios"], fail_reasons=args.fail_reasons)
+        ns_label = shape_label(ns["nodes"], ns["pods"], ns["scenarios"])
+        ns_dt = run_batched(ns_snap, ns["scenarios"],
+                            fail_reasons=args.fail_reasons, shape=ns_label)
         out["northstar_scenarios_per_sec_per_chip"] = round(ns["scenarios"] / ns_dt, 1)
         out["northstar_shape"] = f"{ns['nodes']}n_x{ns['pods']}p_x{ns['scenarios']}s"
         # wide = the SAME snapshot at more lanes (assert the preset table
@@ -180,7 +210,9 @@ def main():
         wide = PRESETS["northstar-wide"]
         assert all(wide[k] == ns[k] for k in ("nodes", "pods", "max_new")), (
             "northstar-wide must differ from northstar only in lane count")
-        wide_dt = run_batched(ns_snap, wide["scenarios"], fail_reasons=args.fail_reasons)
+        wide_label = shape_label(wide["nodes"], wide["pods"], wide["scenarios"])
+        wide_dt = run_batched(ns_snap, wide["scenarios"],
+                              fail_reasons=args.fail_reasons, shape=wide_label)
         out["northstar_wide_scenarios_per_sec_per_chip"] = round(
             wide["scenarios"] / wide_dt, 1)
         out["northstar_wide_lanes"] = wide["scenarios"]
@@ -190,7 +222,9 @@ def main():
         assert all(nr[k] == ns[k] for k in ("nodes", "pods", "max_new", "scenarios")), (
             "northstar-rich must differ from northstar only in workload")
         nr_snap = build(nr["nodes"], nr["pods"], nr["max_new"], rich=True)
-        nr_dt = run_batched(nr_snap, nr["scenarios"], fail_reasons=args.fail_reasons)
+        nr_label = shape_label(nr["nodes"], nr["pods"], nr["scenarios"], rich=True)
+        nr_dt = run_batched(nr_snap, nr["scenarios"],
+                            fail_reasons=args.fail_reasons, shape=nr_label)
         out["northstar_rich_scenarios_per_sec_per_chip"] = round(
             nr["scenarios"] / nr_dt, 2)
     print(json.dumps(out))

@@ -51,7 +51,7 @@ class ApplyOptions:
     config_path: str = ""
     default_scheduler_config: str = ""   # KubeSchedulerConfiguration file; Score
                                          # enable/disable/weights + pluginConfig
-                                         # map onto EngineConfig (engine/profile.py)
+                                         # map onto EngineConfig (engine/sched_config.py)
     output_file: str = ""
     use_greed: bool = False
     interactive: bool = False
@@ -215,21 +215,24 @@ class Applier:
         )
 
         self._pdbs = list(cluster.pdbs) + [p for a in apps for p in a.resources.pdbs]
-        pods = build_pod_sequence(cluster, apps, use_greed=self.opts.use_greed)
-        max_new = self.opts.max_new_nodes if template is not None else 0
         from open_simulator_tpu.core import with_volume_objects
+        from open_simulator_tpu.telemetry.spans import span
 
-        snapshot = encode_cluster(
-            cluster.nodes,
-            pods,
-            with_volume_objects(
-                EncodeOptions(max_new_nodes=max_new, new_node_template=template),
-                cluster, apps,
-            ),
-        )
+        with span("expand"):
+            pods = build_pod_sequence(cluster, apps, use_greed=self.opts.use_greed)
+        max_new = self.opts.max_new_nodes if template is not None else 0
+        with span("encode"):
+            snapshot = encode_cluster(
+                cluster.nodes,
+                pods,
+                with_volume_objects(
+                    EncodeOptions(max_new_nodes=max_new, new_node_template=template),
+                    cluster, apps,
+                ),
+            )
         overrides = {}
         if self.opts.default_scheduler_config:
-            from open_simulator_tpu.engine.profile import weight_overrides_from_file
+            from open_simulator_tpu.engine.sched_config import weight_overrides_from_file
 
             overrides = weight_overrides_from_file(self.opts.default_scheduler_config)
         self._preemption = not overrides.pop("_disable_preemption", False)

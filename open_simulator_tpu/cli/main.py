@@ -7,6 +7,7 @@ cmd/apply/apply.go:27-36, cmd/server/server.go). LogLevel env knob kept.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import logging
 import os
 import sys
@@ -49,6 +50,38 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("-i", "--interactive", action="store_true", help="interactive add-node prompt loop")
     ap.add_argument("--extended-resources", default="", help="comma list, e.g. gpu")
     ap.add_argument("--max-new-nodes", type=int, default=128, help="sweep upper bound for added nodes")
+    ap.add_argument("--trace-out", default="",
+                    help="write a Chrome-trace JSON timeline of this run's "
+                         "phases (open in chrome://tracing or Perfetto)")
+
+    ex = sub.add_parser(
+        "explain",
+        help="per-pod scheduling explanation: why this node / why unschedulable",
+        description="Run one simulation with per-op failure accounting and "
+                    "top-k score recording on, then report per pod: the "
+                    "chosen node with each score plugin's weighted "
+                    "contribution at the top-k candidates, or the "
+                    "per-filter-op node elimination counts ('0/N nodes "
+                    "are available: ...') with the first failing op. The "
+                    "numbers decode the engine's own fail_counts/score "
+                    "tensors — nothing is recomputed on the host.")
+    ex.add_argument("-f", "--simon-config", required=True,
+                    help="simon/v1alpha1 Config file")
+    ex.add_argument("--default-scheduler-config", default="",
+                    help="KubeSchedulerConfiguration file (same semantics "
+                         "as apply)")
+    ex.add_argument("--pod", action="append", default=[], metavar="NS/NAME",
+                    help="only explain this pod key (repeatable; default all)")
+    ex.add_argument("--top-k", type=int, default=3,
+                    help="candidate nodes to report per pod")
+    ex.add_argument("--use-greed", action="store_true",
+                    help="sort app pods by dominant share, like apply")
+    ex.add_argument("--json", action="store_true",
+                    help="emit the report as JSON")
+    ex.add_argument("--output-file", default="")
+    ex.add_argument("--trace-out", default="",
+                    help="write a Chrome-trace JSON timeline of this run's "
+                         "phases (open in chrome://tracing or Perfetto)")
 
     sp = sub.add_parser("server", help="REST simulation server")
     sp.add_argument("--port", type=int, default=8899)
@@ -65,6 +98,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="reject request bodies above this size with 413")
     sp.add_argument("--request-timeout", type=float, default=300.0,
                     help="per-request simulation deadline in seconds (504 past it)")
+    sp.add_argument("--explain-topk", type=int, default=3,
+                    help="candidate nodes recorded per pod during serving "
+                         "simulations for GET /api/explain (0 disables)")
 
     ch = sub.add_parser(
         "chaos",
@@ -86,6 +122,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="node label key that defines zones")
     ch.add_argument("--json", action="store_true", help="emit the report as JSON")
     ch.add_argument("--output-file", default="")
+    ch.add_argument("--trace-out", default="",
+                    help="write a Chrome-trace JSON timeline of this run's "
+                         "phases (open in chrome://tracing or Perfetto)")
 
     mg = sub.add_parser("migrate", help="plan a defragmentation migration of placed pods")
     mg.add_argument("--cluster-config", required=True, help="cluster YAML dir (with placed pods)")
@@ -120,6 +159,24 @@ def build_parser() -> argparse.ArgumentParser:
     gd = sub.add_parser("gen-doc", help="generate markdown docs for the CLI")
     gd.add_argument("--dir", default="docs/commandline")
     return p
+
+
+@contextlib.contextmanager
+def _trace_capture(path: str):
+    """--trace-out: capture exactly this run's spans and write the
+    Chrome-trace JSON on the way out (even when the run fails — a failed
+    run's timeline is the one you want)."""
+    if not path:
+        yield
+        return
+    from open_simulator_tpu.telemetry.spans import RECORDER, export_chrome_trace
+
+    RECORDER.clear()
+    try:
+        yield
+    finally:
+        export_chrome_trace(path)
+        print(f"chrome trace written to {path}", file=sys.stderr)
 
 
 def _init_logging() -> None:
@@ -193,11 +250,38 @@ def main(argv=None) -> int:
             max_new_nodes=args.max_new_nodes,
         )
         try:
-            return Applier(opts).run()
+            with _trace_capture(args.trace_out):
+                return Applier(opts).run()
         except Exception as e:  # surface config errors as exit-code-1 messages
             # (a SimulationError formats itself as "[CODE] ref.field: ...")
             print(f"error: {e}", file=sys.stderr)
             return 1
+
+    if args.command == "explain":
+        import json as _json
+
+        from open_simulator_tpu.telemetry.explain import format_explain, run_explain
+
+        try:
+            with _trace_capture(args.trace_out):
+                report = run_explain(
+                    args.simon_config,
+                    default_scheduler_config=args.default_scheduler_config,
+                    top_k=args.top_k,
+                    pods=args.pod or None,
+                    use_greed=args.use_greed,
+                )
+        except Exception as e:  # config/admission errors -> exit-code-1 message
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        text = (_json.dumps(report, indent=2) if args.json
+                else format_explain(report))
+        if args.output_file:
+            with open(args.output_file, "w", encoding="utf-8") as f:
+                f.write(text + "\n")
+        else:
+            print(text)
+        return 0
 
     if args.command == "chaos":
         from open_simulator_tpu.k8s.loader import load_resources_from_directory
@@ -206,9 +290,12 @@ def main(argv=None) -> int:
         events = [FaultEvent(kind, target) for kind, target in args.events]
         plan = ChaosPlan(events=events, zone_key=args.zone_key)
         try:
-            cluster = load_resources_from_directory(args.cluster_config)
-            report = run_chaos(cluster, plan)
-        except SimulationError as e:
+            with _trace_capture(args.trace_out):
+                cluster = load_resources_from_directory(args.cluster_config)
+                report = run_chaos(cluster, plan)
+        except (SimulationError, OSError) as e:
+            # OSError: unreadable cluster dir or unwritable --trace-out —
+            # a clean "error:" exit like apply/explain, not a traceback
             print(f"error: {e}", file=sys.stderr)
             return 1
         import json as _json
@@ -250,6 +337,7 @@ def main(argv=None) -> int:
             kubeconfig=args.kubeconfig,
             max_body_bytes=args.max_body_mib * 1024 * 1024,
             request_timeout_s=args.request_timeout,
+            explain_topk=args.explain_topk,
         )
 
     if args.command == "gen-doc":

@@ -64,6 +64,20 @@ class SimulateResult:
     # the integer truth behind the gpu-index annotation (decode-side view of
     # the Reserve allocation, open-gpu-share.go:147-188)
     gpu_assignments: Dict[str, List[int]] = field(default_factory=dict)
+    # telemetry/explain decode surface: the raw per-pod per-op failure
+    # counts behind the reason strings, the op vocabulary they index, and
+    # (when the engine ran with explain_topk) the top-k candidate tensors
+    # with their score-plugin row names
+    fail_counts: Optional[np.ndarray] = field(default=None, repr=False)
+    op_names: List[str] = field(default_factory=list)
+    n_active_nodes: int = 0
+    topk_node: Optional[np.ndarray] = field(default=None, repr=False)
+    topk_score: Optional[np.ndarray] = field(default=None, repr=False)
+    topk_parts: Optional[np.ndarray] = field(default=None, repr=False)
+    score_part_names: List[str] = field(default_factory=list)
+    # keys of pods deleted as preemption victims (structured marker —
+    # explain must not infer this from the reason string's wording)
+    preempted_pod_keys: List[str] = field(default_factory=list)
 
     def placements(self) -> Dict[str, str]:
         return {sp.pod.key: sp.node_name for sp in self.scheduled_pods}
@@ -90,6 +104,10 @@ def decode_result(
     preempted_by: Optional[Dict[int, int]] = None,
     vol_pick: Optional[np.ndarray] = None,
     extra_op_names: Optional[List[str]] = None,
+    topk_node: Optional[np.ndarray] = None,
+    topk_score: Optional[np.ndarray] = None,
+    topk_parts: Optional[np.ndarray] = None,
+    score_part_names: Optional[List[str]] = None,
 ) -> SimulateResult:
     op_names = snapshot.op_names + list(extra_op_names or [])
     n_active = int(np.sum(active))
@@ -98,6 +116,7 @@ def decode_result(
     pods_by_node: Dict[int, List[Pod]] = {}
     volume_bindings: Dict[str, str] = {}
     gpu_assignments: Dict[str, List[int]] = {}
+    preempted_keys: List[str] = []
     forced = snapshot.arrays.forced_node
     for i, pod in enumerate(snapshot.pods):
         ni = int(node_assign[i])
@@ -136,6 +155,7 @@ def decode_result(
                 # victim of DefaultPreemption: deleted to admit the preemptor
                 pre = snapshot.pods[preempted_by[i]]
                 reason = f'preempted to admit higher-priority pod "{pre.key}"'
+                preempted_keys.append(pod.key)
             elif i in snapshot.pre_reasons:
                 # unschedulable before any node was considered (PreFilter
                 # UnschedulableAndUnresolvable — missing / Lost / unbound
@@ -159,6 +179,14 @@ def decode_result(
         snapshot=snapshot,
         volume_bindings=volume_bindings,
         gpu_assignments=gpu_assignments,
+        fail_counts=np.asarray(fail_counts),
+        op_names=list(op_names),
+        n_active_nodes=n_active,
+        topk_node=topk_node,
+        topk_score=topk_score,
+        topk_parts=topk_parts,
+        score_part_names=list(score_part_names or []),
+        preempted_pod_keys=preempted_keys,
     )
 
 
@@ -260,45 +288,88 @@ def simulate(
     validate=True runs the resilience admission pass first, so malformed
     specs raise a structured SimulationError taxonomy (code + object ref +
     hint) instead of a traceback from deep inside encode."""
+    from open_simulator_tpu import telemetry
+    from open_simulator_tpu.telemetry.spans import span
+
     t0 = time.perf_counter()
     config_overrides = dict(config_overrides or {})
     preemption = preemption and not config_overrides.pop("_disable_preemption", False)
-    nodes = [make_valid_node(n) for n in cluster.nodes]
-    cluster = _with_nodes(cluster, nodes)
-    if validate:
-        from open_simulator_tpu.resilience.admission import admit
+    with span("simulate"):
+        nodes = [make_valid_node(n) for n in cluster.nodes]
+        cluster = _with_nodes(cluster, nodes)
+        if validate:
+            from open_simulator_tpu.resilience.admission import admit
 
-        admit(cluster, apps)
-    pods = build_pod_sequence(cluster, apps, use_greed=use_greed)
-    encode_options = with_volume_objects(encode_options, cluster, apps)
-    snapshot = encode_cluster(nodes, pods, encode_options)
-    cfg = make_config(snapshot, **config_overrides)
-    arrs = device_arrays(snapshot)
-    active_np = np.asarray(arrs.active)
-    preempted_by: Optional[Dict[int, int]] = None
-    if preemption:
-        from open_simulator_tpu.engine.preemption import run_with_preemption
+            with span("admit"):
+                admit(cluster, apps)
+        with span("expand"):
+            pods = build_pod_sequence(cluster, apps, use_greed=use_greed)
+        encode_options = with_volume_objects(encode_options, cluster, apps)
+        with span("encode"):
+            snapshot = encode_cluster(nodes, pods, encode_options)
+        cfg = make_config(snapshot, **config_overrides)
+        with span("transfer"):
+            arrs = device_arrays(snapshot)
+        active_np = np.asarray(arrs.active)
+        preempted_by: Optional[Dict[int, int]] = None
+        # schedule_phase counts compile-miss vs cache-hit off the jit-cache
+        # delta and stamps a nested "compile" span on a miss
+        with telemetry.schedule_phase(schedule_pods):
+            if preemption:
+                from open_simulator_tpu.engine.preemption import run_with_preemption
 
-        pdbs = list(cluster.pdbs) + [p for a in apps for p in a.resources.pdbs]
+                pdbs = list(cluster.pdbs) + [p for a in apps for p in a.resources.pdbs]
 
-        def schedule_fn(disabled, nominated):
-            return schedule_pods(arrs, arrs.active, cfg, disabled=disabled,
-                                 nominated=nominated)
+                def schedule_fn(disabled, nominated):
+                    return schedule_pods(arrs, arrs.active, cfg, disabled=disabled,
+                                         nominated=nominated)
 
-        out, pre = run_with_preemption(snapshot, active_np, schedule_fn, pdbs)
-        preempted_by = pre.preempted_by
-    else:
-        out = schedule_pods(arrs, arrs.active, cfg)
-    node_assign = np.asarray(out.node)
-    fail_counts = np.asarray(out.fail_counts)
-    gpu_pick = np.asarray(out.gpu_pick) if cfg.enable_gpu else None
-    elapsed = time.perf_counter() - t0
-    return decode_result(
-        snapshot, node_assign, fail_counts, active_np, elapsed, gpu_pick,
-        preempted_by=preempted_by,
-        vol_pick=np.asarray(out.vol_pick) if cfg.enable_pv_match else None,
-        extra_op_names=list(cfg.extension_op_names),
+                out, pre = run_with_preemption(snapshot, active_np, schedule_fn, pdbs)
+                preempted_by = pre.preempted_by
+            else:
+                out = schedule_pods(arrs, arrs.active, cfg)
+            node_assign = np.asarray(out.node)  # blocks on device completion
+            fail_counts = np.asarray(out.fail_counts)
+        gpu_pick = np.asarray(out.gpu_pick) if cfg.enable_gpu else None
+        elapsed = time.perf_counter() - t0
+        with span("decode"):
+            result = decode_result(
+                snapshot, node_assign, fail_counts, active_np, elapsed, gpu_pick,
+                preempted_by=preempted_by,
+                vol_pick=np.asarray(out.vol_pick) if cfg.enable_pv_match else None,
+                extra_op_names=list(cfg.extension_op_names),
+                **explain_decode_kwargs(cfg, out),
+            )
+    _record_simulation(telemetry, result)
+    return result
+
+
+def explain_decode_kwargs(cfg, out) -> Dict:
+    """The explain-surface decode kwargs (top-k tensors + part names),
+    shared by simulate() and Simulator._run; {} when explain_topk is off."""
+    if not cfg.explain_topk:
+        return {}
+    from open_simulator_tpu.engine.scheduler import score_part_names
+
+    return dict(
+        topk_node=np.asarray(out.topk_node),
+        topk_score=np.asarray(out.topk_score),
+        topk_parts=np.asarray(out.topk_parts),
+        score_part_names=list(score_part_names(cfg)),
     )
+
+
+def _record_simulation(telemetry, result: SimulateResult) -> None:
+    """Post-decode counters: one simulate() call's scheduling outcomes."""
+    telemetry.counter(
+        "simon_simulations_total", "completed simulate() calls").inc()
+    telemetry.counter(
+        "simon_pods_scheduled_total",
+        "pods placed across all simulations").inc(len(result.scheduled_pods))
+    telemetry.counter(
+        "simon_pods_unscheduled_total",
+        "pods left unschedulable across all simulations").inc(
+        len(result.unscheduled_pods))
 
 
 def _with_nodes(cluster: ClusterResources, nodes: List[Node]) -> ClusterResources:

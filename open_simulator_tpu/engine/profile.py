@@ -1,141 +1,24 @@
-"""KubeSchedulerConfiguration -> engine overrides.
+"""Deprecated alias for engine/sched_config.py.
 
-The reference accepts a scheduler config file via --default-scheduler-config
-and merges it over the v1beta2 defaults (GetAndSetSchedulerConfig,
-pkg/simulator/utils.go:325-356). Here the file's Score plugin
-enable/disable/weight lists map onto EngineConfig weight fields, and
-Filter/PreFilter plugin DISABLES map onto the engine's feature gates (the
-same compile-the-op-out switches make_config autodetects; a disabled
-filter op contributes a constant-true mask, exactly like the vendored
-framework skipping a de-registered plugin). Out-of-tree plugins have a
-tensor-shaped registry of their own — engine/extensions.ExtensionOp
-(config_overrides={"extensions": (...)}).
+This module always held KubeSchedulerConfiguration parsing, never
+profiling; it was renamed so the name stops colliding with the telemetry
+layer's profiling surfaces (utils/trace.profile_to, /debug/profile).
+Import from ``open_simulator_tpu.engine.sched_config`` — this shim
+re-exports the public names and will be removed in a later PR.
 """
 
 from __future__ import annotations
 
-import logging
-from typing import Any, Dict
+import warnings
 
-import yaml
+from open_simulator_tpu.engine.sched_config import (  # noqa: F401
+    SchedulerConfigError,
+    weight_overrides_from_file,
+)
 
-log = logging.getLogger(__name__)
-
-# plugin name -> EngineConfig weight field
-_SCORE_PLUGIN_FIELDS = {
-    "NodeResourcesBalancedAllocation": "w_balanced",
-    "NodeResourcesFit": "w_least",
-    "NodeResourcesLeastAllocated": "w_least",
-    "NodeAffinity": "w_node_aff",
-    "TaintToleration": "w_taint",
-    "InterPodAffinity": "w_interpod",
-    "PodTopologySpread": "w_spread",
-    "Simon": "w_simon",
-    "Open-Gpu-Share": "w_gpu",
-}
-
-# filter/preFilter plugin name -> EngineConfig gate(s) a DISABLE turns off.
-# NodeResourcesFit/NodeName have no gate (fit and forced binds are the
-# engine's substrate) — disables of those warn and are ignored.
-_FILTER_PLUGIN_GATES = {
-    "NodeUnschedulable": ("enable_unsched",),
-    "NodeAffinity": ("enable_class_aff",),
-    "TaintToleration": ("enable_class_taint",),
-    "NodePorts": ("enable_ports",),
-    "InterPodAffinity": ("enable_pod_affinity", "enable_anti_affinity"),
-    "PodTopologySpread": ("enable_spread_hard",),
-    "VolumeBinding": ("enable_vol_static", "enable_pv_match"),
-    "VolumeZone": (),   # folded into the vol_static masks; warn below
-    "Open-Gpu-Share": ("enable_gpu",),
-}
-
-
-class SchedulerConfigError(ValueError):
-    pass
-
-
-def weight_overrides_from_file(path: str) -> Dict[str, float]:
-    """Parse a KubeSchedulerConfiguration file into EngineConfig kwargs."""
-    with open(path, "r", encoding="utf-8") as f:
-        doc = yaml.safe_load(f) or {}
-    kind = doc.get("kind", "")
-    if kind and kind != "KubeSchedulerConfiguration":
-        raise SchedulerConfigError(f"{path}: expected KubeSchedulerConfiguration, got {kind}")
-    profiles = doc.get("profiles") or []
-    if not profiles:
-        return {}
-    plugins = (profiles[0] or {}).get("plugins") or {}
-    overrides: Dict[str, Any] = {}
-    for point in ("filter", "preFilter"):
-        section = plugins.get(point) or {}
-        disabled = section.get("disabled") or []
-        star = any(e.get("name") == "*" for e in disabled)
-        if star:
-            for gates in _FILTER_PLUGIN_GATES.values():
-                for g in gates:
-                    overrides[g] = False
-            # kube semantics: with `disabled: ['*']` the enabled list IS
-            # the plugin set — those gates come back on
-            for entry in section.get("enabled") or []:
-                for g in _FILTER_PLUGIN_GATES.get(entry.get("name", ""), ()):
-                    overrides[g] = True
-        # explicit named disables always win (plain `enabled` entries
-        # without a star merely append to the default set, which is the
-        # autodetected-gate status quo — no override needed)
-        for entry in disabled:
-            name = entry.get("name", "")
-            if name == "*":
-                continue
-            gates = _FILTER_PLUGIN_GATES.get(name)
-            if gates:
-                for g in gates:
-                    overrides[g] = False
-            else:
-                log.warning(
-                    "%s: cannot disable %s plugin %r — it has no engine "
-                    "gate (resource fit and forced binds are the engine's "
-                    "substrate; VolumeZone folds into the VolumeBinding "
-                    "masks)", path, point, name,
-                )
-    for entry in (plugins.get("postFilter") or {}).get("disabled") or []:
-        # DefaultPreemption disable is honored by the callers (simulate /
-        # Simulator / Applier pop this pseudo-override before make_config)
-        if entry.get("name") in ("DefaultPreemption", "*"):
-            overrides["_disable_preemption"] = True
-    score = plugins.get("score") or {}
-    for entry in score.get("enabled") or []:
-        name = entry.get("name", "")
-        field = _SCORE_PLUGIN_FIELDS.get(name)
-        if field is None:
-            continue  # unknown plugin names are ignored, like out-of-tree ones
-        overrides[field] = float(entry.get("weight", 1))
-    for entry in score.get("disabled") or []:
-        name = entry.get("name", "")
-        if name == "*":
-            overrides = {f: 0.0 for f in set(_SCORE_PLUGIN_FIELDS.values())} | overrides
-            continue
-        field = _SCORE_PLUGIN_FIELDS.get(name)
-        if field is not None and field not in overrides:
-            overrides[field] = 0.0
-    _apply_plugin_config((profiles[0] or {}).get("pluginConfig") or [], overrides)
-    return overrides
-
-
-def _apply_plugin_config(plugin_config, overrides: Dict[str, float]) -> None:
-    """pluginConfig args. NodeResourcesFitArgs.scoringStrategy selects the
-    allocation-scoring direction (LeastAllocated default / MostAllocated
-    bin-packing), the v1beta2+ replacement for the separate
-    NodeResources{Least,Most}Allocated plugins."""
-    for entry in plugin_config:
-        if entry.get("name") != "NodeResourcesFit":
-            continue
-        strategy = ((entry.get("args") or {}).get("scoringStrategy") or {})
-        stype = strategy.get("type", "")
-        if stype == "MostAllocated":
-            weight = overrides.get("w_least", 1.0)
-            overrides["w_least"] = 0.0
-            overrides["w_most"] = weight
-        elif stype == "LeastAllocated":
-            overrides["w_least"] = overrides.get("w_least", 1.0)
-        # other strategy types / args (ignoredResources etc.) leave the
-        # enable/disable weights untouched
+warnings.warn(
+    "open_simulator_tpu.engine.profile is deprecated; import "
+    "open_simulator_tpu.engine.sched_config instead",
+    DeprecationWarning,
+    stacklevel=2,
+)

@@ -129,6 +129,13 @@ class EngineConfig(NamedTuple):
     # rows after the built-in table; score extensions join the weighted sum
     # (and the shared normalize reduction).
     extensions: Tuple = ()
+    # Explain instrumentation (telemetry/explain.py): when > 0, every scan
+    # step also emits the top-k candidate nodes by final score plus each
+    # live score plugin's weighted contribution at those nodes (rows in
+    # score_part_names order), recorded at the pod's own step so the
+    # numbers reflect the carry the pod scheduled against. 0 (the default)
+    # compiles the whole block out — the hot paths never pay for it.
+    explain_topk: int = 0
     # Length of the leading run of forced-bind pods (spec.nodeName) whose
     # carry contributions are applied as ONE batched scatter before the
     # scan instead of one scan step each — a live-cluster snapshot starts
@@ -225,6 +232,12 @@ class ScheduleOutput(NamedTuple):
     feasible: jnp.ndarray     # [P] i32 feasible-node count
     gpu_pick: jnp.ndarray     # [P, G] i32 per-device GPU multiplicities on the bound node
     vol_pick: jnp.ndarray     # [P, Lw] i32 PV id bound per WFC claim slot (-1 none)
+    # explain_topk outputs (K = cfg.explain_topk, 0 when off; C = the
+    # score_part_names(cfg) row count). Scores at masked-out nodes carry
+    # the neg_inf sentinel; decode drops them.
+    topk_node: jnp.ndarray    # [P, K] i32 candidate nodes by final score
+    topk_score: jnp.ndarray   # [P, K] f32 final score at each candidate
+    topk_parts: jnp.ndarray   # [P, C, K] f32 per-plugin weighted contributions
     state: SimState
 
 
@@ -787,9 +800,21 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     # Values are identical to the standalone minmax_normalize/
     # max_normalize formulas.
     big = jnp.float32(3.4e38)
-    score = scores.resource_scores_fused(
+
+    # explain_topk: each live plugin's weighted row is also kept for the
+    # per-candidate breakdown (one stack + one gather per step, compiled
+    # out when explain_topk == 0). Row order is the score_part_names(cfg)
+    # contract — extend both together.
+    part_rows: list = []
+
+    def _part(row):
+        if cfg.explain_topk:
+            part_rows.append(row)
+        return row
+
+    score = _part(scores.resource_scores_fused(
         state.headroom, inv_alloc, x["req"], cfg.cpu_mem_idx,
-        cfg.w_balanced, cfg.w_least, cfg.w_most)
+        cfg.w_balanced, cfg.w_least, cfg.w_most))
 
     # selectHost below is two monoid reduces (max + min-index-among-
     # maxima); a (max, index) tuple-reduce was measured ~2.4x a plain
@@ -862,29 +887,31 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         )
 
     if cfg.w_node_aff and cfg.enable_node_aff_score:
-        score += cfg.w_node_aff * scores.max_apply(raw_na, -reds[i_na])
+        score += _part(cfg.w_node_aff * scores.max_apply(raw_na, -reds[i_na]))
     if cfg.w_taint and cfg.enable_taint_score:
-        score += cfg.w_taint * scores.max_apply(raw_tt, -reds[i_tt], reverse=True)
+        score += _part(
+            cfg.w_taint * scores.max_apply(raw_tt, -reds[i_tt], reverse=True))
     if cfg.w_interpod and cfg.enable_pref:
-        score += cfg.w_interpod * scores.minmax_apply(
-            raw_ip, reds[i_ip_lo], -reds[i_ip_hi])
+        score += _part(cfg.w_interpod * scores.minmax_apply(
+            raw_ip, reds[i_ip_lo], -reds[i_ip_hi]))
     if cfg.w_spread and cfg.enable_spread_soft:
-        score += cfg.w_spread * scores.spread_apply(
-            spread_raw, reds[i_sp_lo], -reds[i_sp_hi], spread_node_ok, any_soft)
+        score += _part(cfg.w_spread * scores.spread_apply(
+            spread_raw, reds[i_sp_lo], -reds[i_sp_hi], spread_node_ok, any_soft))
     if cfg.w_simon:
-        score += cfg.w_simon * scores.minmax_apply(
-            raw_si, reds[i_si_lo], -reds[i_si_hi])
+        score += _part(cfg.w_simon * scores.minmax_apply(
+            raw_si, reds[i_si_lo], -reds[i_si_hi]))
     if cfg.enable_gpu:
         # cnt==0 pods score 0 on the GPU dimension (scalar factor)
-        score += (cfg.w_gpu * (x["gpu_cnt"] > 0)) * scores.minmax_apply(
-            raw_gp, reds[i_gp_lo], -reds[i_gp_hi])
+        score += _part((cfg.w_gpu * (x["gpu_cnt"] > 0)) * scores.minmax_apply(
+            raw_gp, reds[i_gp_lo], -reds[i_gp_hi]))
     for ext, raw_e, lo_i, hi_i in ext_scores:
         if lo_i is not None:
-            score += ext.weight * scores.minmax_apply(raw_e, reds[lo_i], -reds[hi_i])
+            score += _part(
+                ext.weight * scores.minmax_apply(raw_e, reds[lo_i], -reds[hi_i]))
         elif hi_i is not None:
-            score += ext.weight * scores.max_apply(raw_e, -reds[hi_i])
+            score += _part(ext.weight * scores.max_apply(raw_e, -reds[hi_i]))
         else:
-            score += ext.weight * raw_e
+            score += _part(ext.weight * raw_e)
 
     # Preemption retry: a nominated node (status.nominatedNodeName analog,
     # defaultpreemption PostFilter) restricts the pick to that node while it
@@ -911,6 +938,24 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     # maxima) — XLA lowers jnp.argmax through the generic tuple-comparator
     # reduce, measured ~2.4x the cost of a plain min/max at [64, 5184]
     masked_score = jnp.where(mask, score, neg_inf)
+    if cfg.explain_topk:
+        # candidate ranking for the explain decode: top-k final scores
+        # (ties resolve to the lower index, matching selectHost) plus a
+        # gather of the per-plugin rows at those nodes. With
+        # tie_break_seed the ranking includes the jitter, like the pick.
+        k_top = min(cfg.explain_topk, n_nodes)
+        topk_score, topk_node = jax.lax.top_k(masked_score, k_top)
+        topk_node = topk_node.astype(jnp.int32)
+        if part_rows:
+            topk_parts = jnp.take(jnp.stack(part_rows), topk_node, axis=1)
+        else:
+            topk_parts = jnp.zeros((0, k_top), f32)
+    else:
+        # width-0 outputs: nothing is materialized per step (the gpu_pick
+        # pattern), and the [P, K] outputs below keep a stable pytree
+        topk_node = jnp.zeros((0,), jnp.int32)
+        topk_score = jnp.zeros((0,), f32)
+        topk_parts = jnp.zeros((0, 0), f32)
     top = jnp.max(masked_score)
     any_feasible = top > neg_inf  # scores are finite; == neg_inf iff mask empty
     sel_node = jnp.min(
@@ -1086,7 +1131,8 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
     new_state = SimState(headroom, group_count, term_block, pref_paint, ports_used,
                          gpu_used, vg_used, sdev_taken, dom_count, pv_taken,
                          vol_cnt, svol_on)
-    return new_state, (final_node, fail_counts, feasible_n, pick, vol_pick)
+    return new_state, (final_node, fail_counts, feasible_n, pick, vol_pick,
+                       topk_node, topk_score, topk_parts)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -1164,7 +1210,8 @@ def schedule_pods(
              jnp.asarray(scan_arrs.spread_key, jnp.int32)], axis=1)
     step = functools.partial(_step, scan_arrs, active, cfg, hoisted, inv_alloc,
                              gcr_seg)
-    final_state, (nodes, fail_counts, feasible, gpu_pick, vol_pick) = jax.lax.scan(
+    final_state, (nodes, fail_counts, feasible, gpu_pick, vol_pick,
+                  topk_node, topk_score, topk_parts) = jax.lax.scan(
         step, state, xs, unroll=cfg.scan_unroll
     )
     if k:
@@ -1178,13 +1225,21 @@ def schedule_pods(
             [jnp.zeros((k, gpu_pick.shape[1]), jnp.int32), gpu_pick])
         vol_pick = jnp.concatenate(
             [jnp.full((k, vol_pick.shape[1]), -1, jnp.int32), vol_pick])
+        # forced-bind pods were never ranked; -1 candidates decode to none
+        topk_node = jnp.concatenate(
+            [jnp.full((k, topk_node.shape[1]), -1, jnp.int32), topk_node])
+        topk_score = jnp.concatenate(
+            [jnp.zeros((k, topk_score.shape[1]), jnp.float32), topk_score])
+        topk_parts = jnp.concatenate(
+            [jnp.zeros((k,) + topk_parts.shape[1:], jnp.float32), topk_parts])
     if not cfg.fail_reasons:
         # keep the output contract ([P, OPS]) without paying a per-step
         # accounting pass or a materialized scan output
         fail_counts = jnp.zeros((n_pods, cfg.n_ops), jnp.int32)
     return ScheduleOutput(
         node=nodes, fail_counts=fail_counts, feasible=feasible, gpu_pick=gpu_pick,
-        vol_pick=vol_pick, state=final_state,
+        vol_pick=vol_pick, topk_node=topk_node, topk_score=topk_score,
+        topk_parts=topk_parts, state=final_state,
     )
 
 
@@ -1200,6 +1255,28 @@ def slice_pods(arrs: SnapshotArrays, start: int, stop: int) -> SnapshotArrays:
         x = getattr(arrs, f.name)
         out[f.name] = x[start:stop] if f.name in pod_axis else x
     return type(arrs)(**out)
+
+
+def score_part_names(cfg: EngineConfig) -> Tuple[str, ...]:
+    """Static names of the per-plugin score rows _step records under
+    explain_topk, in exactly the order the rows are stacked (the
+    topk_parts row axis). The gate conditions MUST mirror the _part()
+    call sites in _step — extend both together."""
+    names = ["NodeResources"]
+    if cfg.w_node_aff and cfg.enable_node_aff_score:
+        names.append("NodeAffinity")
+    if cfg.w_taint and cfg.enable_taint_score:
+        names.append("TaintToleration")
+    if cfg.w_interpod and cfg.enable_pref:
+        names.append("InterPodAffinity")
+    if cfg.w_spread and cfg.enable_spread_soft:
+        names.append("PodTopologySpread")
+    if cfg.w_simon:
+        names.append("Simon")
+    if cfg.enable_gpu:
+        names.append("Open-Gpu-Share")
+    names += [e.name for e in cfg.extensions if e.score_fn is not None]
+    return tuple(names)
 
 
 def make_config(snapshot: ClusterSnapshot, **overrides) -> EngineConfig:

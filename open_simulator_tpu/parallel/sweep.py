@@ -105,7 +105,8 @@ def batched_schedule(
             in_shardings=(NamedSharding(mesh, P("scenario", None)),),
             out_shardings=ScheduleOutput(
                 node=lane, fail_counts=lane, feasible=lane, gpu_pick=lane,
-                vol_pick=lane,
+                vol_pick=lane, topk_node=lane, topk_score=lane,
+                topk_parts=lane,
                 state=jax.tree_util.tree_map(lambda _: lane, _state_proto(arrs)),
             ),
         )
@@ -191,12 +192,15 @@ def capacity_sweep(
     `backoff_s`); if the batched run still fails and `isolate_trials`,
     each lane re-runs alone so one failing trial cannot kill the sweep —
     failed lanes land in CapacityPlan.trial_errors instead."""
+    from open_simulator_tpu.telemetry.spans import span
+
     arrs = device_arrays(snapshot)
     masks = active_masks_for_counts(snapshot, counts)
     sweep_cfg = cfg if fail_reasons else cfg._replace(fail_reasons=False)
-    nodes, fail, headroom, vg_used_arr, gpu, vol, trial_errors = _execute_sweep(
-        arrs, masks, sweep_cfg, mesh, fail_reasons, retries, backoff_s,
-        isolate_trials)
+    with span("sweep", lanes=len(counts)):
+        nodes, fail, headroom, vg_used_arr, gpu, vol, trial_errors = _execute_sweep(
+            arrs, masks, sweep_cfg, mesh, fail_reasons, retries, backoff_s,
+            isolate_trials)
     alloc = np.asarray(arrs.alloc)             # [N, R]
     used = alloc[None] - headroom              # [S, N, R]
 
@@ -265,7 +269,18 @@ def _execute_sweep(arrs, masks, sweep_cfg, mesh, fail_reasons,
     runs when the batch keeps failing. Returns host numpy
     (nodes, fail, headroom, vg_used, gpu_pick, vol_pick, trial_errors);
     failed lanes hold neutral values (all -1 nodes, pristine headroom)."""
+    import time as _time
+
     from open_simulator_tpu.resilience.retry import run_with_retries
+    from open_simulator_tpu.telemetry import registry as _telemetry
+
+    trials_total = _telemetry.counter(
+        "simon_sweep_trials_total", "capacity-sweep lane outcomes",
+        labelnames=("outcome",))
+    trial_seconds = _telemetry.histogram(
+        "simon_sweep_trial_seconds",
+        "wall time of sweep device executions (batched = all lanes at once)",
+        labelnames=("mode",))
 
     def host(out):
         fail = (np.asarray(out.fail_counts) if fail_reasons
@@ -275,11 +290,15 @@ def _execute_sweep(arrs, masks, sweep_cfg, mesh, fail_reasons,
                 np.asarray(out.vol_pick))
 
     try:
+        t0 = _time.perf_counter()
         out = run_with_retries(
             lambda: batched_schedule(arrs, jnp.asarray(masks), sweep_cfg,
                                      mesh=mesh),
             retries=retries, backoff_s=backoff_s)
-        return host(out) + ({},)
+        hosted = host(out)  # np.asarray blocks: the timing covers execution
+        trial_seconds.labels(mode="batched").observe(_time.perf_counter() - t0)
+        trials_total.labels(outcome="ok").inc(masks.shape[0])
+        return hosted + ({},)
     except Exception:
         if not isolate_trials:
             raise
@@ -295,11 +314,15 @@ def _execute_sweep(arrs, masks, sweep_cfg, mesh, fail_reasons,
     trial_errors = {}
     for si in range(s):
         try:
+            t0 = _time.perf_counter()
             out_i = run_with_retries(
                 lambda: batched_schedule(arrs, jnp.asarray(masks[si:si + 1]),
                                          sweep_cfg, mesh=None),
                 retries=retries, backoff_s=backoff_s)
             nodes_i, fail_i, hr_i, vg_i, gpu_i, vol_i = host(out_i)
+            trial_seconds.labels(mode="isolated").observe(
+                _time.perf_counter() - t0)
+            trials_total.labels(outcome="ok").inc()
             nodes[si], fail[si], headroom[si], vg_used[si] = (
                 nodes_i[0], fail_i[0], hr_i[0], vg_i[0])
             if gpu_i[0].shape == gpu[si].shape:
@@ -307,6 +330,7 @@ def _execute_sweep(arrs, masks, sweep_cfg, mesh, fail_reasons,
             if vol_i[0].shape == vol[si].shape:
                 vol[si] = vol_i[0]
         except Exception as e:  # noqa: BLE001 — isolate, record, continue
+            trials_total.labels(outcome="failed").inc()
             trial_errors[si] = f"{type(e).__name__}: {e}"
     if len(trial_errors) == s:
         # every lane failed — this is a systemic failure (dead device,

@@ -264,7 +264,31 @@ def admit(cluster: ClusterResources, apps: Iterable = (),
     errors = validate_cluster(cluster, apps, strict_topology=strict_topology,
                               require_nodes=require_nodes)
     if errors:
+        _count_rejections(errors)
         raise AdmissionError(errors)
+
+
+def _rejections_counter():
+    """Get-or-create eagerly at import (below) so the family renders on
+    /metrics — zero-valued — as soon as the admission pass is loaded,
+    not only after the first rejection."""
+    from open_simulator_tpu.telemetry import counter
+
+    return counter(
+        "simon_admission_rejections_total",
+        "spec defects found by the admission pass, by taxonomy code",
+        labelnames=("code",))
+
+
+_rejections_counter()
+
+
+def _count_rejections(errors: List[SimulationError]) -> None:
+    """simon_admission_rejections_total{code}: one increment per defect
+    (an admission failure with three bad quantities counts three)."""
+    rejections = _rejections_counter()
+    for e in errors:
+        rejections.labels(code=e.code or "E_UNKNOWN").inc()
 
 
 def validate_app(app, cluster: ClusterResources) -> List[SimulationError]:

@@ -220,8 +220,19 @@ def run_chaos(
     active = np.array(np.asarray(arrs.active), dtype=bool, copy=True)
     forced = np.array(np.asarray(arrs.forced_node), dtype=np.int32, copy=True)
 
-    out0 = schedule_pods(arrs, jnp.asarray(active), cfg)
-    assign = np.asarray(out0.node)
+    from open_simulator_tpu.telemetry import counter
+    from open_simulator_tpu.telemetry.spans import span
+
+    events_total = counter("simon_chaos_events_total",
+                           "fault events injected, by kind",
+                           labelnames=("kind",))
+    evicted_total = counter("simon_chaos_evicted_pods_total",
+                            "pods evicted by fault events",
+                            labelnames=("outcome",))
+
+    with span("chaos.baseline"):
+        out0 = schedule_pods(arrs, jnp.asarray(active), cfg)
+        assign = np.asarray(out0.node)
     report = DisruptionReport(
         total_pods=snapshot.n_pods,
         baseline_unschedulable=int(np.sum(assign < 0)),
@@ -246,8 +257,9 @@ def run_chaos(
         evicted_idx = np.nonzero((assign >= 0) & failed_mask[np.maximum(assign, 0)])[0]
 
         arrs_ev = dataclasses.replace(arrs, forced_node=jnp.asarray(forced))
-        out = schedule_pods(arrs_ev, jnp.asarray(active), cfg)
-        new_assign = np.asarray(out.node)
+        with span("chaos.event", kind=ev.kind, target=ev.target):
+            out = schedule_pods(arrs_ev, jnp.asarray(active), cfg)
+            new_assign = np.asarray(out.node)
 
         replaced = {
             snapshot.pods[i].key: node_names[int(new_assign[i])]
@@ -258,6 +270,9 @@ def run_chaos(
             r: float(np.sum(alloc[failed_mask, ri]))
             for ri, r in enumerate(resources)
         }
+        events_total.labels(kind=ev.kind).inc()
+        evicted_total.labels(outcome="replaced").inc(len(replaced))
+        evicted_total.labels(outcome="lost").inc(len(lost))
         report.steps.append(DisruptionStep(
             event=ev,
             failed_nodes=[node_names[i] for i in failed],

@@ -24,13 +24,28 @@ def run_with_retries(
 ) -> T:
     """Call fn(); on a retryable exception wait backoff_s * 2^attempt
     (capped) and try again, up to `retries` extra attempts. The last
-    failure is re-raised unchanged."""
+    failure is re-raised unchanged.
+
+    Outcomes feed simon_retry_total{outcome}: `retried` per backoff taken,
+    `recovered` when a retried call eventually succeeds, `exhausted` when
+    the attempts run out — the series that tells flaky-device latency
+    apart from persistent failure on a dashboard."""
+    from open_simulator_tpu.telemetry import counter
+
+    outcomes = counter("simon_retry_total",
+                       "retry-with-backoff outcomes around device execution",
+                       labelnames=("outcome",))
     attempt = 0
     while True:
         try:
-            return fn()
+            result = fn()
+            if attempt:
+                outcomes.labels(outcome="recovered").inc()
+            return result
         except retry_on:
             if attempt >= retries:
+                outcomes.labels(outcome="exhausted").inc()
                 raise
+            outcomes.labels(outcome="retried").inc()
             sleep(min(backoff_s * (2.0 ** attempt), max_backoff_s))
             attempt += 1

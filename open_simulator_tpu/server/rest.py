@@ -4,6 +4,14 @@ Endpoint parity with the reference (pkg/server/server.go:148-314):
 
   GET  /healthz           -> {"status": "healthy"}
   GET  /test              -> liveness echo
+  GET  /metrics           -> Prometheus text exposition of the default
+                             telemetry registry (request/scheduling/
+                             admission/chaos series + on-demand jax
+                             runtime gauges; telemetry/registry.py)
+  GET  /api/explain       -> per-pod "why this node / why unschedulable"
+                             decode of the LAST simulation this server
+                             ran (?pod=ns/name repeatable, ?top_k=N);
+                             404 E_NO_SIMULATION before the first one
   POST /api/deploy-apps   -> simulate deploying new apps (+ optional new nodes)
   POST /api/scale-apps    -> simulate re-scaling existing workloads (their
                              current pods are removed first — the re-rollout
@@ -36,6 +44,7 @@ Request bodies (JSON):
 from __future__ import annotations
 
 import json
+import logging
 import tempfile
 import threading
 import time
@@ -44,6 +53,7 @@ from typing import Any, Dict, List, Optional
 
 import yaml
 
+from open_simulator_tpu import telemetry
 from open_simulator_tpu.core import AppResource, SimulateResult, simulate
 from open_simulator_tpu.errors import SimulationError
 from open_simulator_tpu.k8s.loader import (
@@ -60,22 +70,63 @@ from open_simulator_tpu.k8s.objects import LABEL_APP_NAME, Node
 DEFAULT_MAX_BODY_BYTES = 8 * 1024 * 1024
 DEFAULT_REQUEST_TIMEOUT_S = 300.0
 
+# access log (satellite of the telemetry PR): one debug line per request
+# with method, path, status, duration — silent by default, switched on
+# with LogLevel=debug like every other logger in the CLI
+access_log = logging.getLogger("simon-tpu.http")
+
+# request-metric path label vocabulary (unknown paths collapse to "other"
+# so a scanner can't inflate the label cardinality)
+_KNOWN_PATHS = frozenset({
+    "/healthz", "/test", "/metrics", "/debug/stats", "/debug/profile",
+    "/api/explain", "/api/deploy-apps", "/api/scale-apps", "/api/chaos",
+})
+
+
+def _http_metrics():
+    """Get-or-create the request metric families (module import order must
+    not matter, so handles are resolved at call time)."""
+    return (
+        telemetry.counter(
+            "simon_http_requests_total",
+            "REST requests served, by method/path/status",
+            labelnames=("method", "path", "status")),
+        telemetry.histogram(
+            "simon_http_request_seconds",
+            "REST request wall time (includes simulation time)",
+            labelnames=("path",)),
+        telemetry.gauge(
+            "simon_http_in_flight", "REST requests currently being handled"),
+    )
+
+
+DEFAULT_EXPLAIN_TOPK = 3
+
 
 class SimulationServer:
     def __init__(self, cluster_config: str = "", kubeconfig: str = "",
                  max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
-                 request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S):
+                 request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
+                 explain_topk: int = DEFAULT_EXPLAIN_TOPK):
         self.cluster_config = cluster_config
         # recorded API dump standing in for the reference's 10 live
         # informers (pkg/server/server.go:97-137; no cluster access here)
         self.kubeconfig = kubeconfig
         self.max_body_bytes = int(max_body_bytes)
         self.request_timeout_s = float(request_timeout_s)
+        # candidates recorded per pod during serving simulations so
+        # GET /api/explain can break scores down without re-running;
+        # 0 disables the recording (and the explain candidate lists)
+        self.explain_topk = max(0, int(explain_topk))
         self._lock = threading.Lock()
         self._stats = {"requests": 0, "simulations": 0, "errors": 0,
                        "last_elapsed_s": 0.0, "started_at": time.time()}
         self._profile_dir = ""
         self._profile_lock = threading.Lock()
+        # full (untrimmed) result of the last simulation: the explain
+        # endpoint decodes it without re-running anything
+        self._last_result: Optional[SimulateResult] = None
+        telemetry.install_runtime_gauges()
 
     # ---- debug surface (the gin pprof analog, server.go:148-152) -------
 
@@ -142,10 +193,18 @@ class SimulationServer:
         cluster = self.base_cluster(body.get("cluster"))
         cluster.nodes.extend(self._request_new_nodes(body.get("new_nodes")))
         apps = self._request_apps(body)
-        result = simulate(cluster, apps)  # simulate() runs admission first
+        result = self._simulate(cluster, apps)  # runs admission first
         self._stats["simulations"] += 1
         self._stats["last_elapsed_s"] = round(result.elapsed_s, 3)
+        self._last_result = result
         return self._response(result, app_only=True)
+
+    def _simulate(self, cluster: ClusterResources,
+                  apps: List[AppResource]) -> SimulateResult:
+        """All serving simulations record explain_topk candidates, so the
+        explain endpoint has score breakdowns for the last result."""
+        return simulate(cluster, apps,
+                        config_overrides={"explain_topk": self.explain_topk})
 
     def chaos(self, body: Dict[str, Any]) -> Dict[str, Any]:
         """Fault-injection re-simulation (resilience/chaos.py)."""
@@ -184,10 +243,32 @@ class SimulationServer:
             app_res = ClusterResources()
             app_res.add(workload, kind)
             apps.append(AppResource(name=f"scale-{name}", resources=app_res))
-        result = simulate(cluster, apps)
+        result = self._simulate(cluster, apps)
         self._stats["simulations"] += 1
         self._stats["last_elapsed_s"] = round(result.elapsed_s, 3)
+        self._last_result = result
         return self._response(result, app_only=True)
+
+    def explain(self, query: Dict[str, List[str]]) -> Dict[str, Any]:
+        """Explain report over the last simulation (GET /api/explain)."""
+        from open_simulator_tpu.telemetry.explain import explain_result
+
+        result = self._last_result
+        if result is None:
+            raise SimulationError(
+                "no simulation has run yet — nothing to explain",
+                code="E_NO_SIMULATION", ref="server", field="",
+                hint="POST /api/deploy-apps or /api/scale-apps first")
+        raw_k = (query.get("top_k") or [""])[0]
+        try:
+            top_k = int(raw_k) if raw_k else None
+        except ValueError:
+            raise SimulationError(
+                f"top_k must be an integer, got {raw_k!r}",
+                code="E_BAD_REQUEST", ref="request", field="top_k",
+                hint="GET /api/explain?top_k=3") from None
+        pods = query.get("pod") or None
+        return explain_result(result, top_k=top_k, pods=pods)
 
     # ---- helpers -------------------------------------------------------
 
@@ -280,23 +361,72 @@ class SimulationServer:
 
 
 def _make_handler(server: SimulationServer):
+    req_total, req_seconds, in_flight = _http_metrics()
+
     class Handler(BaseHTTPRequestHandler):
-        def log_message(self, fmt, *args):  # quiet
+        def log_request(self, code="-", size="-"):
+            # replaced by the timed access line in _account (duration ms)
             pass
 
-        def _send(self, code: int, payload: Dict[str, Any]) -> None:
-            data = json.dumps(payload).encode()
+        def log_message(self, fmt, *args):
+            # http.server internals (parse errors etc.) -> the access logger
+            access_log.debug(fmt, *args)
+
+        def _account(self, status: int) -> None:
+            """Access log + request metrics, once per response."""
+            dur_s = time.perf_counter() - getattr(
+                self, "_t0", time.perf_counter())
+            path = self.path.split("?", 1)[0]
+            label = path if path in _KNOWN_PATHS else "other"
+            method = self.command or "-"
+            req_total.labels(method=method, path=label,
+                             status=str(status)).inc()
+            req_seconds.labels(path=label).observe(dur_s)
+            access_log.debug("%s %s -> %d %.1fms", method, path, status,
+                             dur_s * 1000.0)
+
+        def _send_raw(self, code: int, data: bytes, ctype: str) -> None:
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
+            self._account(code)
+
+        def _send(self, code: int, payload: Dict[str, Any]) -> None:
+            self._send_raw(code, json.dumps(payload).encode(),
+                           "application/json")
 
         def do_GET(self):
+            self._t0 = time.perf_counter()
+            in_flight.inc()
+            try:
+                self._do_get()
+            finally:
+                in_flight.dec()
+
+        def _do_get(self):
             if self.path == "/healthz":
                 self._send(200, {"status": "healthy"})
             elif self.path == "/test":
                 self._send(200, {"message": "simon-tpu server is running"})
+            elif self.path == "/metrics":
+                # Prometheus text exposition of the whole default registry
+                # (jax runtime gauges sample inside the render)
+                self._send_raw(200, telemetry.render_prometheus().encode(),
+                               telemetry.PROMETHEUS_CONTENT_TYPE)
+            elif self.path == "/api/explain" or self.path.startswith("/api/explain?"):
+                from urllib.parse import parse_qs, urlparse
+
+                q = parse_qs(urlparse(self.path).query)
+                try:
+                    self._send(200, server.explain(q))
+                except SimulationError as e:
+                    server._stats["errors"] += 1
+                    self._send(_status_for(e), _err_payload(e))
+                except Exception as e:  # noqa: BLE001
+                    server._stats["errors"] += 1
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
             elif self.path == "/debug/stats":
                 # profiling surface, the gin pprof analog
                 # (/root/reference/pkg/server/server.go:148-152): process +
@@ -321,6 +451,14 @@ def _make_handler(server: SimulationServer):
                 self._send(404, {"error": "not found"})
 
         def do_POST(self):
+            self._t0 = time.perf_counter()
+            in_flight.inc()
+            try:
+                self._do_post()
+            finally:
+                in_flight.dec()
+
+        def _do_post(self):
             routes = {"/api/deploy-apps": server.deploy_apps,
                       "/api/scale-apps": server.scale_apps,
                       "/api/chaos": server.chaos}
@@ -404,6 +542,7 @@ _STATUS_BY_CODE = {
     "E_PAYLOAD_TOO_LARGE": 413,
     "E_TIMEOUT": 504,
     "E_BUSY": 503,
+    "E_NO_SIMULATION": 404,
 }
 
 
@@ -414,7 +553,8 @@ def _status_for(e: SimulationError) -> int:
 def serve(address: str = "127.0.0.1", port: int = 8899, cluster_config: str = "",
           kubeconfig: str = "",
           max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
-          request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S) -> int:
+          request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
+          explain_topk: int = DEFAULT_EXPLAIN_TOPK) -> int:
     if kubeconfig:
         # validate up front so a real kubeconfig fails fast with the
         # record-a-dump recipe instead of 500s per request
@@ -423,7 +563,8 @@ def serve(address: str = "127.0.0.1", port: int = 8899, cluster_config: str = ""
         resolve_cluster_source(kubeconfig).load()
     sim_server = SimulationServer(cluster_config=cluster_config, kubeconfig=kubeconfig,
                                   max_body_bytes=max_body_bytes,
-                                  request_timeout_s=request_timeout_s)
+                                  request_timeout_s=request_timeout_s,
+                                  explain_topk=explain_topk)
     httpd = ThreadingHTTPServer((address, port), _make_handler(sim_server))
     print(f"simon-tpu server listening on http://{address}:{port}")
     try:

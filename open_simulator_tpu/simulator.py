@@ -105,47 +105,55 @@ class Simulator:
 
     # -- internals -------------------------------------------------------
     def _run(self, select_app: Optional[str]) -> SimulateResult:
-        from open_simulator_tpu.core import with_volume_objects
+        from open_simulator_tpu import telemetry
+        from open_simulator_tpu.core import explain_decode_kwargs, with_volume_objects
+        from open_simulator_tpu.telemetry.spans import span
 
         opts = with_volume_objects(self._encode_options, self.cluster, self._apps)
-        snapshot = encode_cluster(self.cluster.nodes, self._pods, opts)
+        with span("encode"):
+            snapshot = encode_cluster(self.cluster.nodes, self._pods, opts)
         cfg = make_config(snapshot, **self._overrides)
-        arrs = device_arrays(snapshot)
+        with span("transfer"):
+            arrs = device_arrays(snapshot)
         preempted_by = None
-        if self.preemption:
-            from open_simulator_tpu.engine.preemption import run_with_preemption
+        with telemetry.schedule_phase(schedule_pods):
+            if self.preemption:
+                from open_simulator_tpu.engine.preemption import run_with_preemption
 
-            pdbs = list(self.cluster.pdbs) + [
-                p for a in self._apps for p in a.resources.pdbs
-            ]
+                pdbs = list(self.cluster.pdbs) + [
+                    p for a in self._apps for p in a.resources.pdbs
+                ]
 
-            def schedule_fn(disabled, nominated):
-                return schedule_pods(arrs, arrs.active, cfg, disabled=disabled,
-                                     nominated=nominated)
+                def schedule_fn(disabled, nominated):
+                    return schedule_pods(arrs, arrs.active, cfg, disabled=disabled,
+                                         nominated=nominated)
 
-            out, pre = run_with_preemption(
-                snapshot, np.asarray(arrs.active), schedule_fn, pdbs,
-                init_disabled=self._pre_disabled,
-                init_nominated=np.where(
-                    self._pre_assign >= 0, self._pre_assign, -1
-                ).astype(np.int32),
+                out, pre = run_with_preemption(
+                    snapshot, np.asarray(arrs.active), schedule_fn, pdbs,
+                    init_disabled=self._pre_disabled,
+                    init_nominated=np.where(
+                        self._pre_assign >= 0, self._pre_assign, -1
+                    ).astype(np.int32),
+                )
+                self._preempted_by.update(pre.preempted_by)
+                preempted_by = dict(self._preempted_by)
+                self._pre_disabled = np.asarray(pre.disabled)
+                self._pre_assign = np.asarray(out.node).astype(np.int32)
+            else:
+                out = schedule_pods(arrs, arrs.active, cfg)
+            node_assign = np.asarray(out.node)  # blocks on device completion
+        with span("decode"):
+            result = decode_result(
+                snapshot,
+                node_assign,
+                np.asarray(out.fail_counts),
+                np.asarray(arrs.active),
+                gpu_pick=np.asarray(out.gpu_pick) if cfg.enable_gpu else None,
+                preempted_by=preempted_by,
+                vol_pick=np.asarray(out.vol_pick) if cfg.enable_pv_match else None,
+                extra_op_names=list(cfg.extension_op_names),
+                **explain_decode_kwargs(cfg, out),
             )
-            self._preempted_by.update(pre.preempted_by)
-            preempted_by = dict(self._preempted_by)
-            self._pre_disabled = np.asarray(pre.disabled)
-            self._pre_assign = np.asarray(out.node).astype(np.int32)
-        else:
-            out = schedule_pods(arrs, arrs.active, cfg)
-        result = decode_result(
-            snapshot,
-            np.asarray(out.node),
-            np.asarray(out.fail_counts),
-            np.asarray(arrs.active),
-            gpu_pick=np.asarray(out.gpu_pick) if cfg.enable_gpu else None,
-            preempted_by=preempted_by,
-            vol_pick=np.asarray(out.vol_pick) if cfg.enable_pv_match else None,
-            extra_op_names=list(cfg.extension_op_names),
-        )
         self._last = result
         if select_app is None:
             return result
@@ -159,4 +167,13 @@ class Simulator:
             node_status=result.node_status,
             elapsed_s=result.elapsed_s,
             snapshot=result.snapshot,
+            # explain surface rides along (rows index the full snapshot)
+            fail_counts=result.fail_counts,
+            op_names=result.op_names,
+            n_active_nodes=result.n_active_nodes,
+            topk_node=result.topk_node,
+            topk_score=result.topk_score,
+            topk_parts=result.topk_parts,
+            score_part_names=result.score_part_names,
+            preempted_pod_keys=result.preempted_pod_keys,
         )

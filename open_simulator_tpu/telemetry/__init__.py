@@ -1,0 +1,38 @@
+"""Telemetry: metrics registry, nested spans, runtime gauges, explain.
+
+The unified observability layer (ARCHITECTURE.md §8):
+
+  registry.py  dependency-free counters/gauges/histograms + Prometheus
+               text exposition (GET /metrics renders the default REGISTRY)
+  spans.py     nested host-side phase spans -> simon_phase_seconds +
+               Chrome-trace JSON export (--trace-out, loads in Perfetto)
+  runtime.py   on-demand jax gauges (live buffers, device memory) and
+               jit compile-cache hit/miss accounting
+  explain.py   per-pod "why this node / why unschedulable" decode of the
+               engine's fail_counts + top-k score tensors
+"""
+
+from open_simulator_tpu.telemetry.registry import (  # noqa: F401
+    PROMETHEUS_CONTENT_TYPE,
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    counter,
+    gauge,
+    histogram,
+    render_prometheus,
+)
+from open_simulator_tpu.telemetry.runtime import (  # noqa: F401
+    install_runtime_gauges,
+    jit_cache_size,
+    record_compile_event,
+    schedule_phase,
+)
+from open_simulator_tpu.telemetry.spans import (  # noqa: F401
+    RECORDER,
+    SpanRecorder,
+    export_chrome_trace,
+    span,
+)

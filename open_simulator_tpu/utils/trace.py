@@ -2,8 +2,11 @@
 
 The reference wraps simulate phases in utiltrace with slow-threshold
 logging (pkg/simulator/core.go:80-128 'Trace Simulate' steps, 1s alarm;
-simulator.go:522-532, 100ms snapshot alarm). Same idea here, plus an
-optional `jax.profiler` trace context for real device timelines.
+simulator.go:522-532, 100ms snapshot alarm). Same idea here — and since
+PR 3 each step ALSO opens a telemetry span, so Trace users feed the
+`simon_phase_seconds` histogram and the Chrome-trace timeline
+(telemetry/spans.py) for free while keeping the log-if-long alarm.
+`jax.profiler` (profile_to) remains the hook for real device timelines.
 """
 
 from __future__ import annotations
@@ -12,6 +15,8 @@ import contextlib
 import logging
 import time
 from typing import List, Optional, Tuple
+
+from open_simulator_tpu.telemetry.spans import span as _span
 
 log = logging.getLogger("simon-tpu.trace")
 
@@ -34,7 +39,8 @@ class Trace:
     def step(self, label: str):
         s = time.perf_counter()
         try:
-            yield
+            with _span(label):
+                yield
         finally:
             self.steps.append((label, time.perf_counter() - s))
 

@@ -1,0 +1,32 @@
+"""GL4 fixture (clean): the SAFE pattern for metric reads near jit scope.
+
+Telemetry is host-side: record from RECORDED outputs — after the
+device->host hop (np.asarray / block) OUTSIDE the traced function — and
+keep the traced body pure jnp. This file must produce ZERO findings; it
+is the positive example the telemetry instrumentation across core.py /
+simulator.py / sweep.py follows (the negative example — .item() on a
+traced value inside the jit — lives in gl4_trace.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from open_simulator_tpu.telemetry import counter, histogram
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def traced_step(cfg, xs):
+    # traced scope: pure jnp math, no host sync, no metric calls
+    scale = 2.0 if cfg else 1.0  # static flag: host branch is fine
+    return jnp.sum(xs) * scale
+
+
+def run_and_record(values):
+    out = traced_step(True, jnp.asarray(values))
+    hosted = float(np.asarray(out))  # device -> host OUTSIDE the jit
+    histogram("fixture_run_seconds").observe(hosted)
+    counter("fixture_runs_total").inc()
+    return hosted

@@ -236,7 +236,7 @@ def test_pipe_char_inside_printf_string(tmp_path):
 
 
 def test_null_profile_entry_tolerated(tmp_path):
-    from open_simulator_tpu.engine.profile import weight_overrides_from_file
+    from open_simulator_tpu.engine.sched_config import weight_overrides_from_file
     cfg = tmp_path / "sched.yaml"
     cfg.write_text("kind: KubeSchedulerConfiguration\nprofiles:\n  -\n")
     assert weight_overrides_from_file(str(cfg)) == {}

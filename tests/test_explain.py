@@ -1,0 +1,269 @@
+"""Explain decode: golden per-pod report on a small synthetic cluster,
+engine top-k contract, Simulator pass-through, and the CLI surface."""
+
+import json
+import textwrap
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu.core import AppResource, simulate
+from open_simulator_tpu.k8s.loader import ClusterResources
+from open_simulator_tpu.telemetry.explain import (
+    explain_result,
+    first_failing_op,
+    format_explain,
+)
+
+
+@pytest.fixture
+def small_cluster(node_factory, pod_factory):
+    """Two schedulable nodes (one tainted); a pod that fits, a pod whose
+    cpu fits nowhere (tolerating the taint, so cpu is the only failure),
+    and a pod blocked by the taint on one node and cpu on the other."""
+    cluster = ClusterResources()
+    cluster.nodes = [
+        node_factory("big", cpu_m=4000),
+        node_factory("small-tainted", cpu_m=1000, taints=[
+            {"key": "dedicated", "value": "infra", "effect": "NoSchedule"}]),
+    ]
+    apps = ClusterResources()
+    apps.pods = [
+        pod_factory("fits", cpu="500m"),
+        pod_factory("too-big", cpu="9000m",
+                    tolerations=[{"operator": "Exists"}]),
+        pod_factory("squeezed", cpu="3600m"),
+    ]
+    return cluster, [AppResource("a", apps)]
+
+
+def test_explain_golden_small_cluster(small_cluster):
+    cluster, apps = small_cluster
+    result = simulate(cluster, apps, config_overrides={"explain_topk": 2})
+    report = explain_result(result)
+
+    assert report["n_active_nodes"] == 2
+    assert report["summary"] == {"scheduled": 1, "unscheduled": 2}
+    by_pod = {e["pod"]: e for e in report["pods"]}
+
+    fits = by_pod["default/fits"]
+    assert fits["status"] == "scheduled" and not fits["forced"]
+    # rank-0 candidate IS the chosen node, and its parts sum to its score
+    assert fits["candidates"][0]["node"] == fits["node"]
+    top = fits["candidates"][0]
+    assert sum(top["parts"].values()) == pytest.approx(top["score"], abs=1e-2)
+    assert set(top["parts"]) == set(report["score_parts"])
+
+    too_big = by_pod["default/too-big"]
+    assert too_big["status"] == "unscheduled"
+    assert too_big["first_failing_op"] == "Insufficient cpu"
+    assert too_big["eliminations"] == [{"op": "Insufficient cpu", "nodes": 2}]
+    assert "0/2 nodes are available" in too_big["reason"]
+    assert too_big["candidates"] == []  # neg_inf sentinels dropped
+
+    squeezed = by_pod["default/squeezed"]
+    assert squeezed["status"] == "unscheduled"
+    # taint fires before the fit rows in the vendored pipeline order
+    assert squeezed["first_failing_op"] == (
+        "node(s) had taint that the pod didn't tolerate")
+    assert {e["op"]: e["nodes"] for e in squeezed["eliminations"]} == {
+        "node(s) had taint that the pod didn't tolerate": 1,
+        "Insufficient cpu": 1,
+    }
+
+
+def test_explain_matches_engine_fail_counts(small_cluster):
+    """The report's per-op decode must be the engine's fail_counts row,
+    not a recomputation."""
+    cluster, apps = small_cluster
+    result = simulate(cluster, apps)
+    report = explain_result(result)
+    keys = [p.key for p in result.snapshot.pods]
+    for entry in report["pods"]:
+        if entry["status"] != "unscheduled":
+            continue
+        i = keys.index(entry["pod"])
+        row = np.asarray(result.fail_counts[i])
+        assert entry["first_failing_op"] == first_failing_op(row, result.op_names)
+        assert sum(e["nodes"] for e in entry["eliminations"]) == int(row.sum())
+
+
+def test_topk_outputs_off_by_default(small_cluster):
+    cluster, apps = small_cluster
+    result = simulate(cluster, apps)
+    assert result.topk_node is None and result.score_part_names == []
+    # explain still works: failure decode only
+    report = explain_result(result)
+    assert all(e["candidates"] == [] for e in report["pods"])
+
+
+def test_topk_respects_node_count_and_order(small_cluster):
+    cluster, apps = small_cluster
+    # ask for more candidates than nodes: K clamps to N
+    result = simulate(cluster, apps, config_overrides={"explain_topk": 16})
+    assert result.topk_node.shape == (3, 2)
+    report = explain_result(result, top_k=1)
+    fits = next(e for e in report["pods"] if e["pod"] == "default/fits")
+    assert len(fits["candidates"]) == 1
+    # candidate scores are non-increasing in rank
+    full = explain_result(result)
+    for e in full["pods"]:
+        scores = [c["score"] for c in e["candidates"]]
+        assert scores == sorted(scores, reverse=True)
+
+
+def test_explain_negative_topk_clamped(small_cluster):
+    cluster, apps = small_cluster
+    result = simulate(cluster, apps, config_overrides={"explain_topk": 2})
+    report = explain_result(result, top_k=-1)
+    assert all(e["candidates"] == [] for e in report["pods"])
+
+
+def test_explain_pod_filter_and_format(small_cluster):
+    cluster, apps = small_cluster
+    result = simulate(cluster, apps, config_overrides={"explain_topk": 2})
+    report = explain_result(result, pods=["default/too-big"])
+    assert [e["pod"] for e in report["pods"]] == ["default/too-big"]
+    text = format_explain(explain_result(result))
+    assert "default/fits: scheduled on" in text
+    assert "default/too-big: UNSCHEDULABLE" in text
+    assert "first failing op: Insufficient cpu" in text
+    assert "candidate" in text
+
+
+def test_forced_pod_marked(node_factory, pod_factory):
+    cluster = ClusterResources()
+    cluster.nodes = [node_factory("n0"), node_factory("n1")]
+    cluster.pods = [pod_factory("pinned", node_name="n1")]
+    result = simulate(cluster, [], config_overrides={"explain_topk": 2})
+    report = explain_result(result)
+    [entry] = report["pods"]
+    assert entry["forced"] and entry["status"] == "scheduled"
+    assert entry["node"] == "n1"
+    assert "pinned via spec.nodeName" in format_explain(report)
+
+
+def test_preempted_status_from_structured_marker(node_factory, pod_factory):
+    """Preempted victims are flagged via SimulateResult.preempted_pod_keys,
+    not by matching the reason string's wording."""
+    cluster = ClusterResources()
+    cluster.nodes = [node_factory("solo", cpu_m=1000)]
+    cluster.pods = [pod_factory("low", cpu="800m")]
+    high = pod_factory("high", cpu="800m")
+    high.priority = 1000
+    apps = ClusterResources()
+    apps.pods = [high]
+    result = simulate(cluster, [AppResource("a", apps)])
+    assert result.preempted_pod_keys == ["default/low"]
+    report = explain_result(result)
+    entry = next(e for e in report["pods"] if e["pod"] == "default/low")
+    assert entry["status"] == "preempted"
+    assert "preempted" in entry["reason"]
+    placed = next(e for e in report["pods"] if e["pod"] == "default/high")
+    assert placed["status"] == "scheduled" and placed["node"] == "solo"
+
+
+def test_simulator_session_carries_explain_surface(node_factory, pod_factory):
+    from open_simulator_tpu.simulator import Simulator
+
+    cluster = ClusterResources()
+    cluster.nodes = [node_factory("n0", cpu_m=2000)]
+    sim = Simulator(cluster, config_overrides={"explain_topk": 2})
+    sim.run_cluster()
+    apps = ClusterResources()
+    apps.pods = [pod_factory("w", cpu="500m",
+                             labels={"simon/app-name": "webapp"})]
+    res = sim.schedule_app(AppResource("webapp", apps))
+    # the trimmed per-app result still decodes (rows index the snapshot)
+    report = explain_result(res)
+    entry = next(e for e in report["pods"] if e["pod"] == "default/w")
+    assert entry["status"] == "scheduled" and entry["candidates"]
+    # trimmed result: explain covers ONLY the result's own pods — pods
+    # outside the app must not be mislabeled unscheduled from absence
+    assert {e["pod"] for e in report["pods"]} == {"default/w"}
+    assert all(e["status"] != "unscheduled" or e.get("reason")
+               for e in report["pods"])
+
+
+def test_explain_cli_json_and_trace_out(tmp_path, capsys):
+    from open_simulator_tpu.cli.main import main
+
+    cluster_dir = tmp_path / "cluster"
+    cluster_dir.mkdir()
+    (cluster_dir / "node.yaml").write_text(textwrap.dedent("""
+        apiVersion: v1
+        kind: Node
+        metadata: {name: c0}
+        status:
+          allocatable: {cpu: '2', memory: 4Gi, pods: '110'}
+    """))
+    app_dir = tmp_path / "app"
+    app_dir.mkdir()
+    (app_dir / "pods.yaml").write_text(textwrap.dedent("""
+        apiVersion: v1
+        kind: Pod
+        metadata: {name: ok, namespace: default}
+        spec:
+          containers: [{name: c, resources: {requests: {cpu: 500m}}}]
+        ---
+        apiVersion: v1
+        kind: Pod
+        metadata: {name: nope, namespace: default}
+        spec:
+          containers: [{name: c, resources: {requests: {cpu: '32'}}}]
+    """))
+    config = tmp_path / "config.yaml"
+    config.write_text(textwrap.dedent("""
+        apiVersion: simon/v1alpha1
+        kind: Config
+        metadata: {name: explain-test}
+        spec:
+          cluster: {customConfig: cluster}
+          appList:
+            - {name: app, path: app}
+    """))
+    trace_path = tmp_path / "trace.json"
+    rc = main(["explain", "-f", str(config), "--json",
+               "--trace-out", str(trace_path)])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["summary"] == {"scheduled": 1, "unscheduled": 1}
+    nope = next(e for e in report["pods"] if e["pod"] == "default/nope")
+    assert nope["first_failing_op"] == "Insufficient cpu"
+
+    # --trace-out wrote a Perfetto-loadable Chrome trace with the nested
+    # simulate phases
+    doc = json.loads(trace_path.read_text())
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"simulate", "encode", "schedule", "decode"} <= names
+    sim_ev = next(e for e in doc["traceEvents"] if e["name"] == "simulate")
+    enc_ev = next(e for e in doc["traceEvents"] if e["name"] == "encode")
+    assert sim_ev["ts"] <= enc_ev["ts"]
+    assert enc_ev["ts"] + enc_ev["dur"] <= sim_ev["ts"] + sim_ev["dur"] + 1
+    # a cache-miss "compile" event must nest strictly INSIDE schedule —
+    # Perfetto nests by containment, an overlapping sibling renders wrong
+    if "compile" in names:
+        sch = next(e for e in doc["traceEvents"] if e["name"] == "schedule")
+        comp = next(e for e in doc["traceEvents"] if e["name"] == "compile")
+        assert sch["ts"] <= comp["ts"]
+        assert comp["ts"] + comp["dur"] <= sch["ts"] + sch["dur"]
+
+
+def test_explain_cli_missing_config_errors(tmp_path, capsys):
+    from open_simulator_tpu.cli.main import main
+
+    rc = main(["explain", "-f", str(tmp_path / "nope.yaml")])
+    assert rc == 1
+    assert "error:" in capsys.readouterr().err
+
+
+def test_chaos_cli_unwritable_trace_out_errors_cleanly(capsys):
+    """An unwritable --trace-out path must exit 1 with an error message
+    like apply/explain, not escape as a traceback."""
+    from open_simulator_tpu.cli.main import main
+
+    rc = main(["chaos", "--cluster-config", "examples/cluster/demo",
+               "--kill-node", "worker-a-0",
+               "--trace-out", "/nonexistent-dir/t.json"])
+    assert rc == 1
+    assert "error:" in capsys.readouterr().err

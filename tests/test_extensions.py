@@ -91,7 +91,7 @@ def test_profile_filter_disable_maps_to_gates(tmp_path):
     """A KubeSchedulerConfiguration that disables filter plugins turns the
     matching engine gates off (the vendored framework would skip the
     de-registered plugin the same way)."""
-    from open_simulator_tpu.engine.profile import weight_overrides_from_file
+    from open_simulator_tpu.engine.sched_config import weight_overrides_from_file
 
     cfg_file = tmp_path / "sched.yaml"
     cfg_file.write_text("""

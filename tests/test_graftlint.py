@@ -124,6 +124,13 @@ def test_clean_fixture_is_clean():
     assert lint_fixture("clean_ok.py") == []
 
 
+def test_gl4_telemetry_safe_pattern_is_clean():
+    """Host-side metric recording from RECORDED outputs (np.asarray after
+    the jit, float() on host values) near traced code — the pattern the
+    telemetry instrumentation follows — must not trip GL4."""
+    assert lint_fixture("gl4_telemetry_ok.py") == []
+
+
 def test_suppression_swallows_finding_and_gl0_flags_naked_directive():
     fs = lint_fixture("suppressed.py")
     assert [f.code for f in fs] == ["GL0"]

@@ -7,7 +7,7 @@ import textwrap
 import numpy as np
 
 from open_simulator_tpu.core import AppResource, simulate
-from open_simulator_tpu.engine.profile import weight_overrides_from_file
+from open_simulator_tpu.engine.sched_config import weight_overrides_from_file
 from open_simulator_tpu.k8s.loader import ClusterResources, make_valid_node
 from open_simulator_tpu.k8s.local_storage import RES_DEVICE_HDD, RES_VG
 from open_simulator_tpu.k8s.objects import ANNO_NODE_LOCAL_STORAGE, ANNO_POD_LOCAL_STORAGE

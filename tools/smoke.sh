@@ -39,4 +39,23 @@ if [ "$rc" -ne 0 ]; then
 fi
 
 echo
+echo "== telemetry end to end (server + /metrics scrape + explain) =="
+env JAX_PLATFORMS=cpu python tools/metrics_smoke.py
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: telemetry stage exited $rc" >&2
+  exit "$rc"
+fi
+
+echo
+echo "== simon-tpu explain on the example cluster =="
+env JAX_PLATFORMS=cpu python -m open_simulator_tpu.cli explain \
+  -f examples/config.yaml --top-k 2
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: explain exited $rc" >&2
+  exit "$rc"
+fi
+
+echo
 echo "smoke OK"
