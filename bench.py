@@ -154,11 +154,19 @@ def main():
     ap.add_argument("--max-new", type=int)
     ap.add_argument("--skip-baseline", action="store_true")
     ap.add_argument(
+        "--compile-cache-dir", default="",
+        help="persistent XLA compile cache: repeat bench runs skip the "
+             "cold compile (engine/exec_cache.py)")
+    ap.add_argument(
         "--fail-reasons", action="store_true",
         help="time the simulate() path (per-op failure accounting in every "
              "lane) instead of the default sweep path",
     )
     args = ap.parse_args()
+    if args.compile_cache_dir:
+        from open_simulator_tpu.engine.exec_cache import enable_persistent_cache
+
+        enable_persistent_cache(args.compile_cache_dir)
     preset = PRESETS[args.preset]
     for k in ("nodes", "pods", "scenarios", "max_new"):
         if getattr(args, k) is None:

@@ -40,7 +40,11 @@ from open_simulator_tpu.k8s.loader import (
     make_valid_node,
 )
 from open_simulator_tpu.k8s.objects import Node
-from open_simulator_tpu.parallel.sweep import SweepThresholds, capacity_sweep
+from open_simulator_tpu.parallel.sweep import (
+    SweepThresholds,
+    capacity_bisect,
+    capacity_sweep,
+)
 from open_simulator_tpu.report.tables import full_report
 
 
@@ -57,6 +61,14 @@ class ApplyOptions:
     interactive: bool = False
     extended_resources: List[str] = field(default_factory=list)
     max_new_nodes: int = 128             # sweep upper bound
+    # "bisect" (default): galloping bisection over the monotone node-count
+    # axis, ~log_W(max_new) W-lane rounds reusing one compiled executable.
+    # "exhaustive": one lane per candidate count (what interactive mode
+    # needs — it decodes arbitrary counts — and what fail_reasons=True
+    # API callers keep).
+    sweep_mode: str = "bisect"
+    # opt-in jax persistent compilation cache directory (exec_cache)
+    compile_cache_dir: str = ""
 
 
 class ApplyError(RuntimeError):
@@ -236,21 +248,33 @@ class Applier:
 
             overrides = weight_overrides_from_file(self.opts.default_scheduler_config)
         self._preemption = not overrides.pop("_disable_preemption", False)
+        if self.opts.compile_cache_dir:
+            overrides.setdefault("compile_cache_dir", self.opts.compile_cache_dir)
         cfg = make_config(snapshot, **overrides)
         thresholds = self._thresholds()
 
         if self.opts.interactive:
+            # interactive decodes arbitrary user-chosen counts, so it needs
+            # every lane — bisection only probes the bracket
             return self._run_interactive(snapshot, cfg, thresholds, max_new)
 
-        # Batched sweep: candidate counts 0..max_new in one device program.
-        counts = list(range(max_new + 1))
-        plan = capacity_sweep(snapshot, cfg, counts, thresholds)
+        if self.opts.sweep_mode == "bisect":
+            # galloping bisection: feasibility is monotone in the count, so
+            # ~log_W(max_new) W-lane rounds replace max_new+1 lanes and
+            # every round reuses one compiled executable
+            plan = capacity_bisect(snapshot, cfg, max_new, thresholds)
+        else:
+            # exhaustive: candidate counts 0..max_new, one lane each
+            counts = list(range(max_new + 1))
+            plan = capacity_sweep(snapshot, cfg, counts, thresholds)
         if plan.best_count is None:
             self._say(
                 f"FAILED: apps do not fit even with {max_new} new node(s) "
                 f"(raise --max-new-nodes or adjust the newNode spec)"
             )
-            worst = self._result_for(snapshot, plan, len(counts) - 1, cfg)
+            # both modes probe max_new, so the last (largest) lane is the
+            # most-capacity view worth reporting
+            worst = self._result_for(snapshot, plan, len(plan.counts) - 1, cfg)
             self._say(full_report(worst, self.opts.extended_resources))
             return 1
 
@@ -270,9 +294,13 @@ class Applier:
                 f"batched sweep); the per-pod report below is authoritative"
             )
         if plan.best_count > 0:
+            how = (f"bisected {max_new + 1} candidates in "
+                   f"{len(plan.counts)} probes"
+                   if self.opts.sweep_mode == "bisect"
+                   else f"swept {len(plan.counts)} candidates in one batch")
             self._say(
                 f"cluster requires {plan.best_count} new node(s) of the given spec "
-                f"to satisfy all apps (swept {len(counts)} candidates in one batch)"
+                f"to satisfy all apps ({how})"
             )
         else:
             self._say("all apps fit on the existing cluster; no new nodes needed")
@@ -305,15 +333,24 @@ class Applier:
             # best_count message — is the authoritative per-pod report.
             import time
 
+            from open_simulator_tpu.engine import exec_cache
             from open_simulator_tpu.engine.preemption import run_with_preemption
             from open_simulator_tpu.engine.scheduler import schedule_pods
 
-            arrs = self._device_arrays_for(snapshot)
+            arrs, n_pods = self._device_arrays_for(snapshot)
             lane_active = np.asarray(masks[idx])
+            lane_active_pad = exec_cache.pad_vector(
+                lane_active, arrs.alloc.shape[0], False)
 
             def schedule_fn(disabled, nominated):
-                return schedule_pods(arrs, lane_active, cfg, disabled=disabled,
-                                     nominated=nominated)
+                return exec_cache.unpad_output(
+                    schedule_pods(
+                        arrs, lane_active_pad, cfg,
+                        disabled=exec_cache.pad_vector(
+                            disabled, arrs.req.shape[0], False),
+                        nominated=exec_cache.pad_vector(
+                            nominated, arrs.req.shape[0], -1)),
+                    n_pods)
 
             t0 = time.perf_counter()
             out, pre = run_with_preemption(
@@ -336,12 +373,18 @@ class Applier:
             # assignments so node picks and fail rows come from one run
             # (vmap vs single-lane reduction order can break exact ties
             # differently).
+            from open_simulator_tpu.engine import exec_cache
             from open_simulator_tpu.engine.scheduler import schedule_pods
 
-            out = schedule_pods(
-                self._device_arrays_for(snapshot), np.asarray(masks[idx]),
-                cfg._replace(fail_reasons=True),
-            )
+            arrs, n_pods = self._device_arrays_for(snapshot)
+            out = exec_cache.unpad_output(
+                schedule_pods(
+                    arrs,
+                    exec_cache.pad_vector(
+                        np.asarray(masks[idx]), arrs.alloc.shape[0], False),
+                    cfg._replace(fail_reasons=True),
+                ),
+                n_pods)
             return decode_result(
                 snapshot,
                 np.asarray(out.node),
@@ -360,12 +403,16 @@ class Applier:
         )
 
     def _device_arrays_for(self, snapshot):
-        """One host->device upload per snapshot, reused across the
-        interactive prompt loop's repeated lane decodes."""
+        """One bucketed host->device upload per snapshot, reused across the
+        interactive prompt loop's repeated lane decodes. Returns
+        (padded device arrays, real pod count) — the same bucket the sweep
+        lanes ran in, so a reasons-on re-run recompiles only for the
+        fail_reasons flag, never for a shape."""
         if getattr(self, "_arrs_snapshot", None) is not snapshot:
-            from open_simulator_tpu.engine.scheduler import device_arrays
+            from open_simulator_tpu.engine import exec_cache
 
-            self._arrs_cache = device_arrays(snapshot)
+            arrs, _, n_pods = exec_cache.bucketed_device_arrays(snapshot.arrays)
+            self._arrs_cache = (arrs, n_pods)
             self._arrs_snapshot = snapshot
         return self._arrs_cache
 

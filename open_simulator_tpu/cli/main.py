@@ -50,6 +50,17 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("-i", "--interactive", action="store_true", help="interactive add-node prompt loop")
     ap.add_argument("--extended-resources", default="", help="comma list, e.g. gpu")
     ap.add_argument("--max-new-nodes", type=int, default=128, help="sweep upper bound for added nodes")
+    ap.add_argument(
+        "--sweep-mode", choices=("bisect", "exhaustive"), default="bisect",
+        help="bisect (default): galloping bisection over the monotone "
+             "node-count axis — ~log(max-new-nodes) fixed-width lane "
+             "rounds reusing one compiled executable; exhaustive: one "
+             "lane per candidate count (interactive mode always uses "
+             "exhaustive)")
+    ap.add_argument(
+        "--compile-cache-dir", default="",
+        help="opt-in jax persistent compilation cache directory: repeat "
+             "runs (and restarted servers) skip cold XLA compiles")
     ap.add_argument("--trace-out", default="",
                     help="write a Chrome-trace JSON timeline of this run's "
                          "phases (open in chrome://tracing or Perfetto)")
@@ -101,6 +112,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--explain-topk", type=int, default=3,
                     help="candidate nodes recorded per pod during serving "
                          "simulations for GET /api/explain (0 disables)")
+    sp.add_argument(
+        "--compile-cache-dir", default="",
+        help="opt-in jax persistent compilation cache directory: a "
+             "restarted server skips cold XLA compiles for shapes it has "
+             "served before")
 
     ch = sub.add_parser(
         "chaos",
@@ -248,6 +264,8 @@ def main(argv=None) -> int:
             interactive=args.interactive,
             extended_resources=[s for s in args.extended_resources.split(",") if s],
             max_new_nodes=args.max_new_nodes,
+            sweep_mode=args.sweep_mode,
+            compile_cache_dir=args.compile_cache_dir,
         )
         try:
             with _trace_capture(args.trace_out):
@@ -338,6 +356,7 @@ def main(argv=None) -> int:
             max_body_bytes=args.max_body_mib * 1024 * 1024,
             request_timeout_s=args.request_timeout,
             explain_topk=args.explain_topk,
+            compile_cache_dir=args.compile_cache_dir,
         )
 
     if args.command == "gen-doc":

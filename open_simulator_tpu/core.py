@@ -15,8 +15,9 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from open_simulator_tpu.encode.snapshot import ClusterSnapshot, EncodeOptions, encode_cluster
+from open_simulator_tpu.engine import exec_cache
 from open_simulator_tpu.engine.queue import sort_pods_greedy
-from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
+from open_simulator_tpu.engine.scheduler import make_config, schedule_pods
 from open_simulator_tpu.k8s.loader import ClusterResources, make_valid_node
 from open_simulator_tpu.k8s.objects import ANNO_GPU_INDEX, Node, Pod
 from open_simulator_tpu.models.expand import expand_app_resources, expand_cluster_pods
@@ -308,9 +309,13 @@ def simulate(
         with span("encode"):
             snapshot = encode_cluster(nodes, pods, encode_options)
         cfg = make_config(snapshot, **config_overrides)
+        exec_cache.enable_persistent_cache(cfg.compile_cache_dir)
         with span("transfer"):
-            arrs = device_arrays(snapshot)
-        active_np = np.asarray(arrs.active)
+            # bucketed padding: snapshots in the same shape bucket present
+            # ONE shape to XLA, so consecutive simulate() calls on slightly
+            # different clusters reuse the compiled scan (exec_cache.py)
+            arrs, _, n_pods = exec_cache.bucketed_device_arrays(snapshot.arrays)
+        active_np = np.asarray(snapshot.arrays.active)
         preempted_by: Optional[Dict[int, int]] = None
         # schedule_phase counts compile-miss vs cache-hit off the jit-cache
         # delta and stamps a nested "compile" span on a miss
@@ -321,13 +326,22 @@ def simulate(
                 pdbs = list(cluster.pdbs) + [p for a in apps for p in a.resources.pdbs]
 
                 def schedule_fn(disabled, nominated):
-                    return schedule_pods(arrs, arrs.active, cfg, disabled=disabled,
-                                         nominated=nominated)
+                    # victim/nomination columns are built against the real
+                    # pod axis; pad to the bucket, slice the outputs back
+                    return exec_cache.unpad_output(
+                        schedule_pods(
+                            arrs, arrs.active, cfg,
+                            disabled=exec_cache.pad_vector(
+                                disabled, arrs.req.shape[0], False),
+                            nominated=exec_cache.pad_vector(
+                                nominated, arrs.req.shape[0], -1)),
+                        n_pods)
 
                 out, pre = run_with_preemption(snapshot, active_np, schedule_fn, pdbs)
                 preempted_by = pre.preempted_by
             else:
-                out = schedule_pods(arrs, arrs.active, cfg)
+                out = exec_cache.unpad_output(
+                    schedule_pods(arrs, arrs.active, cfg), n_pods)
             node_assign = np.asarray(out.node)  # blocks on device completion
             fail_counts = np.asarray(out.fail_counts)
         gpu_pick = np.asarray(out.gpu_pick) if cfg.enable_gpu else None

@@ -211,6 +211,40 @@ class SnapshotArrays:
     svol_key: np.ndarray       # [Nsv] i32 limit-key index per shared volume
 
 
+# ---- axis metadata ------------------------------------------------------
+# Canonical per-field axis declarations for SnapshotArrays, shared by the
+# consumers that must agree on them: parallel.sweep.shard_arrays (which
+# mesh axis partitions which array) and engine.exec_cache.pad_snapshot_arrays
+# (which axis the shape-bucketing pads). Declared here, next to the
+# dataclass, so adding a field forces one decision in one place — shape
+# heuristics would misfire whenever P happens to equal N.
+NODE_AXIS_FIRST = frozenset({
+    "alloc", "spec_id", "active", "is_new_node", "gpu_cap_mem", "gpu_count",
+    "gpu_slot", "unschedulable", "vg_cap", "sdev_cap", "sdev_ssd",
+    "vol_limit_cap",
+})
+NODE_AXIS_SECOND = frozenset({
+    "topo_onehot", "has_key", "class_affinity", "class_taint",
+    "class_node_aff_score", "class_taint_prefer", "pv_node_ok",
+    "class_vol_node", "class_vol_zone", "class_vol_bind",
+})
+POD_AXIS_FIRST = frozenset({
+    "req", "class_id", "forced_node", "ports", "match_groups",
+    "aff_group", "aff_key", "aff_valid", "aff_self",
+    "anti_group", "anti_key", "anti_valid",
+    "own_terms", "hit_terms", "match_gid", "own_tid", "hit_tid",
+    "spread_group", "spread_key", "spread_skew", "spread_hard", "spread_valid",
+    "pref_group", "pref_key", "pref_weight", "pref_valid", "pref_tid",
+    "hit_pref", "gpu_mem", "gpu_cnt", "gpu_forced", "gpu_has_forced",
+    "lvm_req", "sdev_req", "sdev_req_ssd",
+    "vol_cid", "vol_pv_missing", "wfc_ccid", "wfc_valid", "vol_limit_req",
+    "svol_id",
+})
+# vocab-axis arrays (term_key, pref_term_key, spec_alloc, pv_cand,
+# svol_key) carry neither a node nor a pod axis and are never padded
+# or sharded.
+
+
 @dataclass
 class ClusterSnapshot:
     arrays: SnapshotArrays

@@ -18,4 +18,14 @@ from open_simulator_tpu.engine.scheduler import (
     init_state,
     schedule_pods,
 )
+from open_simulator_tpu.engine.exec_cache import (
+    EXEC_CACHE,
+    BucketPolicy,
+    bucket_shape,
+    bucketed_device_arrays,
+    enable_persistent_cache,
+    pad_snapshot_arrays,
+    run_batched_cached,
+    unpad_output,
+)
 from open_simulator_tpu.engine.queue import sort_pods_greedy, sort_pods_affinity, sort_pods_toleration

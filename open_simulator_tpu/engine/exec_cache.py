@@ -1,0 +1,404 @@
+"""Compile-once, run-many: shape bucketing + AOT executable cache.
+
+Every new snapshot shape used to trigger a full XLA recompile of the
+scheduling scan — a re-simulated cluster that grew by one node, the
+applier's reasons-on re-run, and every fresh `jax.jit(jax.vmap(...))`
+wrapper in the sweep paid compile time again. This module amortizes all
+of that:
+
+* **Bucketing** (`bucket_dim`, `pad_snapshot_arrays`): the node and pod
+  axes of `SnapshotArrays` are padded up to bucket boundaries — next
+  power of two with a linear tail, like serving-stack batch bucketing —
+  so every snapshot inside a bucket presents ONE shape to XLA. Padded
+  nodes are inactive (never feasible, never scored into a normalizer any
+  differently than existing inactive nodes) and padded pods are
+  bind-nothing sentinels (`forced_node == -4`, zero requests), so
+  results are bit-identical to the unpadded run; callers slice the
+  pod-axis outputs back with `unpad_output`.
+
+* **AOT executable cache** (`run_batched_cached`): the batched sweep
+  executable — `jax.jit(...).lower(...).compile()` — is cached in a
+  bounded LRU keyed on `(fn, cfg, array shapes, lane count, devices)`.
+  The sweep previously rebuilt a fresh `jax.jit(jax.vmap(lambda ...))`
+  wrapper per call, which defeats jax's own function-identity cache;
+  here round two of a bisection (and every later capacity question in
+  the same bucket) reuses round one's executable.
+
+* **Donated carries**: the cached executable takes the scan carry batch
+  as an argument and donates it (`donate_argnums`), resetting it to the
+  pristine init state on device. Back-to-back sweep rounds hand the
+  previous round's output state in, so the `[S, N, R]` headroom (and
+  the rest of the carry roster) stops double-buffering in HBM.
+  Contract: a donated state is DEAD after the call — host anything you
+  need from it first (see ARCHITECTURE.md section 9).
+
+* **Persistent compilation cache** (`enable_persistent_cache`): opt-in
+  via `--compile-cache-dir` / `EngineConfig.compile_cache_dir`, wires
+  `jax_compilation_cache_dir` so server restarts skip cold compiles.
+
+Telemetry extends the PR 3 jit-cache series instead of inventing names:
+hits/misses/evictions land in `simon_compile_cache_total{fn, event}` and
+compile wall time is a "compile" span (-> `simon_phase_seconds`).
+
+Trace-safety: all cache bookkeeping here is host-side (dict ops, string
+keys, counters) and runs strictly OUTSIDE jit scope; the traced bodies
+stay pure jnp (the pattern pinned by
+tests/fixtures/lint/gl4_execcache_ok.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from open_simulator_tpu.encode.snapshot import (
+    NODE_AXIS_FIRST,
+    NODE_AXIS_SECOND,
+    POD_AXIS_FIRST,
+    SnapshotArrays,
+)
+
+_log = logging.getLogger(__name__)
+
+
+# ---- bucketing policy ---------------------------------------------------
+
+@dataclass(frozen=True)
+class BucketPolicy:
+    """Round an axis length up to its bucket boundary.
+
+    Power-of-two steps up to `linear_from`, then multiples of
+    `linear_step` (the serving-stack batch-bucketing shape ladder:
+    geometric where relative padding waste is bounded, linear where a
+    doubling would waste half the axis). The defaults keep the tracked
+    north-star shape (5120 nodes x 51200 pods) exactly on a boundary, so
+    the benchmark series stays comparable.
+    """
+
+    enabled: bool = True
+    node_linear_from: int = 1024
+    node_linear_step: int = 1024
+    pod_linear_from: int = 2048
+    pod_linear_step: int = 2048
+
+
+def _default_policy() -> BucketPolicy:
+    # SIMON_BUCKETING=0 opts the whole process out (debug escape hatch)
+    return BucketPolicy(enabled=os.environ.get("SIMON_BUCKETING", "1") != "0")
+
+
+DEFAULT_POLICY = _default_policy()
+
+
+def bucket_dim(n: int, linear_from: int, linear_step: int) -> int:
+    """Smallest bucket boundary >= n (n <= 0 passes through untouched)."""
+    if n <= 0:
+        return n
+    if n <= linear_from:
+        p = 1
+        while p < n:
+            p *= 2
+        return p
+    return -(-n // linear_step) * linear_step
+
+
+def bucket_shape(n_nodes: int, n_pods: int,
+                 policy: Optional[BucketPolicy] = None) -> Tuple[int, int]:
+    p = policy or DEFAULT_POLICY
+    if not p.enabled:
+        return n_nodes, n_pods
+    return (bucket_dim(n_nodes, p.node_linear_from, p.node_linear_step),
+            bucket_dim(n_pods, p.pod_linear_from, p.pod_linear_step))
+
+
+# ---- SnapshotArrays padding --------------------------------------------
+
+# Non-default pad values. Everything else pads with 0/False, which is the
+# "does not exist" encoding already used for inactive nodes and invalid
+# term slots: forced_node -4 is the engine's bind-nothing sentinel (the
+# pre-reason path), the slot arrays use -1 as their empty marker, and a
+# padded node is marked unschedulable for defense in depth (its active
+# mask is already False, which alone keeps it infeasible and scored like
+# any other inactive node).
+_PAD_VALUES: Dict[str, Any] = {
+    "forced_node": -4,
+    "match_gid": -1,
+    "own_tid": -1,
+    "hit_tid": -1,
+    "svol_id": -1,
+    "unschedulable": True,
+}
+
+
+def pad_snapshot_arrays(arrs: SnapshotArrays, n_nodes_to: int,
+                        n_pods_to: int) -> SnapshotArrays:
+    """Pad the node and pod axes up to the given sizes (host numpy).
+
+    Padded nodes are inactive (`active` False) and padded pods are
+    bind-nothing sentinels, so the scan's placements, failure counts for
+    real pods, and carry trajectory are bit-identical to the unpadded
+    run — the padding only changes the static shapes XLA compiles for.
+    """
+    n = arrs.alloc.shape[0]
+    p = arrs.req.shape[0]
+    dn = n_nodes_to - n
+    dp = n_pods_to - p
+    if dn < 0 or dp < 0:
+        raise ValueError(
+            f"bucket ({n_nodes_to}, {n_pods_to}) smaller than snapshot "
+            f"({n}, {p})")
+    if dn == 0 and dp == 0:
+        return arrs
+
+    def pad(name: str, x):
+        x = np.asarray(x)
+        if name in NODE_AXIS_FIRST:
+            axis, grow = 0, dn
+        elif name in NODE_AXIS_SECOND:
+            axis, grow = 1, dn
+        elif name in POD_AXIS_FIRST:
+            axis, grow = 0, dp
+        else:
+            return x
+        if grow == 0:
+            return x
+        fill = _PAD_VALUES.get(name, False if x.dtype == np.bool_ else 0)
+        shape = list(x.shape)
+        shape[axis] = grow
+        block = np.full(shape, fill, dtype=x.dtype)
+        return np.concatenate([x, block], axis=axis)
+
+    out = {f.name: pad(f.name, getattr(arrs, f.name))
+           for f in dataclasses.fields(arrs)}
+    return type(arrs)(**out)
+
+
+def bucketed_device_arrays(arrs: SnapshotArrays,
+                           policy: Optional[BucketPolicy] = None):
+    """Pad to the bucket and transfer to the default device in one hop.
+    Returns (device_arrays, n_nodes_orig, n_pods_orig) — the originals
+    are what `unpad_output` and host-side decode need back."""
+    import jax
+    import jax.numpy as jnp
+
+    n, p = arrs.alloc.shape[0], arrs.req.shape[0]
+    nb, pb = bucket_shape(n, p, policy)
+    padded = pad_snapshot_arrays(arrs, nb, pb)
+    return jax.tree_util.tree_map(jnp.asarray, padded), n, p
+
+
+def pad_vector(vec, n_to: int, fill):
+    """Widen a host [K] vector to a padded axis length (None passes
+    through) — the preemption victim/nomination columns and chaos active
+    masks are built against the real axis and padded at the call site."""
+    if vec is None:
+        return None
+    vec = np.asarray(vec)
+    if vec.shape[0] >= n_to:
+        return vec
+    out = np.full((n_to,), fill, dtype=vec.dtype)
+    out[: vec.shape[0]] = vec
+    return out
+
+
+def unpad_output(out, n_pods: int):
+    """Slice the pod-axis outputs of a ScheduleOutput back to the real pod
+    count (the state keeps its padded node axis; host consumers read it
+    through active masks)."""
+    if out.node.shape[0] == n_pods:
+        return out
+    return out._replace(
+        node=out.node[:n_pods],
+        fail_counts=out.fail_counts[:n_pods],
+        feasible=out.feasible[:n_pods],
+        gpu_pick=out.gpu_pick[:n_pods],
+        vol_pick=out.vol_pick[:n_pods],
+        topk_node=out.topk_node[:n_pods],
+        topk_score=out.topk_score[:n_pods],
+        topk_parts=out.topk_parts[:n_pods],
+    )
+
+
+# ---- AOT executable cache ----------------------------------------------
+
+def _shape_sig(arrs) -> Tuple:
+    out = []
+    for f in dataclasses.fields(arrs):
+        x = getattr(arrs, f.name)
+        out.append((f.name, tuple(x.shape), str(x.dtype)))
+    return tuple(out)
+
+
+class ExecutableCache:
+    """Bounded LRU of AOT-compiled executables.
+
+    Keys are host tuples (fn name, EngineConfig, shape signatures, device
+    ids); values are `jax.stages.Compiled` objects. Thread-safe: the REST
+    server can answer capacity questions concurrently with a chaos run.
+    Hits/misses/evictions extend the PR 3 `simon_compile_cache_total`
+    series; compile wall time is recorded as a "compile" span.
+    """
+
+    def __init__(self, capacity: int = 8):
+        self.capacity = max(1, int(capacity))
+        self._entries: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def _count(self, fn_name: str, event: str) -> None:
+        from open_simulator_tpu.telemetry import counter
+        from open_simulator_tpu.telemetry.runtime import COMPILE_CACHE_TOTAL
+
+        counter(
+            COMPILE_CACHE_TOTAL,
+            "jit compilation-cache outcomes per schedule phase",
+            labelnames=("fn", "event"),
+        ).labels(fn=fn_name, event=event).inc()
+
+    def get_or_compile(self, key: Tuple, fn_name: str,
+                       build: Callable[[], Any]):
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                self._count(fn_name, "hit")
+                return hit
+        # compile OUTSIDE the lock: a cold north-star compile takes
+        # minutes and must not block a concurrent cache hit
+        self._count(fn_name, "miss")
+        from open_simulator_tpu.telemetry.spans import span
+
+        t0 = time.perf_counter()
+        with span("compile", fn=fn_name):
+            compiled = build()
+        _log.debug("compiled %s in %.3fs (cache size %d)", fn_name,
+                   time.perf_counter() - t0, len(self._entries) + 1)
+        with self._lock:
+            self._entries[key] = compiled
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self._count(fn_name, "eviction")
+        return compiled
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+EXEC_CACHE = ExecutableCache(
+    capacity=int(os.environ.get("SIMON_EXEC_CACHE_SIZE", "8")))
+
+
+def _fresh_lane_state(prev, arrs):
+    """Reset a (donated) carry to the pristine init values on device.
+
+    Reading every leaf (`x * 0` / `x & False`) keeps the donated buffers
+    live inputs so XLA aliases them into the output state instead of
+    allocating a second copy; the values are exactly `init_state`'s
+    (zeros everywhere, headroom = alloc)."""
+    import jax
+    import jax.numpy as jnp
+
+    def z(x):
+        return x & False if jnp.issubdtype(x.dtype, jnp.bool_) else x * 0
+
+    zeroed = jax.tree_util.tree_map(z, prev)
+    return zeroed._replace(
+        headroom=zeroed.headroom + jnp.asarray(arrs.alloc, jnp.float32))
+
+
+def _zeros_carry_batch(arrs, cfg, lanes: int):
+    import jax
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.engine.scheduler import init_state
+
+    proto = init_state(arrs, cfg)
+    return jax.tree_util.tree_map(
+        lambda x: jnp.zeros((lanes,) + x.shape, x.dtype), proto)
+
+
+def run_batched_cached(arrs, masks, cfg, carry=None,
+                       fn_name: str = "batched_schedule"):
+    """Run the vmapped scan over scenario lanes through the AOT cache.
+
+    `masks` is the [S, N] per-lane active matrix. `carry` is an optional
+    donated state batch (a previous round's `out.state`); its buffers are
+    reset to the init values on device and reused for this round's carry
+    — after the call the passed-in state is DEAD. With carry=None a fresh
+    zeros batch is allocated (and still donated, so the executable is the
+    same either way)."""
+    import jax
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.engine.scheduler import schedule_pods
+
+    masks = jnp.asarray(masks)
+    lanes = int(masks.shape[0])
+    if carry is None:
+        carry = _zeros_carry_batch(arrs, cfg, lanes)
+    key = (fn_name, cfg, _shape_sig(arrs), (lanes,) + tuple(masks.shape[1:]),
+           str(masks.dtype),
+           tuple(str(d) for d in jax.devices()))
+
+    def build():
+        def fn(a, m, c):
+            def lane(mask_row, carry_row):
+                return schedule_pods(a, mask_row, cfg,
+                                     state=_fresh_lane_state(carry_row, a),
+                                     state_is_fresh=True)
+
+            return jax.vmap(lane)(m, c)
+
+        return jax.jit(fn, donate_argnums=(2,)).lower(
+            arrs, masks, carry).compile()
+
+    compiled = EXEC_CACHE.get_or_compile(key, fn_name, build)
+    return compiled(arrs, masks, carry)
+
+
+# ---- persistent compilation cache --------------------------------------
+
+_persistent_dir: Optional[str] = None
+
+
+def enable_persistent_cache(path: str) -> None:
+    """Opt into jax's on-disk compilation cache so process restarts skip
+    cold compiles (the `--compile-cache-dir` CLI flag and
+    `EngineConfig.compile_cache_dir` both land here). Idempotent."""
+    global _persistent_dir
+    if not path or _persistent_dir == path:
+        return
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # the scan compiles this repo cares about are small on tier-1 shapes;
+    # cache everything rather than only minute-long compiles
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    try:
+        # jax initializes its on-disk cache AT MOST ONCE, on the first
+        # compile — and imports (chex) compile tiny helpers before any
+        # caller can reach this function, freezing "no cache dir" forever.
+        # Reset so the next compile re-initializes against the dir above.
+        from jax._src import compilation_cache as _jax_cc
+
+        _jax_cc.reset_cache()
+    except Exception:  # noqa: BLE001 — private API drift: cache best-effort
+        _log.warning("could not reset jax's compilation-cache state; the "
+                     "persistent cache may stay cold this process")
+    _persistent_dir = path
+    _log.info("persistent compilation cache enabled at %s", path)

@@ -145,6 +145,12 @@ class EngineConfig(NamedTuple):
     # carry no gpu/storage/WFC-volume claims (those picks are
     # order-dependent within the prefix) and no extensions are registered.
     forced_prefix: int = 0
+    # Opt-in on-disk XLA compilation cache (engine/exec_cache.py
+    # enable_persistent_cache): when non-empty, the simulate/sweep entry
+    # points point jax_compilation_cache_dir here so a restarted server
+    # or a re-run CLI skips cold compiles. Not read inside the trace —
+    # it configures the jax runtime, once, on the host.
+    compile_cache_dir: str = ""
 
     @property
     def enable_spread(self) -> bool:
@@ -1135,7 +1141,8 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
                        topk_node, topk_score, topk_parts)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
+@functools.partial(jax.jit, static_argnames=("cfg", "state_is_fresh"),
+                   donate_argnames=("state",))
 def schedule_pods(
     arrs: SnapshotArrays,
     active: jnp.ndarray,
@@ -1143,18 +1150,28 @@ def schedule_pods(
     state: SimState | None = None,
     disabled: jnp.ndarray | None = None,
     nominated: jnp.ndarray | None = None,
+    state_is_fresh: bool = False,
 ) -> ScheduleOutput:
     """Scan the pod sequence, return assignments + reason counts + final state.
 
     disabled [P] bool marks preemption victims (treated as deleted);
     nominated [P] i32 is the preemption retry's nominatedNodeName (-1 = none).
+
+    A passed-in `state` is DONATED: its device buffers are reused for the
+    output state, so the caller's copy is dead after the call (host what
+    you need first; numpy-backed states are unaffected — only their
+    transient device copy is consumed). `state_is_fresh=True` declares a
+    caller-built pristine init state (the exec-cache donation path), which
+    keeps the forced-bind prefix hoisting that a resumed state must skip.
     """
     n_pods = arrs.req.shape[0]
     # forced-bind prefix hoisting: only from a fresh state with no
     # preemption columns (victim/nomination indices cover the full
-    # sequence; resumed states already contain their prefix)
+    # sequence; resumed states already contain their prefix — a donated
+    # state flagged fresh is an init state and hoists like None)
     k = min(cfg.forced_prefix, n_pods)
-    if k and (state is not None or disabled is not None or nominated is not None):
+    if k and ((state is not None and not state_is_fresh)
+              or disabled is not None or nominated is not None):
         k = 0
     if state is None:
         state = init_state(arrs, cfg)

@@ -20,6 +20,7 @@ from open_simulator_tpu.parallel.sweep import (
     CapacityPlan,
     SweepThresholds,
     batched_schedule,
+    capacity_bisect,
     capacity_sweep,
     make_mesh,
 )

@@ -16,6 +16,7 @@ must stay under MaxCPU/MaxMemory.
 from __future__ import annotations
 
 import functools
+import logging
 from dataclasses import dataclass, field
 from typing import Dict, List, NamedTuple, Optional, Sequence
 
@@ -25,12 +26,19 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from open_simulator_tpu.encode.snapshot import ClusterSnapshot
+from open_simulator_tpu.engine.exec_cache import (
+    bucketed_device_arrays,
+    enable_persistent_cache,
+    run_batched_cached,
+)
 from open_simulator_tpu.engine.scheduler import (
     EngineConfig,
     ScheduleOutput,
     device_arrays,
     schedule_pods,
 )
+
+_log = logging.getLogger(__name__)
 
 
 class SweepThresholds(NamedTuple):
@@ -90,29 +98,40 @@ def batched_schedule(
     active_batch: jnp.ndarray,  # [S, N]
     cfg: EngineConfig,
     mesh: Optional[Mesh] = None,
+    carry: Optional[object] = None,
 ) -> ScheduleOutput:
     """vmap the scan over scenario lanes; shard lanes over the mesh.
 
     The snapshot arrays are broadcast (replicated) across the scenario
     axis; only the active mask differs per lane. With a mesh, GSPMD
-    shards the lane axis; without, it is a single-device vmap.
+    shards the lane axis; without, the single-device vmap runs through
+    the AOT executable cache (engine/exec_cache.py), so every call with
+    the same bucketed shapes + cfg reuses one compiled executable —
+    building a fresh `jax.jit(jax.vmap(lambda ...))` wrapper per call
+    (the old shape of this function) defeats jax's function-identity
+    cache and recompiled the whole sweep every time.
+
+    `carry` is an optional DONATED state batch (a previous round's
+    `out.state`, dead after this call) whose buffers back this run's
+    carry — only the AOT path supports it.
     """
+    if mesh is None or mesh.empty:
+        return run_batched_cached(arrs, active_batch, cfg, carry=carry)
+    if carry is not None:
+        raise ValueError("carry donation requires mesh=None (the AOT path)")
     fn = jax.vmap(lambda a: schedule_pods(arrs, a, cfg))
-    if mesh is not None and not mesh.empty:
-        lane = NamedSharding(mesh, P("scenario"))
-        fn = jax.jit(
-            fn,
-            in_shardings=(NamedSharding(mesh, P("scenario", None)),),
-            out_shardings=ScheduleOutput(
-                node=lane, fail_counts=lane, feasible=lane, gpu_pick=lane,
-                vol_pick=lane, topk_node=lane, topk_score=lane,
-                topk_parts=lane,
-                state=jax.tree_util.tree_map(lambda _: lane, _state_proto(arrs)),
-            ),
-        )
-        active_batch = jax.device_put(active_batch, NamedSharding(mesh, P("scenario", None)))
-    else:
-        fn = jax.jit(fn)
+    lane = NamedSharding(mesh, P("scenario"))
+    fn = jax.jit(
+        fn,
+        in_shardings=(NamedSharding(mesh, P("scenario", None)),),
+        out_shardings=ScheduleOutput(
+            node=lane, fail_counts=lane, feasible=lane, gpu_pick=lane,
+            vol_pick=lane, topk_node=lane, topk_score=lane,
+            topk_parts=lane,
+            state=jax.tree_util.tree_map(lambda _: lane, _state_proto(arrs)),
+        ),
+    )
+    active_batch = jax.device_put(active_batch, NamedSharding(mesh, P("scenario", None)))
     return fn(active_batch)
 
 
@@ -128,21 +147,20 @@ def shard_arrays(arrs, mesh: Mesh):
     exceeds one chip's HBM). Pod-axis and vocab arrays are replicated;
     GSPMD inserts the all-gathers/argmax reductions the scan step needs.
 
-    The node-axis position is declared explicitly per array (shape
-    heuristics would misfire when P happens to equal N).
+    The node-axis position per array comes from the canonical
+    declarations next to the dataclass (encode/snapshot.py
+    NODE_AXIS_FIRST/NODE_AXIS_SECOND, shared with the bucketing pad —
+    shape heuristics would misfire when P happens to equal N).
     """
-    node_first = {"alloc", "active", "is_new_node", "gpu_cap_mem", "gpu_count", "gpu_slot",
-                  "unschedulable", "vg_cap", "sdev_cap", "sdev_ssd",
-                  "vol_limit_cap", "spec_id"}
-    node_second = {"topo_onehot", "has_key", "class_affinity", "class_taint",
-                   "class_node_aff_score", "class_taint_prefer",
-                   "pv_node_ok", "class_vol_node", "class_vol_zone",
-                   "class_vol_bind"}
+    from open_simulator_tpu.encode.snapshot import (
+        NODE_AXIS_FIRST,
+        NODE_AXIS_SECOND,
+    )
 
     def spec_for(name: str, x) -> P:
-        if name in node_first:
+        if name in NODE_AXIS_FIRST:
             return P("node", *([None] * (x.ndim - 1)))
-        if name in node_second:
+        if name in NODE_AXIS_SECOND:
             return P(None, "node", *([None] * (x.ndim - 2)))
         return P(*([None] * x.ndim))
 
@@ -169,6 +187,60 @@ def active_masks_for_counts(snapshot: ClusterSnapshot, counts: Sequence[int]) ->
     return masks
 
 
+def _padded_lane_masks(masks: np.ndarray, n_nodes_padded: int) -> np.ndarray:
+    """Widen [S, N] lane masks to the bucketed node axis (pads are never
+    active in any lane)."""
+    s, n = masks.shape
+    if n == n_nodes_padded:
+        return masks
+    out = np.zeros((s, n_nodes_padded), dtype=bool)
+    out[:, :n] = masks
+    return out
+
+
+class _LaneStats(NamedTuple):
+    all_scheduled: bool
+    cpu_pct: float
+    mem_pct: float
+    satisfied: bool
+
+
+def _lane_stats(alloc, cpu_i, mem_i, vg_cap, has_storage, lane_active,
+                nodes_row, headroom_row, vg_row, error,
+                thresholds: SweepThresholds) -> _LaneStats:
+    """Verdict for one lane from its hosted outputs — shared by the
+    exhaustive sweep and the bisection so both apply one definition of
+    "satisfied" (all pods scheduled AND occupancy under thresholds)."""
+    ok = error is None and bool(np.all(nodes_row >= 0))
+    used = alloc - headroom_row                         # [N, R]
+
+    def occupancy(ri) -> float:
+        tot = float(np.sum(alloc[lane_active, ri]))
+        u = float(np.sum(used[lane_active, ri]))
+        return 100.0 * u / tot if tot else 0.0
+
+    def vg_occupancy() -> float:
+        """MaxVG is enforced per volume group: the WORST VG's occupancy
+        across active nodes (the reference parses MaxVG but never checks
+        it, apply.go:614-681 — per-VG is the meaningful strictness)."""
+        cap = vg_cap[lane_active]                       # [n, V]
+        u = vg_row[lane_active]
+        with np.errstate(invalid="ignore", divide="ignore"):
+            pct = np.where(cap > 0, 100.0 * u / np.where(cap > 0, cap, 1.0), 0.0)
+        return float(pct.max()) if pct.size else 0.0
+
+    c_pct = occupancy(cpu_i)
+    m_pct = occupancy(mem_i)
+    v_pct = vg_occupancy() if has_storage else 0.0
+    sat = (
+        ok
+        and c_pct <= thresholds.max_cpu_pct
+        and m_pct <= thresholds.max_memory_pct
+        and v_pct <= thresholds.max_vg_pct
+    )
+    return _LaneStats(ok, c_pct, m_pct, sat)
+
+
 def capacity_sweep(
     snapshot: ClusterSnapshot,
     cfg: EngineConfig,
@@ -191,57 +263,36 @@ def capacity_sweep(
     Device execution is retried with exponential backoff (`retries`,
     `backoff_s`); if the batched run still fails and `isolate_trials`,
     each lane re-runs alone so one failing trial cannot kill the sweep —
-    failed lanes land in CapacityPlan.trial_errors instead."""
+    failed lanes land in CapacityPlan.trial_errors instead.
+
+    When feasibility alone is the question, `capacity_bisect` answers
+    with ~log_W(max_new) W-lane rounds instead of one lane per count."""
     from open_simulator_tpu.telemetry.spans import span
 
-    arrs = device_arrays(snapshot)
-    masks = active_masks_for_counts(snapshot, counts)
+    enable_persistent_cache(cfg.compile_cache_dir)
+    arrs, _, n_pods = bucketed_device_arrays(snapshot.arrays)
+    masks = _padded_lane_masks(
+        active_masks_for_counts(snapshot, counts), arrs.alloc.shape[0])
     sweep_cfg = cfg if fail_reasons else cfg._replace(fail_reasons=False)
     with span("sweep", lanes=len(counts)):
-        nodes, fail, headroom, vg_used_arr, gpu, vol, trial_errors = _execute_sweep(
-            arrs, masks, sweep_cfg, mesh, fail_reasons, retries, backoff_s,
-            isolate_trials)
+        nodes, fail, headroom, vg_used_arr, gpu, vol, trial_errors, _ = (
+            _execute_sweep(arrs, masks, sweep_cfg, mesh, fail_reasons,
+                           retries, backoff_s, isolate_trials, n_pods=n_pods))
     alloc = np.asarray(arrs.alloc)             # [N, R]
-    used = alloc[None] - headroom              # [S, N, R]
-
     cpu_i = snapshot.resources.index("cpu")
     mem_i = snapshot.resources.index("memory")
     vg_cap = np.asarray(arrs.vg_cap)           # [N, V]
     has_storage = bool(np.any(vg_cap > 0))
-    vg_used_all = vg_used_arr if has_storage else None
-
-    def occupancy(si, lane_active, ri) -> float:
-        tot = float(np.sum(alloc[lane_active, ri]))
-        u = float(np.sum(used[si][lane_active, ri]))
-        return 100.0 * u / tot if tot else 0.0
-
-    def vg_occupancy(si, lane_active) -> float:
-        """MaxVG is enforced per volume group: the WORST VG's occupancy
-        across active nodes (the reference parses MaxVG but never checks
-        it, apply.go:614-681 — per-VG is the meaningful strictness)."""
-        cap = vg_cap[lane_active]                       # [n, V]
-        u = vg_used_all[si][lane_active]
-        with np.errstate(invalid="ignore", divide="ignore"):
-            pct = np.where(cap > 0, 100.0 * u / np.where(cap > 0, cap, 1.0), 0.0)
-        return float(pct.max()) if pct.size else 0.0
 
     all_scheduled, cpu_occ, mem_occ, satisfied = [], [], [], []
     for si in range(len(counts)):
-        lane_active = masks[si]
-        ok = si not in trial_errors and bool(np.all(nodes[si] >= 0))
-        c_pct = occupancy(si, lane_active, cpu_i)
-        m_pct = occupancy(si, lane_active, mem_i)
-        v_pct = vg_occupancy(si, lane_active) if has_storage else 0.0
-        sat = (
-            ok
-            and c_pct <= thresholds.max_cpu_pct
-            and m_pct <= thresholds.max_memory_pct
-            and v_pct <= thresholds.max_vg_pct
-        )
-        all_scheduled.append(ok)
-        cpu_occ.append(c_pct)
-        mem_occ.append(m_pct)
-        satisfied.append(sat)
+        st = _lane_stats(
+            alloc, cpu_i, mem_i, vg_cap, has_storage, masks[si], nodes[si],
+            headroom[si], vg_used_arr[si], trial_errors.get(si), thresholds)
+        all_scheduled.append(st.all_scheduled)
+        cpu_occ.append(st.cpu_pct)
+        mem_occ.append(st.mem_pct)
+        satisfied.append(st.satisfied)
 
     best = None
     for si in sorted(range(len(counts)), key=lambda i: counts[i]):
@@ -263,17 +314,155 @@ def capacity_sweep(
     )
 
 
+def _probe_ladder(max_new: int, lanes: int) -> List[int]:
+    """First bisection round: a geometric ladder with both endpoints —
+    0 (is the cluster already enough?) and max_new (is it impossible?) —
+    downsampled evenly to the lane budget."""
+    ladder = sorted({0, max_new} | {
+        min(1 << i, max_new) for i in range(max(max_new, 1).bit_length())})
+    if len(ladder) > lanes:
+        idx = np.round(np.linspace(0, len(ladder) - 1, lanes)).astype(int)
+        ladder = sorted({ladder[i] for i in idx})
+    return ladder
+
+
+def capacity_bisect(
+    snapshot: ClusterSnapshot,
+    cfg: EngineConfig,
+    max_new: int,
+    thresholds: SweepThresholds = SweepThresholds(),
+    mesh: Optional[Mesh] = None,
+    lanes: int = 8,
+    retries: int = 2,
+    backoff_s: float = 0.05,
+    isolate_trials: bool = True,
+) -> CapacityPlan:
+    """Minimum satisfying node count by batched galloping bisection.
+
+    Feasibility is monotone in the node count (more nodes never
+    unschedule a pod, and occupancy only falls), so instead of one lane
+    per candidate (S = max_new + 1 device lanes) each round runs `lanes`
+    probes covering the current bracket and shrinks it ~(lanes+1)x:
+    round one is a geometric ladder bracketing the answer (endpoints 0
+    and max_new always probed, so "fits already" and "impossible" are
+    one-round answers), later rounds spread evenly inside the bracket.
+    The `[lanes, N]` mask shape is FIXED across rounds, so every round
+    after the first reuses the round-one compiled executable (the AOT
+    cache), and each round donates the previous round's carry buffers
+    back to the device.
+
+    Returns a CapacityPlan over the PROBED counts only (sorted);
+    `best_count` equals the exhaustive sweep's on monotone clusters.
+    Probes run with fail_reasons off always — callers that want per-op
+    reasons in every lane need `capacity_sweep(fail_reasons=True)`.
+    Retry/isolation semantics per round match the exhaustive sweep
+    (`trial_errors` keys index the sorted probed counts)."""
+    from open_simulator_tpu.telemetry.spans import span
+
+    if max_new < 0:
+        raise ValueError(f"max_new must be >= 0, got {max_new}")
+    enable_persistent_cache(cfg.compile_cache_dir)
+    arrs, _, n_pods = bucketed_device_arrays(snapshot.arrays)
+    n_pad = arrs.alloc.shape[0]
+    alloc = np.asarray(arrs.alloc)
+    cpu_i = snapshot.resources.index("cpu")
+    mem_i = snapshot.resources.index("memory")
+    vg_cap = np.asarray(arrs.vg_cap)
+    has_storage = bool(np.any(vg_cap > 0))
+    sweep_cfg = cfg._replace(fail_reasons=False)
+    lanes = max(1, min(lanes, max_new + 1))
+
+    records: Dict[int, dict] = {}      # count -> hosted lane outputs
+    carry_holder = {"carry": None}     # donated across rounds (mesh=None)
+
+    def probe(counts_round: List[int]) -> None:
+        # fixed [lanes, N] mask shape: pad the round by repeating the
+        # last probe so every round reuses one compiled executable
+        cs = list(counts_round) + [counts_round[-1]] * (lanes - len(counts_round))
+        masks = _padded_lane_masks(
+            active_masks_for_counts(snapshot, cs), n_pad)
+        with span("sweep", lanes=lanes, mode="bisect"):
+            nodes, _, headroom, vg_used, gpu, vol, errs, state = _execute_sweep(
+                arrs, masks, sweep_cfg, mesh, False, retries, backoff_s,
+                isolate_trials, n_pods=n_pods,
+                carry=carry_holder["carry"] if mesh is None else None,
+                return_state=mesh is None)
+        carry_holder["carry"] = state
+        for i, c in enumerate(cs):
+            if c in records:
+                continue
+            stats = _lane_stats(alloc, cpu_i, mem_i, vg_cap, has_storage,
+                                masks[i], nodes[i], headroom[i], vg_used[i],
+                                errs.get(i), thresholds)
+            records[c] = dict(nodes=nodes[i], gpu=gpu[i], vol=vol[i],
+                              error=errs.get(i), stats=stats)
+
+    probe(_probe_ladder(max_new, lanes))
+
+    def bracket():
+        sat = sorted(c for c, r in records.items() if r["stats"].satisfied)
+        hi = sat[0] if sat else None
+        lo = max((c for c in records
+                  if (hi is None or c < hi) and not records[c]["stats"].satisfied),
+                 default=-1)
+        return lo, hi
+
+    lo, hi = bracket()
+    while hi is not None and hi - lo > 1:
+        cands = sorted(set(
+            int(c) for c in np.round(np.linspace(lo + 1, hi - 1, lanes))
+        ) - set(records))
+        if not cands:
+            break  # every interior count probed; hi is the minimum
+        probe(cands)
+        lo, hi = bracket()
+
+    probed = sorted(records)
+    stats = [records[c]["stats"] for c in probed]
+    return CapacityPlan(
+        counts=probed,
+        all_scheduled=[s.all_scheduled for s in stats],
+        cpu_occupancy_pct=[s.cpu_pct for s in stats],
+        mem_occupancy_pct=[s.mem_pct for s in stats],
+        satisfied=[s.satisfied for s in stats],
+        best_count=hi,
+        nodes_per_scenario=np.stack([records[c]["nodes"] for c in probed]),
+        fail_counts=np.zeros((len(probed), n_pods, cfg.n_ops), dtype=np.int32),
+        gpu_pick=(np.stack([records[c]["gpu"] for c in probed])
+                  if cfg.enable_gpu else None),
+        vol_pick=(np.stack([records[c]["vol"] for c in probed])
+                  if cfg.enable_pv_match else None),
+        trial_errors={i: records[c]["error"] for i, c in enumerate(probed)
+                      if records[c]["error"]},
+    )
+
+
+def _record_lane_error(trial_errors: Dict[int, str], si: int, msg: str) -> None:
+    """Accumulate (never overwrite) per-lane diagnostics — a lane whose
+    gpu AND vol pick widths both drifted must report both."""
+    trial_errors[si] = f"{trial_errors[si]}; {msg}" if si in trial_errors else msg
+
+
 def _execute_sweep(arrs, masks, sweep_cfg, mesh, fail_reasons,
-                   retries, backoff_s, isolate_trials):
+                   retries, backoff_s, isolate_trials, n_pods=None,
+                   carry=None, return_state=False):
     """Run the batched sweep with retry; fall back to isolated per-lane
     runs when the batch keeps failing. Returns host numpy
-    (nodes, fail, headroom, vg_used, gpu_pick, vol_pick, trial_errors);
-    failed lanes hold neutral values (all -1 nodes, pristine headroom)."""
+    (nodes, fail, headroom, vg_used, gpu_pick, vol_pick, trial_errors,
+    state); pod-axis outputs are sliced to `n_pods` (the bucketing pad
+    rows carry no information). `state` is the device-side output carry
+    when `return_state` (for donation into the next round; None on the
+    isolated-fallback path), else None. A passed `carry` is donated to
+    the FIRST batched attempt only — retries re-run from fresh buffers
+    because the donated ones are already dead. Failed lanes hold neutral
+    values (all -1 nodes, pristine headroom)."""
     import time as _time
 
     from open_simulator_tpu.resilience.retry import run_with_retries
     from open_simulator_tpu.telemetry import registry as _telemetry
 
+    if n_pods is None:
+        n_pods = arrs.req.shape[0]
     trials_total = _telemetry.counter(
         "simon_sweep_trials_total", "capacity-sweep lane outcomes",
         labelnames=("outcome",))
@@ -283,34 +472,56 @@ def _execute_sweep(arrs, masks, sweep_cfg, mesh, fail_reasons,
         labelnames=("mode",))
 
     def host(out):
-        fail = (np.asarray(out.fail_counts) if fail_reasons
-                else np.zeros(out.fail_counts.shape, dtype=np.int32))
-        return (np.asarray(out.node), fail, np.asarray(out.state.headroom),
-                np.asarray(out.state.vg_used), np.asarray(out.gpu_pick),
-                np.asarray(out.vol_pick))
+        # lane count from the OUTPUT, not the closure's masks — the
+        # isolated fallback hosts single-lane outputs and must not
+        # allocate a full-batch-shaped zeros block per lane
+        fail = (np.asarray(out.fail_counts)[:, :n_pods] if fail_reasons
+                else np.zeros((out.node.shape[0], n_pods, sweep_cfg.n_ops),
+                              dtype=np.int32))
+        return (np.asarray(out.node)[:, :n_pods], fail,
+                np.asarray(out.state.headroom),
+                np.asarray(out.state.vg_used),
+                np.asarray(out.gpu_pick)[:, :n_pods],
+                np.asarray(out.vol_pick)[:, :n_pods])
+
+    carry_once = {"carry": carry}
+
+    def _batched():
+        # carry only on the first attempt (donated buffers are dead after
+        # it), and only as an explicit kwarg when present — the
+        # fault-injection tests monkeypatch batched_schedule with the
+        # carry-less signature
+        kw = {}
+        c = carry_once.pop("carry", None)
+        if c is not None:
+            kw["carry"] = c
+        return batched_schedule(arrs, jnp.asarray(masks), sweep_cfg,
+                                mesh=mesh, **kw)
 
     try:
         t0 = _time.perf_counter()
-        out = run_with_retries(
-            lambda: batched_schedule(arrs, jnp.asarray(masks), sweep_cfg,
-                                     mesh=mesh),
-            retries=retries, backoff_s=backoff_s)
+        out = run_with_retries(_batched, retries=retries, backoff_s=backoff_s)
         hosted = host(out)  # np.asarray blocks: the timing covers execution
         trial_seconds.labels(mode="batched").observe(_time.perf_counter() - t0)
         trials_total.labels(outcome="ok").inc(masks.shape[0])
-        return hosted + ({},)
+        return hosted + ({}, out.state if return_state else None)
     except Exception:
         if not isolate_trials:
             raise
 
-    s, n_pods = masks.shape[0], arrs.req.shape[0]
+    s = masks.shape[0]
     alloc = np.asarray(arrs.alloc)
     nodes = np.full((s, n_pods), -1, dtype=np.int32)
     fail = np.zeros((s, n_pods, sweep_cfg.n_ops), dtype=np.int32)
     headroom = np.broadcast_to(alloc, (s,) + alloc.shape).copy()
     vg_used = np.zeros((s,) + np.asarray(arrs.vg_cap).shape, dtype=np.float32)
-    gpu = np.zeros((s, n_pods, arrs.gpu_slot.shape[1]), dtype=np.int32)
-    vol = np.full((s, n_pods, arrs.wfc_ccid.shape[1]), -1, dtype=np.int32)
+    # pick widths mirror the engine's output contract: width 0 when the
+    # gate compiles the op out (so a width drift below is genuine, not
+    # the old always-mismatching gate-off case that silently kept zeros)
+    g_w = arrs.gpu_slot.shape[1] if sweep_cfg.enable_gpu else 0
+    v_w = arrs.wfc_ccid.shape[1] if sweep_cfg.enable_pv_match else 0
+    gpu = np.zeros((s, n_pods, g_w), dtype=np.int32)
+    vol = np.full((s, n_pods, v_w), -1, dtype=np.int32)
     trial_errors = {}
     for si in range(s):
         try:
@@ -325,17 +536,42 @@ def _execute_sweep(arrs, masks, sweep_cfg, mesh, fail_reasons,
             trials_total.labels(outcome="ok").inc()
             nodes[si], fail[si], headroom[si], vg_used[si] = (
                 nodes_i[0], fail_i[0], hr_i[0], vg_i[0])
+            # A width drift between the isolated lane's outputs and the
+            # batch layout means the pick columns cannot be trusted —
+            # surface the lane instead of silently reporting zero picks
+            # (the placements themselves are still the lane's own).
             if gpu_i[0].shape == gpu[si].shape:
                 gpu[si] = gpu_i[0]
+            else:
+                _log.warning(
+                    "sweep lane %d: isolated gpu_pick shape %s != batch "
+                    "shape %s; recording the lane as failed instead of "
+                    "dropping its GPU picks", si, gpu_i[0].shape, gpu[si].shape)
+                _record_lane_error(
+                    trial_errors, si,
+                    f"isolated gpu_pick shape {gpu_i[0].shape} != "
+                    f"batch shape {gpu[si].shape}")
             if vol_i[0].shape == vol[si].shape:
                 vol[si] = vol_i[0]
+            else:
+                _log.warning(
+                    "sweep lane %d: isolated vol_pick shape %s != batch "
+                    "shape %s; recording the lane as failed instead of "
+                    "dropping its volume picks", si, vol_i[0].shape,
+                    vol[si].shape)
+                _record_lane_error(
+                    trial_errors, si,
+                    f"isolated vol_pick shape {vol_i[0].shape} != "
+                    f"batch shape {vol[si].shape}")
         except Exception as e:  # noqa: BLE001 — isolate, record, continue
             trials_total.labels(outcome="failed").inc()
             trial_errors[si] = f"{type(e).__name__}: {e}"
     if len(trial_errors) == s:
         # every lane failed — this is a systemic failure (dead device,
         # engine bug), not a flaky trial; surface it instead of returning
-        # an all-failed plan with no diagnostics
+        # an all-failed plan with no diagnostics. (Keyed access would
+        # KeyError if lane numbering ever changed — take any error.)
         raise RuntimeError(
-            f"all {s} sweep trials failed; first: {trial_errors[0]}")
-    return nodes, fail, headroom, vg_used, gpu, vol, trial_errors
+            f"all {s} sweep trials failed; "
+            f"first: {next(iter(trial_errors.values()))}")
+    return nodes, fail, headroom, vg_used, gpu, vol, trial_errors, None

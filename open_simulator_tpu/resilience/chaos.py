@@ -186,11 +186,8 @@ def run_chaos(
         _with_nodes,
     )
     from open_simulator_tpu.encode.snapshot import encode_cluster
-    from open_simulator_tpu.engine.scheduler import (
-        device_arrays,
-        make_config,
-        schedule_pods,
-    )
+    from open_simulator_tpu.engine import exec_cache
+    from open_simulator_tpu.engine.scheduler import make_config, schedule_pods
     from open_simulator_tpu.k8s.loader import make_valid_node
 
     plan.validate()
@@ -210,15 +207,22 @@ def run_chaos(
     # rescued pod is actually rescheduled
     cfg = make_config(snapshot, **dict(config_overrides or {}))._replace(
         forced_prefix=0)
-    arrs = device_arrays(snapshot)
+    # bucketed padding: every event re-scan and the baseline share one
+    # compiled executable with the other entry points' bucket (the host
+    # fault bookkeeping below stays on the REAL axes; masks and forced
+    # columns are padded at the call sites)
+    arrs, _, n_pods_real = exec_cache.bucketed_device_arrays(snapshot.arrays)
+    n_nodes_pad = arrs.alloc.shape[0]
+    n_pods_pad = arrs.req.shape[0]
 
     node_names = list(snapshot.node_names)
     node_labels = [n.meta.labels for n in snapshot.nodes]
     alloc = np.asarray(snapshot.arrays.alloc)
     resources = list(snapshot.resources)
 
-    active = np.array(np.asarray(arrs.active), dtype=bool, copy=True)
-    forced = np.array(np.asarray(arrs.forced_node), dtype=np.int32, copy=True)
+    active = np.array(np.asarray(snapshot.arrays.active), dtype=bool, copy=True)
+    forced = np.array(np.asarray(snapshot.arrays.forced_node), dtype=np.int32,
+                      copy=True)
 
     from open_simulator_tpu.telemetry import counter
     from open_simulator_tpu.telemetry.spans import span
@@ -231,8 +235,10 @@ def run_chaos(
                             labelnames=("outcome",))
 
     with span("chaos.baseline"):
-        out0 = schedule_pods(arrs, jnp.asarray(active), cfg)
-        assign = np.asarray(out0.node)
+        out0 = schedule_pods(
+            arrs, jnp.asarray(exec_cache.pad_vector(active, n_nodes_pad, False)),
+            cfg)
+        assign = np.asarray(out0.node)[:n_pods_real]
     report = DisruptionReport(
         total_pods=snapshot.n_pods,
         baseline_unschedulable=int(np.sum(assign < 0)),
@@ -256,10 +262,15 @@ def run_chaos(
                           forced)
         evicted_idx = np.nonzero((assign >= 0) & failed_mask[np.maximum(assign, 0)])[0]
 
-        arrs_ev = dataclasses.replace(arrs, forced_node=jnp.asarray(forced))
+        arrs_ev = dataclasses.replace(
+            arrs, forced_node=jnp.asarray(
+                exec_cache.pad_vector(forced, n_pods_pad, -4)))
         with span("chaos.event", kind=ev.kind, target=ev.target):
-            out = schedule_pods(arrs_ev, jnp.asarray(active), cfg)
-            new_assign = np.asarray(out.node)
+            out = schedule_pods(
+                arrs_ev,
+                jnp.asarray(exec_cache.pad_vector(active, n_nodes_pad, False)),
+                cfg)
+            new_assign = np.asarray(out.node)[:n_pods_real]
 
         replaced = {
             snapshot.pods[i].key: node_names[int(new_assign[i])]

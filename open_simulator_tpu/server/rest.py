@@ -13,6 +13,12 @@ Endpoint parity with the reference (pkg/server/server.go:148-314):
                              ran (?pod=ns/name repeatable, ?top_k=N);
                              404 E_NO_SIMULATION before the first one
   POST /api/deploy-apps   -> simulate deploying new apps (+ optional new nodes)
+  POST /api/capacity      -> "how many nodes of this spec must I add?" —
+                             the capacity sweep as a service: monotone
+                             bisection by default (sweep_mode
+                             "exhaustive" opts out), reusing the AOT
+                             executable cache across requests in the
+                             same shape bucket
   POST /api/scale-apps    -> simulate re-scaling existing workloads (their
                              current pods are removed first — the re-rollout
                              semantics of removePodsOfApp, server.go:404-444)
@@ -80,6 +86,7 @@ access_log = logging.getLogger("simon-tpu.http")
 _KNOWN_PATHS = frozenset({
     "/healthz", "/test", "/metrics", "/debug/stats", "/debug/profile",
     "/api/explain", "/api/deploy-apps", "/api/scale-apps", "/api/chaos",
+    "/api/capacity",
 })
 
 
@@ -102,12 +109,17 @@ def _http_metrics():
 
 DEFAULT_EXPLAIN_TOPK = 3
 
+# /api/capacity guardrail: padded new-node slots a single request may ask
+# encode to materialize (the exhaustive mode also turns this into lanes)
+MAX_CAPACITY_NEW_NODES = 4096
+
 
 class SimulationServer:
     def __init__(self, cluster_config: str = "", kubeconfig: str = "",
                  max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
                  request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
-                 explain_topk: int = DEFAULT_EXPLAIN_TOPK):
+                 explain_topk: int = DEFAULT_EXPLAIN_TOPK,
+                 compile_cache_dir: str = ""):
         self.cluster_config = cluster_config
         # recorded API dump standing in for the reference's 10 live
         # informers (pkg/server/server.go:97-137; no cluster access here)
@@ -127,6 +139,14 @@ class SimulationServer:
         # endpoint decodes it without re-running anything
         self._last_result: Optional[SimulateResult] = None
         telemetry.install_runtime_gauges()
+        if compile_cache_dir:
+            # persistent XLA compilation cache: a restarted server skips
+            # cold compiles for every shape bucket it has served before
+            from open_simulator_tpu.engine.exec_cache import (
+                enable_persistent_cache,
+            )
+
+            enable_persistent_cache(compile_cache_dir)
 
     # ---- debug surface (the gin pprof analog, server.go:148-152) -------
 
@@ -205,6 +225,83 @@ class SimulationServer:
         explain endpoint has score breakdowns for the last result."""
         return simulate(cluster, apps,
                         config_overrides={"explain_topk": self.explain_topk})
+
+    def capacity(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Minimum new-node count for the requested apps (POST /api/capacity).
+
+        Body: {"cluster": {...}?, "apps": [{"name", "yaml"}, ...],
+               "new_node": {"spec_yaml": "<Node yaml>"},
+               "max_new_nodes": 64?, "sweep_mode": "bisect"|"exhaustive"?,
+               "thresholds": {"max_cpu_pct", "max_memory_pct", "max_vg_pct"}?}
+        """
+        from open_simulator_tpu.core import build_pod_sequence, with_volume_objects
+        from open_simulator_tpu.encode.snapshot import EncodeOptions, encode_cluster
+        from open_simulator_tpu.engine.scheduler import make_config
+        from open_simulator_tpu.parallel.sweep import (
+            SweepThresholds,
+            capacity_bisect,
+            capacity_sweep,
+        )
+
+        self._stats["requests"] += 1
+        cluster = self.base_cluster(body.get("cluster"))
+        cluster.nodes = [make_valid_node(n) for n in cluster.nodes]
+        apps = self._request_apps(body)
+        new_node = body.get("new_node") or {}
+        if not new_node.get("spec_yaml"):
+            raise SimulationError(
+                "capacity planning needs a new-node template",
+                code="E_BAD_REQUEST", ref="request", field="new_node",
+                hint='include {"new_node": {"spec_yaml": "<Node yaml>"}}')
+        template = make_valid_node(Node.from_dict(
+            yaml.safe_load(new_node["spec_yaml"])))
+        max_new = max(0, int(body.get("max_new_nodes", 64)))
+        if max_new > MAX_CAPACITY_NEW_NODES:
+            # encode materializes max_new padded node rows (and exhaustive
+            # mode max_new+1 lanes) — an unbounded request would wedge the
+            # single-flight worker; reject before any allocation
+            raise SimulationError(
+                f"max_new_nodes {max_new} exceeds the server cap "
+                f"{MAX_CAPACITY_NEW_NODES}",
+                code="E_BAD_REQUEST", ref="request", field="max_new_nodes",
+                hint="ask a smaller what-if, or run simon-tpu apply locally "
+                     "with --max-new-nodes")
+        mode = body.get("sweep_mode", "bisect")
+        if mode not in ("bisect", "exhaustive"):
+            raise SimulationError(
+                f"unknown sweep_mode {mode!r}",
+                code="E_BAD_REQUEST", ref="request", field="sweep_mode",
+                hint='use "bisect" (default) or "exhaustive"')
+        th = body.get("thresholds") or {}
+        thresholds = SweepThresholds(
+            max_cpu_pct=float(th.get("max_cpu_pct", 100.0)),
+            max_memory_pct=float(th.get("max_memory_pct", 100.0)),
+            max_vg_pct=float(th.get("max_vg_pct", 100.0)))
+
+        pods = build_pod_sequence(cluster, apps)
+        snapshot = encode_cluster(
+            cluster.nodes, pods,
+            with_volume_objects(
+                EncodeOptions(max_new_nodes=max_new, new_node_template=template),
+                cluster, apps))
+        cfg = make_config(snapshot)
+        if mode == "bisect":
+            plan = capacity_bisect(snapshot, cfg, max_new, thresholds)
+        else:
+            plan = capacity_sweep(snapshot, cfg, list(range(max_new + 1)),
+                                  thresholds)
+        self._stats["simulations"] += 1
+        return {
+            "best_count": plan.best_count,
+            "mode": mode,
+            "max_new_nodes": max_new,
+            "counts": list(plan.counts),
+            "all_scheduled": list(plan.all_scheduled),
+            "satisfied": list(plan.satisfied),
+            "cpu_occupancy_pct": [round(v, 2) for v in plan.cpu_occupancy_pct],
+            "mem_occupancy_pct": [round(v, 2) for v in plan.mem_occupancy_pct],
+            "trial_errors": {str(k): v for k, v in plan.trial_errors.items()},
+        }
 
     def chaos(self, body: Dict[str, Any]) -> Dict[str, Any]:
         """Fault-injection re-simulation (resilience/chaos.py)."""
@@ -461,6 +558,7 @@ def _make_handler(server: SimulationServer):
         def _do_post(self):
             routes = {"/api/deploy-apps": server.deploy_apps,
                       "/api/scale-apps": server.scale_apps,
+                      "/api/capacity": server.capacity,
                       "/api/chaos": server.chaos}
             handler_fn = routes.get(self.path)
             if handler_fn is None:
@@ -554,7 +652,8 @@ def serve(address: str = "127.0.0.1", port: int = 8899, cluster_config: str = ""
           kubeconfig: str = "",
           max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
           request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
-          explain_topk: int = DEFAULT_EXPLAIN_TOPK) -> int:
+          explain_topk: int = DEFAULT_EXPLAIN_TOPK,
+          compile_cache_dir: str = "") -> int:
     if kubeconfig:
         # validate up front so a real kubeconfig fails fast with the
         # record-a-dump recipe instead of 500s per request
@@ -564,7 +663,8 @@ def serve(address: str = "127.0.0.1", port: int = 8899, cluster_config: str = ""
     sim_server = SimulationServer(cluster_config=cluster_config, kubeconfig=kubeconfig,
                                   max_body_bytes=max_body_bytes,
                                   request_timeout_s=request_timeout_s,
-                                  explain_topk=explain_topk)
+                                  explain_topk=explain_topk,
+                                  compile_cache_dir=compile_cache_dir)
     httpd = ThreadingHTTPServer((address, port), _make_handler(sim_server))
     print(f"simon-tpu server listening on http://{address}:{port}")
     try:

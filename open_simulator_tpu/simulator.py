@@ -30,7 +30,8 @@ from open_simulator_tpu.core import (
     _resolve_priorities,
 )
 from open_simulator_tpu.encode.snapshot import EncodeOptions, encode_cluster
-from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
+from open_simulator_tpu.engine import exec_cache
+from open_simulator_tpu.engine.scheduler import make_config, schedule_pods
 from open_simulator_tpu.k8s.loader import ClusterResources, make_valid_node
 from open_simulator_tpu.k8s.objects import LABEL_APP_NAME, Pod
 from open_simulator_tpu.models.expand import expand_app_resources, expand_cluster_pods
@@ -113,8 +114,13 @@ class Simulator:
         with span("encode"):
             snapshot = encode_cluster(self.cluster.nodes, self._pods, opts)
         cfg = make_config(snapshot, **self._overrides)
+        exec_cache.enable_persistent_cache(cfg.compile_cache_dir)
         with span("transfer"):
-            arrs = device_arrays(snapshot)
+            # bucketed padding: each schedule_app() grows the pod sequence
+            # by a few rows, which used to recompile the whole scan; inside
+            # one bucket every incremental re-run reuses the executable
+            arrs, _, n_pods = exec_cache.bucketed_device_arrays(snapshot.arrays)
+        active_np = np.asarray(snapshot.arrays.active)
         preempted_by = None
         with telemetry.schedule_phase(schedule_pods):
             if self.preemption:
@@ -125,11 +131,17 @@ class Simulator:
                 ]
 
                 def schedule_fn(disabled, nominated):
-                    return schedule_pods(arrs, arrs.active, cfg, disabled=disabled,
-                                         nominated=nominated)
+                    return exec_cache.unpad_output(
+                        schedule_pods(
+                            arrs, arrs.active, cfg,
+                            disabled=exec_cache.pad_vector(
+                                disabled, arrs.req.shape[0], False),
+                            nominated=exec_cache.pad_vector(
+                                nominated, arrs.req.shape[0], -1)),
+                        n_pods)
 
                 out, pre = run_with_preemption(
-                    snapshot, np.asarray(arrs.active), schedule_fn, pdbs,
+                    snapshot, active_np, schedule_fn, pdbs,
                     init_disabled=self._pre_disabled,
                     init_nominated=np.where(
                         self._pre_assign >= 0, self._pre_assign, -1
@@ -140,14 +152,15 @@ class Simulator:
                 self._pre_disabled = np.asarray(pre.disabled)
                 self._pre_assign = np.asarray(out.node).astype(np.int32)
             else:
-                out = schedule_pods(arrs, arrs.active, cfg)
+                out = exec_cache.unpad_output(
+                    schedule_pods(arrs, arrs.active, cfg), n_pods)
             node_assign = np.asarray(out.node)  # blocks on device completion
         with span("decode"):
             result = decode_result(
                 snapshot,
                 node_assign,
                 np.asarray(out.fail_counts),
-                np.asarray(arrs.active),
+                active_np,
                 gpu_pick=np.asarray(out.gpu_pick) if cfg.enable_gpu else None,
                 preempted_by=preempted_by,
                 vol_pick=np.asarray(out.vol_pick) if cfg.enable_pv_match else None,

@@ -15,6 +15,11 @@ Two surfaces:
   to stamp the synthetic "compile" span under "schedule" in the Chrome
   trace. Returns None when the attribute moved — callers degrade to
   recording nothing rather than guessing.
+
+The `simon_compile_cache_total{fn, event}` family is shared with the AOT
+executable cache (engine/exec_cache.py), which records under
+`fn="batched_schedule"` and adds the `eviction` event to the hit/miss
+vocabulary — one series tells the whole compilation-amortization story.
 """
 
 from __future__ import annotations

@@ -1,0 +1,57 @@
+"""GL4 fixture (clean): the SAFE pattern for host-side executable-cache
+bookkeeping next to jit scope (companion to gl4_telemetry_ok.py).
+
+The exec-cache layer (engine/exec_cache.py) keeps an LRU of AOT-compiled
+executables. All of its bookkeeping — dict lookups, LRU reordering,
+hit/miss counting, compile timing — is HOST control flow on HOST values
+(string/shape keys, Python ints), never on traced arrays: the key is
+derived from static `.shape`/`.dtype` metadata BEFORE the jit boundary,
+the `if key in cache` branch runs outside any trace, and the traced body
+stays pure jnp. This file must produce ZERO findings; the negative
+example (branching on a traced value / .item() inside jit) lives in
+gl4_trace.py.
+"""
+
+import time
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from open_simulator_tpu.telemetry import counter
+
+_CACHE = OrderedDict()
+_CAPACITY = 2
+
+
+def _traced_sum(xs, scale):
+    # traced scope: pure jnp math — no cache reads, no metrics, no host
+    # branches on traced values
+    return jnp.sum(xs) * scale
+
+
+def run_cached(values, scale=2.0):
+    xs = jnp.asarray(values)
+    # cache key from STATIC metadata (shape/dtype are host values even on
+    # a traced array; reading them is not a device sync)
+    key = (tuple(xs.shape), str(xs.dtype), float(scale))
+    compiled = _CACHE.get(key)
+    if compiled is None:  # host branch on a host value: safe
+        counter("fixture_exec_cache_total",
+                labelnames=("event",)).labels(event="miss").inc()
+        t0 = time.perf_counter()
+        compiled = jax.jit(_traced_sum).lower(xs, scale).compile()
+        counter("fixture_exec_compiles_total").inc()
+        _ = time.perf_counter() - t0  # host timing of the compile, host-side
+        _CACHE[key] = compiled
+        while len(_CACHE) > _CAPACITY:
+            _CACHE.popitem(last=False)
+            counter("fixture_exec_cache_total",
+                    labelnames=("event",)).labels(event="eviction").inc()
+    else:
+        counter("fixture_exec_cache_total",
+                labelnames=("event",)).labels(event="hit").inc()
+        _CACHE.move_to_end(key)
+    out = compiled(xs, scale)
+    return float(np.asarray(out))  # device -> host OUTSIDE the jit

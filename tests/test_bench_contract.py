@@ -32,6 +32,29 @@ def test_run_batched_tiny():
     assert dt > 0
 
 
+def test_bench_demo_emits_valid_json_line(monkeypatch, capsys):
+    """Rounds 1-5 of the judged series silently recorded a TypeError
+    because bench.py only ever ran under the driver: a broken bench must
+    fail CI, not a judging round. Run the demo preset in-process exactly
+    as the driver would (`bench.py --preset demo --skip-baseline`) and
+    require one parseable JSON line with a positive value."""
+    import json
+
+    monkeypatch.setattr(
+        sys, "argv", ["bench.py", "--preset", "demo", "--skip-baseline"])
+    bench.main()
+    out = capsys.readouterr().out
+    json_lines = [l for l in out.strip().splitlines() if l.startswith("{")]
+    assert len(json_lines) == 1, f"expected one JSON line, got: {out!r}"
+    d = json.loads(json_lines[0])
+    assert d["value"] > 0, d
+    assert d["unit"] == "pods/s"
+    assert d["preset"] == "demo"
+    assert d["scenarios_per_sec"] > 0
+    # --skip-baseline: the tracking ratio is explicitly absent (0), not junk
+    assert d["vs_baseline"] == 0.0
+
+
 def test_all_gates_on_for_rich_build():
     """The honesty premise: the rich bench workload keeps every
     make_config feature gate ON (VERDICT r3 #2)."""

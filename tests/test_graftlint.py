@@ -131,6 +131,14 @@ def test_gl4_telemetry_safe_pattern_is_clean():
     assert lint_fixture("gl4_telemetry_ok.py") == []
 
 
+def test_gl4_execcache_safe_pattern_is_clean():
+    """Host-side executable-cache bookkeeping — LRU dict ops, hit/miss
+    counters, compile timing around jit(...).lower(...).compile() — on
+    HOST keys derived from static shape/dtype metadata, the pattern
+    engine/exec_cache.py follows, must not trip GL4 (or any rule)."""
+    assert lint_fixture("gl4_execcache_ok.py") == []
+
+
 def test_suppression_swallows_finding_and_gl0_flags_naked_directive():
     fs = lint_fixture("suppressed.py")
     assert [f.code for f in fs] == ["GL0"]

@@ -105,6 +105,60 @@ def test_deploy_apps_with_new_nodes(server_url):
     assert not out2["unscheduled_pods"]
 
 
+NODE_SPEC_YAML = textwrap.dedent("""
+    apiVersion: v1
+    kind: Node
+    metadata: {name: template}
+    status:
+      allocatable: {cpu: "8", memory: 16Gi, pods: "110"}
+""")
+
+
+def test_capacity_endpoint_bisect_matches_exhaustive(server_url):
+    """POST /api/capacity: the sweep as a service — bisect (default) and
+    exhaustive must agree on best_count, bisect probing fewer lanes."""
+    body = {
+        "cluster": {"yaml": CLUSTER_YAML},
+        "apps": [{"name": "newapp", "yaml": APP_YAML.replace(
+            "replicas: 3", "replicas: 40")}],
+        "new_node": {"spec_yaml": NODE_SPEC_YAML},
+        "max_new_nodes": 16,
+    }
+    out = _post(server_url + "/api/capacity", body)
+    assert out["mode"] == "bisect"
+    assert out["best_count"] is not None and out["best_count"] > 0
+    assert len(out["counts"]) < 17  # probed a bracket, not every count
+    out_ex = _post(server_url + "/api/capacity",
+                   {**body, "sweep_mode": "exhaustive"})
+    assert out_ex["mode"] == "exhaustive"
+    assert out_ex["counts"] == list(range(17))
+    assert out_ex["best_count"] == out["best_count"]
+
+
+def test_capacity_endpoint_caps_max_new_nodes(server_url):
+    """An unbounded what-if must be rejected before encode materializes
+    millions of padded node rows on the single-flight worker."""
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server_url + "/api/capacity", {
+            "cluster": {"yaml": CLUSTER_YAML}, "apps": [],
+            "new_node": {"spec_yaml": NODE_SPEC_YAML},
+            "max_new_nodes": 100_000_000,
+        })
+    assert ei.value.code == 400
+    body = json.loads(ei.value.read())
+    assert body["field"] == "max_new_nodes"
+
+
+def test_capacity_endpoint_requires_new_node(server_url):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server_url + "/api/capacity",
+              {"cluster": {"yaml": CLUSTER_YAML}, "apps": []})
+    assert ei.value.code == 400
+    body = json.loads(ei.value.read())
+    assert body["code"] == "E_BAD_REQUEST"
+    assert "new_node" in body["ref"] + body.get("field", "")
+
+
 def test_scale_apps(server_url):
     out = _post(server_url + "/api/scale-apps", {
         "cluster": {"yaml": CLUSTER_YAML},

@@ -144,6 +144,106 @@ def test_node_axis_sharding_with_spread_constraints():
     np.testing.assert_array_equal(results[2], results[0])
 
 
+def test_make_mesh_require_all_rejects_partial_use():
+    """require_all: multi-host callers must not silently drop a host's
+    devices (a host with no addressable shard hangs instead of erroring)."""
+    import pytest
+
+    n = len(jax.devices())
+    assert n == 8
+    # 3x2 = 6 of 8 devices: fine by default, rejected with require_all
+    mesh = make_mesh(n_scenario=3, n_node=2)
+    assert mesh.devices.size == 6
+    with pytest.raises(ValueError, match="uses 6 of 8 devices"):
+        make_mesh(n_scenario=3, n_node=2, require_all=True)
+    # an oversubscribed mesh always errors
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        make_mesh(n_scenario=8, n_node=2)
+
+
+def test_shard_arrays_axis_placement_when_n_nodes_equals_n_pods():
+    """The docstring's warning case: with n_nodes == n_pods a shape
+    heuristic could shard the pod axis by accident. The declared sets
+    must put node-first arrays on axis 0 and node-second on axis 1, and
+    leave pod-axis arrays replicated."""
+    from open_simulator_tpu.engine.scheduler import device_arrays
+    from open_simulator_tpu.parallel.sweep import shard_arrays
+
+    cluster = ClusterResources()
+    cluster.nodes = [make_node(f"n{i}", cpu_m=4000, mem_mib=8192)
+                     for i in range(8)]
+    app = ClusterResources()
+    app.pods = [make_pod(f"p{i}", cpu="100m", mem="64Mi") for i in range(8)]
+    pods = build_pod_sequence(cluster, [AppResource(name="a", resources=app)])
+    snap = encode_cluster([make_valid_node(n) for n in cluster.nodes], pods)
+    assert snap.n_nodes == snap.n_pods == 8  # the ambiguous shape
+
+    mesh = make_mesh(n_scenario=4, n_node=2)
+    placed = shard_arrays(device_arrays(snap), mesh)
+
+    def axes(x):
+        return getattr(x.sharding, "spec", None)
+
+    assert tuple(axes(placed.alloc)) == ("node", None)        # node-first
+    assert tuple(axes(placed.active)) == ("node",)
+    assert tuple(axes(placed.topo_onehot)) == (None, "node", None)  # node-second
+    assert tuple(axes(placed.class_affinity)) == (None, "node")
+    # pod-axis arrays replicated — every entry None
+    assert all(s is None for s in tuple(axes(placed.req)))
+    assert all(s is None for s in tuple(axes(placed.forced_node)))
+
+
+def test_isolated_lane_pick_shape_mismatch_is_recorded(monkeypatch):
+    """Satellite: the isolated-lane fallback used to silently keep zero
+    gpu/vol picks when the lane's output width drifted from the batch
+    layout; it must now record the lane in trial_errors."""
+    from open_simulator_tpu.engine.scheduler import make_config
+    from open_simulator_tpu.parallel import sweep as sweep_mod
+
+    snap = _snapshot(n_pods=4, pod_cpu="500m", max_new=1)
+    cfg = make_config(snap)._replace(enable_gpu=True)
+    n_real = snap.n_real_nodes
+    real_batched = sweep_mod.batched_schedule
+
+    def drifted(arrs, masks, cfg_, mesh=None, **kw):
+        if masks.shape[0] > 1:
+            raise RuntimeError("injected: force the isolated fallback")
+        out = real_batched(arrs, masks, cfg_, mesh=mesh, **kw)
+        if int(np.asarray(masks[0]).sum()) - n_real == 0:
+            # lane for count=0: gpu_pick width drifted from the batch
+            return out._replace(
+                gpu_pick=np.zeros((1, np.asarray(out.node).shape[1], 99),
+                                  dtype=np.int32))
+        return out
+
+    monkeypatch.setattr(sweep_mod, "batched_schedule", drifted)
+    plan = sweep_mod.capacity_sweep(snap, cfg, [0, 1], backoff_s=0.0)
+    assert list(plan.trial_errors) == [0]
+    assert "gpu_pick shape" in plan.trial_errors[0]
+    assert not plan.satisfied[0]
+    assert plan.all_scheduled[1]
+
+
+def test_all_lanes_failed_message_survives_any_lane_numbering(monkeypatch):
+    """Satellite: the all-lanes-failed diagnostic reads SOME recorded
+    error (next(iter(...))) instead of hard-indexing trial_errors[0]."""
+    import pytest
+
+    from open_simulator_tpu.engine.scheduler import make_config
+    from open_simulator_tpu.parallel import sweep as sweep_mod
+
+    snap = _snapshot(n_pods=4, pod_cpu="500m", max_new=1)
+    cfg = make_config(snap)
+
+    def dead(*a, **kw):
+        raise RuntimeError("device gone")
+
+    monkeypatch.setattr(sweep_mod, "batched_schedule", dead)
+    with pytest.raises(RuntimeError,
+                       match="all 2 sweep trials failed; first: .*device gone"):
+        sweep_mod.capacity_sweep(snap, cfg, [0, 1], backoff_s=0.0)
+
+
 def test_node_axis_sharding_bit_equal_all_ops():
     """Same mesh-shape equality as above, but on the all-ops workload —
     the sparse-slot column updates (dynamic-update-slice on the sharded

@@ -48,6 +48,15 @@ if [ "$rc" -ne 0 ]; then
 fi
 
 echo
+echo "== bench contract (demo preset emits a valid JSON line) =="
+make bench-smoke
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: bench-smoke exited $rc" >&2
+  exit "$rc"
+fi
+
+echo
 echo "== simon-tpu explain on the example cluster =="
 env JAX_PLATFORMS=cpu python -m open_simulator_tpu.cli explain \
   -f examples/config.yaml --top-k 2
