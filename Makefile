@@ -2,7 +2,7 @@
 # graftlint + the tier-1 verify command from ROADMAP.md plus one chaos
 # scenario end to end (tools/smoke.sh).
 
-.PHONY: test lint smoke bench bench-smoke
+.PHONY: test lint smoke bench bench-smoke bench-regress
 
 test:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -28,3 +28,10 @@ lines=[l for l in sys.stdin if l.strip().startswith('{')]; \
 d=json.loads(lines[-1]); \
 assert d['value'] > 0, d; \
 print('bench-smoke OK:', d['metric'], d['value'], d['unit'])"
+
+# regression gate over the run ledger (SIMON_LEDGER_DIR or
+# BENCH_LEDGER_DIR=... make bench-regress): the newest bench record per
+# shape must stay within the threshold of the trailing median of its
+# priors; exits 0 with a notice when the ledger holds < 2 bench records
+bench-regress:
+	python tools/bench_regress.py --ledger-dir "$${BENCH_LEDGER_DIR:-$${SIMON_LEDGER_DIR:-}}"

@@ -49,44 +49,67 @@ def shape_label(nodes: int, pods: int, scenarios: int, rich: bool = False) -> st
 
 
 def run_batched(snapshot, n_scenarios: int, fail_reasons: bool = False,
-                shape: str = "") -> float:
+                shape: str = "", preset: str = "") -> float:
     """Time the capacity-sweep product path: what-if lanes run with
     fail_reasons off (the applier re-runs only the decoded lane with
     reasons on — not part of the per-lane sweep cost; parallel/sweep.py).
 
     The measured best lands in the simon_bench_seconds{shape} gauge and
     is read BACK from the registry by main() — the BENCH json line and a
-    /metrics scrape of this process report one source of truth."""
+    /metrics scrape of this process report one source of truth. With a
+    ledger configured (--ledger-dir / SIMON_LEDGER_DIR), each timed shape
+    also appends one "bench" RunRecord tagged with its preset/shape/value
+    — the series tools/bench_regress.py gates on."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
     from open_simulator_tpu.parallel.sweep import active_masks_for_counts
+    from open_simulator_tpu.telemetry import ledger
 
-    cfg = make_config(snapshot)._replace(fail_reasons=fail_reasons)
-    arrs = device_arrays(snapshot)
-    max_new = snapshot.n_nodes - snapshot.n_real_nodes
-    counts = [min(i % (max_new + 1), max_new) for i in range(n_scenarios)]
-    masks = jnp.asarray(active_masks_for_counts(snapshot, counts))
+    with ledger.run_capture("bench") as lcap:
+        cfg = make_config(snapshot)._replace(fail_reasons=fail_reasons)
+        arrs = device_arrays(snapshot)
+        max_new = snapshot.n_nodes - snapshot.n_real_nodes
+        counts = [min(i % (max_new + 1), max_new) for i in range(n_scenarios)]
+        masks = jnp.asarray(active_masks_for_counts(snapshot, counts))
 
-    fn = jax.jit(jax.vmap(lambda a: schedule_pods(arrs, a, cfg)))
-    out = fn(masks)  # compile + warm
-    jax.block_until_ready(out.node)
-
-    best = float("inf")
-    for _ in range(5):  # the axon tunnel adds run-to-run noise; keep the best
-        t0 = time.perf_counter()
-        out = fn(masks)
+        fn = jax.jit(jax.vmap(lambda a: schedule_pods(arrs, a, cfg)))
+        out = fn(masks)  # compile + warm
         jax.block_until_ready(out.node)
-        best = min(best, time.perf_counter() - t0)
-    label = shape or shape_label(snapshot.n_real_nodes, snapshot.n_pods,
-                                 n_scenarios)
-    _bench_gauge().labels(shape=label).set(best)
+
+        best = float("inf")
+        for _ in range(5):  # the axon tunnel adds run-to-run noise; keep the best
+            t0 = time.perf_counter()
+            out = fn(masks)
+            jax.block_until_ready(out.node)
+            best = min(best, time.perf_counter() - t0)
+        label = shape or shape_label(snapshot.n_real_nodes, snapshot.n_pods,
+                                     n_scenarios)
+        _bench_gauge().labels(shape=label).set(best)
+        # arrs carries the shapes this run actually compiled at (bench uses
+        # the raw unbucketed arrays), so the fingerprint's bucket is honest
+        lcap.set_config(cfg, snapshot=snapshot, arrs=arrs)
+        lcap.set_result_info(**ledger.array_result_digest(np.asarray(out.node)))
+        lcap.tag("preset", preset)
+        lcap.tag("shape", label)
+        lcap.tag("lanes", n_scenarios)
+        lcap.tag("seconds", round(best, 6))
+        # higher-is-better throughput: the number bench_regress.py compares
+        # against the trailing median of this shape's prior records
+        lcap.tag("value", round(snapshot.n_pods * n_scenarios / best, 3))
     return best
 
 
-def cpu_baseline_rate(n_nodes: int, rich: bool = False) -> float:
-    """Single-scenario pods/sec on XLA:CPU (subprocess; own jax init)."""
+def cpu_baseline_rate(n_nodes: int, rich: bool = False):
+    """Single-scenario pods/sec on XLA:CPU (subprocess; own jax init).
+
+    Returns (rate, error): rate 0.0 with a non-None error when the
+    subprocess failed — a crashed baseline must NOT masquerade as a
+    skipped one (vs_baseline 0.0 read as "skipped" for five rounds while
+    the subprocess was actually dying; the error string lands in the
+    JSON line as "baseline_error" and its stderr tail on our stderr)."""
     code = f"""
 import json, time, os, sys
 sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})
@@ -107,14 +130,22 @@ print(json.dumps({{"rate": 512 / dt}}))
         res = subprocess.run(
             [sys.executable, "-c", code], capture_output=True, text=True, timeout=900
         )
-        for line in res.stdout.strip().splitlines():
-            try:
-                return float(json.loads(line)["rate"])
-            except (json.JSONDecodeError, KeyError):
-                continue
     except subprocess.TimeoutExpired:
-        pass
-    return 0.0
+        return 0.0, "baseline subprocess timed out after 900s"
+    if res.returncode != 0:
+        tail = "\n".join(res.stderr.strip().splitlines()[-5:])
+        print(f"bench: baseline subprocess exited {res.returncode}; "
+              f"stderr tail:\n{tail}", file=sys.stderr)
+        return 0.0, f"baseline subprocess exited {res.returncode}: " \
+                    f"{tail.splitlines()[-1] if tail else 'no stderr'}"
+    for line in res.stdout.strip().splitlines():
+        try:
+            return float(json.loads(line)["rate"]), None
+        except (json.JSONDecodeError, KeyError):
+            continue
+    print("bench: baseline subprocess exited 0 but printed no rate line",
+          file=sys.stderr)
+    return 0.0, "baseline printed no parseable rate line"
 
 
 # BASELINE.md config presets (the reference publishes no numbers; these are
@@ -158,6 +189,11 @@ def main():
         help="persistent XLA compile cache: repeat bench runs skip the "
              "cold compile (engine/exec_cache.py)")
     ap.add_argument(
+        "--ledger-dir", default="",
+        help="run-ledger directory: each timed shape appends one bench "
+             "RunRecord (also honors SIMON_LEDGER_DIR); gate the series "
+             "with tools/bench_regress.py")
+    ap.add_argument(
         "--fail-reasons", action="store_true",
         help="time the simulate() path (per-op failure accounting in every "
              "lane) instead of the default sweep path",
@@ -167,6 +203,10 @@ def main():
         from open_simulator_tpu.engine.exec_cache import enable_persistent_cache
 
         enable_persistent_cache(args.compile_cache_dir)
+    if args.ledger_dir:
+        from open_simulator_tpu.telemetry import ledger
+
+        ledger.configure(args.ledger_dir)
     preset = PRESETS[args.preset]
     for k in ("nodes", "pods", "scenarios", "max_new"):
         if getattr(args, k) is None:
@@ -179,11 +219,15 @@ def main():
     # it returns, so the JSON below and a /metrics scrape of this process
     # report one source of truth
     dt = run_batched(snapshot, args.scenarios, fail_reasons=args.fail_reasons,
-                     shape=label)
+                     shape=label, preset=args.preset)
     pods_per_sec = args.pods * args.scenarios / dt
     scenarios_per_sec = args.scenarios / dt
 
-    base_rate = 0.0 if args.skip_baseline else cpu_baseline_rate(args.nodes, rich=rich)
+    baseline_error = None
+    if args.skip_baseline:
+        base_rate = 0.0
+    else:
+        base_rate, baseline_error = cpu_baseline_rate(args.nodes, rich=rich)
     vs = pods_per_sec / base_rate if base_rate > 0 else 0.0
 
     out = {
@@ -199,6 +243,10 @@ def main():
         "scenarios_per_sec": round(scenarios_per_sec, 2),
         "preset": args.preset,
     }
+    if baseline_error:
+        # vs_baseline 0.0 with this key present means the baseline CRASHED
+        # (stderr tail above), not that it was skipped
+        out["baseline_error"] = baseline_error
     if args.preset == "default":
         # the driver runs bench.py bare: record the BASELINE.md north-star
         # numbers (scenarios/s/chip at 5120n x 51200p, rounds-1..3-comparable
@@ -210,7 +258,8 @@ def main():
         ns_snap = build(ns["nodes"], ns["pods"], ns["max_new"])
         ns_label = shape_label(ns["nodes"], ns["pods"], ns["scenarios"])
         ns_dt = run_batched(ns_snap, ns["scenarios"],
-                            fail_reasons=args.fail_reasons, shape=ns_label)
+                            fail_reasons=args.fail_reasons, shape=ns_label,
+                            preset="northstar")
         out["northstar_scenarios_per_sec_per_chip"] = round(ns["scenarios"] / ns_dt, 1)
         out["northstar_shape"] = f"{ns['nodes']}n_x{ns['pods']}p_x{ns['scenarios']}s"
         # wide = the SAME snapshot at more lanes (assert the preset table
@@ -220,7 +269,8 @@ def main():
             "northstar-wide must differ from northstar only in lane count")
         wide_label = shape_label(wide["nodes"], wide["pods"], wide["scenarios"])
         wide_dt = run_batched(ns_snap, wide["scenarios"],
-                              fail_reasons=args.fail_reasons, shape=wide_label)
+                              fail_reasons=args.fail_reasons, shape=wide_label,
+                              preset="northstar-wide")
         out["northstar_wide_scenarios_per_sec_per_chip"] = round(
             wide["scenarios"] / wide_dt, 1)
         out["northstar_wide_lanes"] = wide["scenarios"]
@@ -232,7 +282,8 @@ def main():
         nr_snap = build(nr["nodes"], nr["pods"], nr["max_new"], rich=True)
         nr_label = shape_label(nr["nodes"], nr["pods"], nr["scenarios"], rich=True)
         nr_dt = run_batched(nr_snap, nr["scenarios"],
-                            fail_reasons=args.fail_reasons, shape=nr_label)
+                            fail_reasons=args.fail_reasons, shape=nr_label,
+                            preset="northstar-rich")
         out["northstar_rich_scenarios_per_sec_per_chip"] = round(
             nr["scenarios"] / nr_dt, 2)
     print(json.dumps(out))

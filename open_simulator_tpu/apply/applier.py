@@ -183,12 +183,19 @@ class Applier:
     # ---- run -----------------------------------------------------------
 
     def run(self) -> int:
+        from open_simulator_tpu.telemetry import ledger
+
         out_f = None
         if self.opts.output_file:
             out_f = open(self.opts.output_file, "w", encoding="utf-8")
             self._out = out_f
         try:
-            return self._run_inner()
+            # flight recorder: the whole apply run is ONE RunRecord
+            # (surface "apply"); the sweep underneath is a nested capture
+            # and therefore silent
+            with ledger.run_capture("apply") as lcap:
+                self._ledger_capture = lcap
+                return self._run_inner()
         finally:
             if out_f:
                 out_f.close()
@@ -251,6 +258,12 @@ class Applier:
         if self.opts.compile_cache_dir:
             overrides.setdefault("compile_cache_dir", self.opts.compile_cache_dir)
         cfg = make_config(snapshot, **overrides)
+        lcap = getattr(self, "_ledger_capture", None)
+        if lcap is not None:
+            lcap.set_config(cfg, snapshot=snapshot)
+            lcap.tag("sweep_mode",
+                     "exhaustive" if self.opts.interactive
+                     else self.opts.sweep_mode)
         thresholds = self._thresholds()
 
         if self.opts.interactive:
@@ -275,11 +288,19 @@ class Applier:
             # both modes probe max_new, so the last (largest) lane is the
             # most-capacity view worth reporting
             worst = self._result_for(snapshot, plan, len(plan.counts) - 1, cfg)
+            if lcap is not None:
+                lcap.set_result(worst)
+                lcap.tag("best_count", None)
             self._say(full_report(worst, self.opts.extended_resources))
             return 1
 
         best_idx = plan.counts.index(plan.best_count)
         result = self._result_for(snapshot, plan, best_idx, cfg)
+        if lcap is not None:
+            # the decoded best-lane result is the run's answer: its digest
+            # is what two identical apply runs must reproduce bit-for-bit
+            lcap.set_result(result)
+            lcap.tag("best_count", plan.best_count)
         # the reasons/preemption re-run can tie-break differently from the
         # sweep lane (vmap vs single-lane reduction order); keep the summary
         # consistent with the per-pod report below by quoting the decoded

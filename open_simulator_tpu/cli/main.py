@@ -64,6 +64,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--trace-out", default="",
                     help="write a Chrome-trace JSON timeline of this run's "
                          "phases (open in chrome://tracing or Perfetto)")
+    ap.add_argument("--ledger-dir", default="",
+                    help="run-ledger directory: append one RunRecord for "
+                         "this run (also honors SIMON_LEDGER_DIR); inspect "
+                         "with `simon-tpu runs`")
 
     ex = sub.add_parser(
         "explain",
@@ -117,6 +121,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="opt-in jax persistent compilation cache directory: a "
              "restarted server skips cold XLA compiles for shapes it has "
              "served before")
+    sp.add_argument(
+        "--ledger-dir", default="",
+        help="run-ledger directory: every simulation this server runs "
+             "appends one RunRecord, served back on GET /api/runs (also "
+             "honors SIMON_LEDGER_DIR)")
 
     ch = sub.add_parser(
         "chaos",
@@ -141,6 +150,44 @@ def build_parser() -> argparse.ArgumentParser:
     ch.add_argument("--trace-out", default="",
                     help="write a Chrome-trace JSON timeline of this run's "
                          "phases (open in chrome://tracing or Perfetto)")
+    ch.add_argument("--ledger-dir", default="",
+                    help="run-ledger directory: append one RunRecord for "
+                         "this chaos run (also honors SIMON_LEDGER_DIR)")
+
+    rn = sub.add_parser(
+        "runs",
+        help="inspect the persistent run ledger: list, show, diff",
+        description="Flight-recorder surface over the run ledger "
+                    "(--ledger-dir / SIMON_LEDGER_DIR): every simulation "
+                    "appends one RunRecord (config fingerprint, per-phase "
+                    "wall times, metric deltas, result digest). `list` "
+                    "summarizes, `show` dumps one record, `diff` compares "
+                    "two — phase-timing deltas with % change, result-"
+                    "digest equality (nondeterminism flag), and config-"
+                    "fingerprint drift explanation. Run ids resolve by "
+                    "unique prefix, or use `last` / `prev`.")
+    rn.add_argument("--ledger-dir", default="",
+                    help="ledger directory (default: SIMON_LEDGER_DIR)")
+    rn_sub = rn.add_subparsers(dest="runs_command")
+    rn_ls = rn_sub.add_parser("list", help="summarize recorded runs")
+    rn_ls.add_argument("--surface", default="",
+                       help="only this surface (apply/chaos/bench/sweep/"
+                            "simulate/server:<route>)")
+    rn_ls.add_argument("-n", "--limit", type=int, default=0,
+                       help="newest N records only")
+    rn_ls.add_argument("--json", action="store_true",
+                       help="emit summaries as JSON")
+    rn_sh = rn_sub.add_parser("show", help="dump one full RunRecord")
+    rn_sh.add_argument("run", metavar="RUN",
+                       help="run id prefix, or last / prev")
+    rn_df = rn_sub.add_parser(
+        "diff", help="compare two runs: phases, digests, config drift")
+    rn_df.add_argument("run_a", metavar="A",
+                       help="run id prefix, or last / prev")
+    rn_df.add_argument("run_b", metavar="B",
+                       help="run id prefix, or last / prev")
+    rn_df.add_argument("--json", action="store_true",
+                       help="emit the structured diff as JSON")
 
     mg = sub.add_parser("migrate", help="plan a defragmentation migration of placed pods")
     mg.add_argument("--cluster-config", required=True, help="cluster YAML dir (with placed pods)")
@@ -204,14 +251,60 @@ def _init_logging() -> None:
     )
 
 
+def _runs_main(args) -> int:
+    """simon-tpu runs {list, show, diff}: the flight-recorder CLI."""
+    import json as _json
+
+    from open_simulator_tpu.telemetry import ledger
+
+    led = ledger.default_ledger()
+    if led is None:
+        print("error: no run ledger configured (pass --ledger-dir or set "
+              "SIMON_LEDGER_DIR)", file=sys.stderr)
+        return 1
+    if not args.runs_command:
+        print("error: pick a subcommand: runs {list, show, diff}",
+              file=sys.stderr)
+        return 2
+    try:
+        if args.runs_command == "list":
+            recs = led.records(surface=args.surface or None,
+                               limit=args.limit or None)
+            if args.json:
+                print(_json.dumps([ledger.run_summary(r) for r in recs],
+                                  indent=2))
+            else:
+                print(ledger.format_run_list(recs))
+            return 0
+        if args.runs_command == "show":
+            print(_json.dumps(led.find(args.run), indent=2, sort_keys=True))
+            return 0
+        # diff
+        d = ledger.diff_records(led.find(args.run_a), led.find(args.run_b))
+        print(_json.dumps(d, indent=2) if args.json else ledger.format_diff(d))
+        return 0
+    except ledger.LedgerError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
 def main(argv=None) -> int:
     _init_logging()
     parser = build_parser()
     args = parser.parse_args(argv)
 
+    if getattr(args, "ledger_dir", ""):
+        # flight recorder: stdlib-only configuration, safe before jax loads
+        from open_simulator_tpu.telemetry import ledger
+
+        ledger.configure(args.ledger_dir)
+
     if args.command == "version":
         print(f"simon-tpu version {__version__}")
         return 0
+
+    if args.command == "runs":
+        return _runs_main(args)
 
     if args.command == "lint":
         # analysis/ is pure-AST stdlib: linting never imports jax or the
@@ -357,6 +450,7 @@ def main(argv=None) -> int:
             request_timeout_s=args.request_timeout,
             explain_topk=args.explain_topk,
             compile_cache_dir=args.compile_cache_dir,
+            ledger_dir=args.ledger_dir,
         )
 
     if args.command == "gen-doc":

@@ -290,12 +290,16 @@ def simulate(
     specs raise a structured SimulationError taxonomy (code + object ref +
     hint) instead of a traceback from deep inside encode."""
     from open_simulator_tpu import telemetry
+    from open_simulator_tpu.telemetry import ledger
     from open_simulator_tpu.telemetry.spans import span
 
     t0 = time.perf_counter()
     config_overrides = dict(config_overrides or {})
     preemption = preemption and not config_overrides.pop("_disable_preemption", False)
-    with span("simulate"):
+    # flight recorder: one RunRecord per simulate() call when a ledger is
+    # configured (no-op otherwise; entry points name the surface via
+    # ledger.surface_override)
+    with ledger.run_capture("simulate") as lcap, span("simulate"):
         nodes = [make_valid_node(n) for n in cluster.nodes]
         cluster = _with_nodes(cluster, nodes)
         if validate:
@@ -315,6 +319,7 @@ def simulate(
             # ONE shape to XLA, so consecutive simulate() calls on slightly
             # different clusters reuse the compiled scan (exec_cache.py)
             arrs, _, n_pods = exec_cache.bucketed_device_arrays(snapshot.arrays)
+        lcap.set_config(cfg, snapshot=snapshot, arrs=arrs)
         active_np = np.asarray(snapshot.arrays.active)
         preempted_by: Optional[Dict[int, int]] = None
         # schedule_phase counts compile-miss vs cache-hit off the jit-cache
@@ -354,6 +359,7 @@ def simulate(
                 extra_op_names=list(cfg.extension_op_names),
                 **explain_decode_kwargs(cfg, out),
             )
+        lcap.set_result(result)
     _record_simulation(telemetry, result)
     return result
 

@@ -41,6 +41,26 @@ from open_simulator_tpu.engine.scheduler import (
 _log = logging.getLogger(__name__)
 
 
+def _with_run_record(fn):
+    """Flight-recorder wiring for both sweep modes: a library-level call
+    (or POST /api/capacity, which names the surface via
+    ledger.surface_override) writes one "sweep" RunRecord with the config
+    fingerprint and the plan digest; under an already-active capture (the
+    applier's) this is a silent no-op — one record per run."""
+
+    @functools.wraps(fn)
+    def wrapper(snapshot, cfg, *args, **kwargs):
+        from open_simulator_tpu.telemetry import ledger
+
+        with ledger.run_capture("sweep") as cap:
+            plan = fn(snapshot, cfg, *args, **kwargs)
+            cap.set_config(cfg, snapshot=snapshot)
+            cap.set_plan(plan)
+            return plan
+
+    return wrapper
+
+
 class SweepThresholds(NamedTuple):
     max_cpu_pct: float = 100.0
     max_memory_pct: float = 100.0
@@ -241,6 +261,7 @@ def _lane_stats(alloc, cpu_i, mem_i, vg_cap, has_storage, lane_active,
     return _LaneStats(ok, c_pct, m_pct, sat)
 
 
+@_with_run_record
 def capacity_sweep(
     snapshot: ClusterSnapshot,
     cfg: EngineConfig,
@@ -326,6 +347,7 @@ def _probe_ladder(max_new: int, lanes: int) -> List[int]:
     return ladder
 
 
+@_with_run_record
 def capacity_bisect(
     snapshot: ClusterSnapshot,
     cfg: EngineConfig,

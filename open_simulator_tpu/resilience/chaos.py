@@ -177,7 +177,26 @@ def run_chaos(
     validate: bool = True,
 ) -> DisruptionReport:
     """Simulate the plan's fault sequence and report each event's blast
-    radius. Deterministic: same cluster + plan -> identical report."""
+    radius. Deterministic: same cluster + plan -> identical report.
+
+    With a ledger configured, the whole fault sequence is one "chaos"
+    RunRecord (the report digest doubles as the determinism witness)."""
+    from open_simulator_tpu.telemetry import ledger
+
+    with ledger.run_capture("chaos") as lcap:
+        return _run_chaos_inner(cluster, plan, apps, encode_options,
+                                config_overrides, validate, lcap)
+
+
+def _run_chaos_inner(
+    cluster,
+    plan: ChaosPlan,
+    apps: Iterable,
+    encode_options,
+    config_overrides: Optional[Dict],
+    validate: bool,
+    lcap,
+) -> DisruptionReport:
     import jax.numpy as jnp
 
     from open_simulator_tpu.core import (
@@ -212,6 +231,7 @@ def run_chaos(
     # fault bookkeeping below stays on the REAL axes; masks and forced
     # columns are padded at the call sites)
     arrs, _, n_pods_real = exec_cache.bucketed_device_arrays(snapshot.arrays)
+    lcap.set_config(cfg, snapshot=snapshot, arrs=arrs)
     n_nodes_pad = arrs.alloc.shape[0]
     n_pods_pad = arrs.req.shape[0]
 
@@ -296,4 +316,5 @@ def run_chaos(
             active_nodes=int(np.sum(active)),
         ))
         assign = new_assign
+    lcap.set_report(report)
     return report

@@ -12,6 +12,14 @@ Endpoint parity with the reference (pkg/server/server.go:148-314):
                              decode of the LAST simulation this server
                              ran (?pod=ns/name repeatable, ?top_k=N);
                              404 E_NO_SIMULATION before the first one
+  GET  /api/runs          -> run-ledger summaries (?surface=, ?limit=N);
+                             empty list when no ledger is configured
+                             (--ledger-dir / SIMON_LEDGER_DIR)
+  GET  /api/runs/<id>     -> one full RunRecord (id prefix / last / prev);
+                             404 E_NO_RUN when absent
+  GET  /api/trace         -> Chrome-trace (Perfetto) JSON of the LAST
+                             POST request's span tree — the server-side
+                             mirror of the CLI --trace-out flag
   POST /api/deploy-apps   -> simulate deploying new apps (+ optional new nodes)
   POST /api/capacity      -> "how many nodes of this spec must I add?" —
                              the capacity sweep as a service: monotone
@@ -86,7 +94,7 @@ access_log = logging.getLogger("simon-tpu.http")
 _KNOWN_PATHS = frozenset({
     "/healthz", "/test", "/metrics", "/debug/stats", "/debug/profile",
     "/api/explain", "/api/deploy-apps", "/api/scale-apps", "/api/chaos",
-    "/api/capacity",
+    "/api/capacity", "/api/runs", "/api/trace",
 })
 
 
@@ -119,7 +127,7 @@ class SimulationServer:
                  max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
                  request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
                  explain_topk: int = DEFAULT_EXPLAIN_TOPK,
-                 compile_cache_dir: str = ""):
+                 compile_cache_dir: str = "", ledger_dir: str = ""):
         self.cluster_config = cluster_config
         # recorded API dump standing in for the reference's 10 live
         # informers (pkg/server/server.go:97-137; no cluster access here)
@@ -138,6 +146,11 @@ class SimulationServer:
         # full (untrimmed) result of the last simulation: the explain
         # endpoint decodes it without re-running anything
         self._last_result: Optional[SimulateResult] = None
+        # span-window marker of the last POST request (GET /api/trace
+        # dumps exactly that request's span tree)
+        self._trace_mark = None
+        if ledger_dir:
+            telemetry.ledger.configure(ledger_dir)
         telemetry.install_runtime_gauges()
         if compile_cache_dir:
             # persistent XLA compilation cache: a restarted server skips
@@ -367,6 +380,45 @@ class SimulationServer:
         pods = query.get("pod") or None
         return explain_result(result, top_k=top_k, pods=pods)
 
+    def runs_index(self, query: Dict[str, List[str]]) -> Dict[str, Any]:
+        """Run-ledger summaries (GET /api/runs?surface=&limit=N). An
+        unconfigured ledger answers an empty list, not an error — the
+        endpoint is how a scraper discovers whether history exists."""
+        from open_simulator_tpu.telemetry import ledger
+
+        led = ledger.default_ledger()
+        if led is None:
+            return {"ledger_dir": None, "runs": []}
+        surface = (query.get("surface") or [None])[0]
+        raw_limit = (query.get("limit") or [""])[0]
+        try:
+            limit = int(raw_limit) if raw_limit else None
+        except ValueError:
+            raise SimulationError(
+                f"limit must be an integer, got {raw_limit!r}",
+                code="E_BAD_REQUEST", ref="request", field="limit",
+                hint="GET /api/runs?limit=20") from None
+        return {"ledger_dir": led.root,
+                "runs": [ledger.run_summary(r)
+                         for r in led.records(surface=surface, limit=limit)]}
+
+    def run_record(self, run_id: str) -> Dict[str, Any]:
+        """One full RunRecord (GET /api/runs/<id|last|prev>)."""
+        from open_simulator_tpu.telemetry import ledger
+
+        led = ledger.default_ledger()
+        if led is None:
+            raise SimulationError(
+                "no run ledger configured", code="E_NO_RUN", ref="server",
+                hint="start the server with --ledger-dir or set "
+                     "SIMON_LEDGER_DIR")
+        try:
+            return led.find(run_id)
+        except ledger.LedgerError as e:
+            raise SimulationError(
+                str(e), code="E_NO_RUN", ref=f"run/{run_id}",
+                hint="list known runs with GET /api/runs") from None
+
     # ---- helpers -------------------------------------------------------
 
     def _request_apps(self, body: Dict[str, Any]) -> List[AppResource]:
@@ -474,7 +526,11 @@ def _make_handler(server: SimulationServer):
             dur_s = time.perf_counter() - getattr(
                 self, "_t0", time.perf_counter())
             path = self.path.split("?", 1)[0]
-            label = path if path in _KNOWN_PATHS else "other"
+            if path.startswith("/api/runs/"):
+                # per-run lookups collapse to one label (id cardinality)
+                label = "/api/runs"
+            else:
+                label = path if path in _KNOWN_PATHS else "other"
             method = self.command or "-"
             req_total.labels(method=method, path=label,
                              status=str(status)).inc()
@@ -524,6 +580,43 @@ def _make_handler(server: SimulationServer):
                 except Exception as e:  # noqa: BLE001
                     server._stats["errors"] += 1
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            elif self.path == "/api/runs" or self.path.startswith("/api/runs?") \
+                    or self.path.startswith("/api/runs/"):
+                from urllib.parse import parse_qs, unquote, urlparse
+
+                parsed = urlparse(self.path)
+                try:
+                    if parsed.path.startswith("/api/runs/"):
+                        run_id = unquote(parsed.path[len("/api/runs/"):])
+                        self._send(200, server.run_record(run_id))
+                    else:
+                        self._send(200, server.runs_index(parse_qs(parsed.query)))
+                except SimulationError as e:
+                    server._stats["errors"] += 1
+                    self._send(_status_for(e), _err_payload(e))
+                except Exception as e:  # noqa: BLE001
+                    server._stats["errors"] += 1
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            elif self.path == "/api/trace" or self.path.startswith("/api/trace?"):
+                # Chrome-trace JSON of the last POST request's span tree —
+                # the server-side mirror of --trace-out, without toggling
+                # the process-wide jax profiler
+                from open_simulator_tpu.telemetry.spans import RECORDER
+
+                if server._trace_mark is None:
+                    # no POST yet: dumping the whole process history would
+                    # masquerade as "the last request's timeline"
+                    e = SimulationError(
+                        "no request has run yet — nothing to trace",
+                        code="E_NO_SIMULATION", ref="server",
+                        hint="POST a simulation first, then GET /api/trace")
+                    self._send(_status_for(e), _err_payload(e))
+                else:
+                    self._send_raw(
+                        200,
+                        json.dumps(RECORDER.chrome_trace(
+                            since=server._trace_mark)).encode(),
+                        "application/json")
             elif self.path == "/debug/stats":
                 # profiling surface, the gin pprof analog
                 # (/root/reference/pkg/server/server.go:148-152): process +
@@ -594,11 +687,21 @@ def _make_handler(server: SimulationServer):
             # single-flight semantics (later requests see 503) instead of
             # racing a zombie computation.
             box: Dict[str, Any] = {}
+            # window marker for GET /api/trace: the spans recorded from
+            # here on belong to this (single-flight) request
+            from open_simulator_tpu.telemetry.ledger import surface_override
+            from open_simulator_tpu.telemetry.spans import RECORDER
+
+            server._trace_mark = RECORDER.mark()
+            route = self.path
 
             def work():
                 try:
                     try:
-                        box["resp"] = (200, handler_fn(body))
+                        # the run the handler triggers records its ledger
+                        # entry under this route's surface name
+                        with surface_override(f"server:{route}"):
+                            box["resp"] = (200, handler_fn(body))
                     except SimulationError as e:
                         server._stats["errors"] += 1
                         box["resp"] = (_status_for(e), _err_payload(e))
@@ -641,6 +744,7 @@ _STATUS_BY_CODE = {
     "E_TIMEOUT": 504,
     "E_BUSY": 503,
     "E_NO_SIMULATION": 404,
+    "E_NO_RUN": 404,
 }
 
 
@@ -653,7 +757,7 @@ def serve(address: str = "127.0.0.1", port: int = 8899, cluster_config: str = ""
           max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
           request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
           explain_topk: int = DEFAULT_EXPLAIN_TOPK,
-          compile_cache_dir: str = "") -> int:
+          compile_cache_dir: str = "", ledger_dir: str = "") -> int:
     if kubeconfig:
         # validate up front so a real kubeconfig fails fast with the
         # record-a-dump recipe instead of 500s per request
@@ -664,7 +768,8 @@ def serve(address: str = "127.0.0.1", port: int = 8899, cluster_config: str = ""
                                   max_body_bytes=max_body_bytes,
                                   request_timeout_s=request_timeout_s,
                                   explain_topk=explain_topk,
-                                  compile_cache_dir=compile_cache_dir)
+                                  compile_cache_dir=compile_cache_dir,
+                                  ledger_dir=ledger_dir)
     httpd = ThreadingHTTPServer((address, port), _make_handler(sim_server))
     print(f"simon-tpu server listening on http://{address}:{port}")
     try:

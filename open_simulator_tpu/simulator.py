@@ -106,6 +106,15 @@ class Simulator:
 
     # -- internals -------------------------------------------------------
     def _run(self, select_app: Optional[str]) -> SimulateResult:
+        from open_simulator_tpu.telemetry import ledger
+
+        # flight recorder: one RunRecord per session re-run when a ledger
+        # is configured (core.simulate wires its own capture; the
+        # incremental session path records here)
+        with ledger.run_capture("simulate") as lcap:
+            return self._run_recorded(select_app, lcap)
+
+    def _run_recorded(self, select_app: Optional[str], lcap) -> SimulateResult:
         from open_simulator_tpu import telemetry
         from open_simulator_tpu.core import explain_decode_kwargs, with_volume_objects
         from open_simulator_tpu.telemetry.spans import span
@@ -120,6 +129,7 @@ class Simulator:
             # by a few rows, which used to recompile the whole scan; inside
             # one bucket every incremental re-run reuses the executable
             arrs, _, n_pods = exec_cache.bucketed_device_arrays(snapshot.arrays)
+        lcap.set_config(cfg, snapshot=snapshot, arrs=arrs)
         active_np = np.asarray(snapshot.arrays.active)
         preempted_by = None
         with telemetry.schedule_phase(schedule_pods):
@@ -167,6 +177,7 @@ class Simulator:
                 extra_op_names=list(cfg.extension_op_names),
                 **explain_decode_kwargs(cfg, out),
             )
+        lcap.set_result(result)  # the FULL (untrimmed) session result
         self._last = result
         if select_app is None:
             return result

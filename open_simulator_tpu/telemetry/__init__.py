@@ -10,6 +10,10 @@ The unified observability layer (ARCHITECTURE.md §8):
                jit compile-cache hit/miss accounting
   explain.py   per-pod "why this node / why unschedulable" decode of the
                engine's fail_counts + top-k score tensors
+  ledger.py    flight recorder: one RunRecord JSON line per simulation
+               into an on-disk size-capped ledger (--ledger-dir /
+               SIMON_LEDGER_DIR), diffed by `simon-tpu runs` and gated
+               by tools/bench_regress.py
 """
 
 from open_simulator_tpu.telemetry.registry import (  # noqa: F401
@@ -36,3 +40,4 @@ from open_simulator_tpu.telemetry.spans import (  # noqa: F401
     export_chrome_trace,
     span,
 )
+from open_simulator_tpu.telemetry import ledger  # noqa: F401

@@ -155,6 +155,12 @@ class Counter(Metric):
             child = self._children.get(key)
             return child.v if child is not None else 0.0
 
+    def collect_values(self) -> Dict[LabelValues, float]:
+        """All children as {label_values: value} — the ledger's start/end
+        counter snapshot (one lock hold, no rendering)."""
+        with self._lock:
+            return {k: c.v for k, c in self._children.items()}
+
 
 class _BoundCounter:
     __slots__ = ("_m", "_c")
@@ -383,6 +389,22 @@ class MetricsRegistry:
     def collect(self) -> Iterable[Metric]:
         with self._lock:
             return list(self._metrics.values())
+
+    def counter_samples(self, prefix: str = "") -> Dict[str, float]:
+        """Flat snapshot of every counter child as
+        {'name{label=value,...}': value} (labels sorted by name; the bare
+        metric name when label-less). The run ledger diffs two of these
+        snapshots to record which counters moved during a run."""
+        out: Dict[str, float] = {}
+        for m in self.collect():
+            if not isinstance(m, Counter) or not m.name.startswith(prefix):
+                continue
+            for values, v in m.collect_values().items():
+                pairs = sorted(zip(m.labelnames, values))
+                key = (m.name + "{" + ",".join(f"{n}={val}" for n, val in pairs)
+                       + "}") if pairs else m.name
+                out[key] = v
+        return out
 
     def render_prometheus(self) -> str:
         """The full exposition, families in registration order."""

@@ -26,7 +26,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
 from open_simulator_tpu.telemetry import registry as _registry
 
@@ -93,9 +93,28 @@ class SpanRecorder:
             self._records.clear()
         self._epoch = time.perf_counter()
 
+    # ---- windows -------------------------------------------------------
+
+    def mark(self) -> Tuple[float, float]:
+        """(epoch, now-relative) window marker: records_since(mark)
+        returns only spans recorded after this point. The run ledger
+        marks a run's start; the server marks each POST so GET
+        /api/trace can dump just the last request's span tree."""
+        return (self._epoch, time.perf_counter() - self._epoch)
+
+    def records_since(self, mark: Optional[Tuple[float, float]]) -> List[SpanRecord]:
+        if mark is None:
+            return self.records()
+        epoch, rel = mark
+        if epoch != self._epoch:
+            # clear() reset the window since the mark — everything held
+            # now started after it
+            rel = 0.0
+        return [r for r in self.records() if r.t0 >= rel - 1e-9]
+
     # ---- export --------------------------------------------------------
 
-    def chrome_trace(self) -> Dict:
+    def chrome_trace(self, since: Optional[Tuple[float, float]] = None) -> Dict:
         """Trace Event JSON (the `traceEvents` array of complete events).
         Events are emitted start-ordered; nesting falls out of interval
         containment per (pid, tid) row, which the per-thread span stack
@@ -103,7 +122,8 @@ class SpanRecorder:
         synthetic ones."""
         pid = os.getpid()
         events = []
-        for rec in sorted(self.records(), key=lambda r: (r.tid, r.t0, -r.dur)):
+        for rec in sorted(self.records_since(since),
+                          key=lambda r: (r.tid, r.t0, -r.dur)):
             ev = {
                 "name": rec.name,
                 "ph": "X",

@@ -1,0 +1,55 @@
+"""GL4 fixture (clean): the SAFE pattern for host-side run-ledger writes
+next to jit scope (companion to gl4_telemetry_ok.py / gl4_execcache_ok.py).
+
+The flight recorder (telemetry/ledger.py) appends one JSON line per run:
+result digests hash DECODED outputs (`np.asarray` after the device
+blocked), fingerprints hash static config/shape metadata, and the file
+append plus counter-delta bookkeeping are plain host I/O on host values.
+None of it runs inside the trace; the traced body stays pure jnp. This
+file must produce ZERO findings — the negative example (hashing or
+branching on a traced value inside jit) lives in gl4_trace.py.
+"""
+
+import hashlib
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from open_simulator_tpu.telemetry import counter
+
+
+def _traced_assign(req, cap):
+    # traced scope: pure jnp math — no hashing, no file writes, no host
+    # branches on traced values
+    fits = req[:, None] <= cap[None, :]
+    return jnp.argmax(fits, axis=1) - (1 - jnp.max(fits, axis=1))
+
+
+def run_and_record(requests, capacities, ledger_path, surface="fixture"):
+    req = jnp.asarray(requests)
+    cap = jnp.asarray(capacities)
+    # fingerprint from STATIC metadata (shapes/dtypes are host values even
+    # on traced arrays; reading them never syncs the device)
+    fingerprint = hashlib.sha256(
+        repr((tuple(req.shape), str(req.dtype), tuple(cap.shape))).encode()
+    ).hexdigest()[:16]
+    t0 = time.perf_counter()
+    out = jax.jit(_traced_assign)(req, cap)
+    assign = np.asarray(out)  # device -> host OUTSIDE the jit, blocks
+    wall = time.perf_counter() - t0  # host timing around the call, host-side
+    digest = hashlib.sha256(np.ascontiguousarray(assign).tobytes()).hexdigest()[:16]
+    record = {
+        "surface": surface,
+        "fingerprint": fingerprint,
+        "digest": digest,
+        "placed": int(np.sum(assign >= 0)),  # host reduction on hosted array
+        "wall_s": round(wall, 6),
+    }
+    counter("fixture_ledger_records_total",
+            labelnames=("surface",)).labels(surface=surface).inc()
+    with open(ledger_path, "a", encoding="utf-8") as f:  # host file append
+        f.write(json.dumps(record, sort_keys=True) + "\n")
+    return record

@@ -139,6 +139,14 @@ def test_gl4_execcache_safe_pattern_is_clean():
     assert lint_fixture("gl4_execcache_ok.py") == []
 
 
+def test_gl4_ledger_safe_pattern_is_clean():
+    """Host-side run-ledger writes next to jit scope — fingerprints from
+    static shape metadata, digests over np.asarray'd outputs, JSON file
+    appends, counter bumps — the pattern telemetry/ledger.py and its call
+    sites follow, must not trip GL4 (or any rule)."""
+    assert lint_fixture("gl4_ledger_ok.py") == []
+
+
 def test_suppression_swallows_finding_and_gl0_flags_naked_directive():
     fs = lint_fixture("suppressed.py")
     assert [f.code for f in fs] == ["GL0"]

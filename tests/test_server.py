@@ -411,6 +411,87 @@ def test_chaos_endpoint_bad_plan(server_url):
     assert _read_error(ei)["code"] == "E_SPEC"
 
 
+def test_runs_endpoints_and_trace(tmp_path, monkeypatch, server_url):
+    """Flight recorder over HTTP: a POST writes one RunRecord under the
+    route's surface; GET /api/runs lists it, GET /api/runs/<id> returns
+    it in full, and GET /api/trace dumps the request's span tree."""
+    from open_simulator_tpu.telemetry import ledger
+
+    monkeypatch.delenv(ledger.LEDGER_DIR_ENV, raising=False)
+    ledger.configure(str(tmp_path))
+    try:
+        _post(server_url + "/api/deploy-apps", {
+            "cluster": {"yaml": CLUSTER_YAML},
+            "apps": [{"name": "newapp", "yaml": APP_YAML}],
+        })
+        with urllib.request.urlopen(server_url + "/api/runs") as resp:
+            idx = json.loads(resp.read())
+        assert idx["ledger_dir"] == str(tmp_path)
+        [summary] = idx["runs"]
+        assert summary["surface"] == "server:/api/deploy-apps"
+        assert summary["placed"] == 5  # 2 existing + 3 newapp (full result)
+        with urllib.request.urlopen(
+                server_url + f"/api/runs/{summary['run_id']}") as resp:
+            rec = json.loads(resp.read())
+        assert rec["run_id"] == summary["run_id"]
+        assert rec["fingerprint"]["engine"] and rec["result"]["digest"]
+        assert "schedule" in rec["phases"]
+        # surface filter finds it; a bogus surface does not
+        with urllib.request.urlopen(
+                server_url + "/api/runs?surface=server:/api/deploy-apps") as resp:
+            assert len(json.loads(resp.read())["runs"]) == 1
+        with urllib.request.urlopen(
+                server_url + "/api/runs?surface=bench") as resp:
+            assert json.loads(resp.read())["runs"] == []
+        # unknown run id -> structured 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(server_url + "/api/runs/ffffffffffff")
+        assert ei.value.code == 404
+        assert json.loads(ei.value.read())["code"] == "E_NO_RUN"
+        # the last request's span tree, as Perfetto-loadable JSON
+        with urllib.request.urlopen(server_url + "/api/trace") as resp:
+            assert resp.headers["Content-Type"] == "application/json"
+            trace = json.loads(resp.read())
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert {"simulate", "schedule", "decode"} <= names
+        for ev in trace["traceEvents"]:
+            assert ev["ph"] == "X" and ev["dur"] >= 0
+    finally:
+        ledger.configure(None)
+
+
+def test_trace_before_any_post_404():
+    """GET /api/trace on a fresh server must not dump the whole process
+    span history as if it were 'the last request'."""
+    httpd = ThreadingHTTPServer(
+        ("127.0.0.1", 0), _make_handler(SimulationServer()))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/api/trace")
+        assert ei.value.code == 404
+        assert json.loads(ei.value.read())["code"] == "E_NO_SIMULATION"
+    finally:
+        httpd.shutdown()
+
+
+def test_runs_endpoint_without_ledger(server_url, monkeypatch):
+    """No ledger configured: /api/runs answers an empty index (discovery,
+    not an error); a record lookup is a 404."""
+    from open_simulator_tpu.telemetry import ledger
+
+    monkeypatch.delenv(ledger.LEDGER_DIR_ENV, raising=False)
+    ledger.configure(None)
+    with urllib.request.urlopen(server_url + "/api/runs") as resp:
+        idx = json.loads(resp.read())
+    assert idx == {"ledger_dir": None, "runs": []}
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(server_url + "/api/runs/last")
+    assert ei.value.code == 404
+
+
 def test_deploy_apps_reports_volume_bindings():
     """WFC claim -> PV choices surface in the REST response."""
     from open_simulator_tpu.server.rest import SimulationServer

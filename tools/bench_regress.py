@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Bench regression gate over the run ledger (`make bench-regress`).
+
+BENCH_r01–r05 silently recorded a TypeError for five rounds because
+nothing compared one round's number to the last. This gate does: for
+every bench shape in the ledger (records written by bench.py with a
+``--ledger-dir`` / SIMON_LEDGER_DIR), compare the NEWEST record's
+throughput (``tags.value``, pods/s, higher is better) against the
+trailing median of up to ``--window`` prior records of the same shape.
+A drop past ``--threshold`` (fractional, default 0.15 = 15%) fails the
+gate with exit code 1.
+
+Graceful no-ops (exit 0 with a notice) keep the gate safe to wire into
+any pipeline: no ledger configured, no bench records at all, or fewer
+than 2 records for every shape — a gate cannot regress against history
+that does not exist yet.
+
+Stdlib-only: reads JSON lines, computes a median, prints a verdict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import statistics
+import sys
+from typing import Dict, List
+
+
+def gate(records: List[dict], threshold: float, window: int,
+         out=None) -> int:
+    """The testable core: 0 = pass/no-op, 1 = regression."""
+    out = out if out is not None else sys.stdout
+    by_shape: Dict[str, List[dict]] = {}
+    for rec in records:  # ledger order is oldest -> newest
+        tags = rec.get("tags") or {}
+        shape = tags.get("shape")
+        if shape and isinstance(tags.get("value"), (int, float)):
+            by_shape.setdefault(shape, []).append(rec)
+
+    if not by_shape:
+        print("bench-regress: no bench records in the ledger yet — "
+              "nothing to gate (run bench.py with --ledger-dir first)",
+              file=out)
+        return 0
+
+    gated = {s: rs for s, rs in by_shape.items() if len(rs) >= 2}
+    skipped = sorted(set(by_shape) - set(gated))
+    if not gated:
+        print(f"bench-regress: every shape has a single record "
+              f"({', '.join(skipped)}) — no history to compare against; "
+              "gate is a no-op", file=out)
+        return 0
+    if skipped:
+        print(f"bench-regress: skipping first-seen shape(s): "
+              f"{', '.join(skipped)}", file=out)
+
+    failures = []
+    for shape in sorted(gated):
+        recs = gated[shape]
+        newest = recs[-1]
+        prior = recs[:-1][-window:]
+        median = statistics.median(r["tags"]["value"] for r in prior)
+        value = newest["tags"]["value"]
+        drop = (median - value) / median if median > 0 else 0.0
+        verdict = "REGRESSION" if drop > threshold else "ok"
+        print(f"bench-regress: {shape}: newest {value:.1f} pods/s vs "
+              f"median-of-{len(prior)} {median:.1f} "
+              f"({-drop * 100.0:+.1f}%) [{verdict}] "
+              f"(run {newest.get('run_id')})", file=out)
+        if drop > threshold:
+            failures.append(shape)
+
+    if failures:
+        print(f"bench-regress: FAILED — {len(failures)} shape(s) regressed "
+              f"past the {threshold * 100.0:.0f}% threshold: "
+              f"{', '.join(failures)}", file=out)
+        return 1
+    print("bench-regress: OK", file=out)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fail (exit 1) when the newest bench record of any "
+                    "shape drops past --threshold below the trailing "
+                    "median of its prior records")
+    ap.add_argument("--ledger-dir", default="",
+                    help="ledger directory (default: SIMON_LEDGER_DIR)")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="fractional allowed drop vs the trailing median "
+                         "(default 0.15)")
+    ap.add_argument("--window", type=int, default=5,
+                    help="prior records per shape feeding the median "
+                         "(default 5)")
+    args = ap.parse_args(argv)
+    if args.threshold < 0 or args.window < 1:
+        print("bench-regress: --threshold must be >= 0 and --window >= 1",
+              file=sys.stderr)
+        return 2
+
+    from open_simulator_tpu.telemetry import ledger
+
+    if args.ledger_dir:
+        ledger.configure(args.ledger_dir)
+    led = ledger.default_ledger()
+    if led is None:
+        print("bench-regress: no ledger configured (--ledger-dir / "
+              "SIMON_LEDGER_DIR) — nothing to gate")
+        return 0
+    return gate(led.records(surface="bench"), args.threshold, args.window)
+
+
+if __name__ == "__main__":
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    raise SystemExit(main())

@@ -57,6 +57,57 @@ if [ "$rc" -ne 0 ]; then
 fi
 
 echo
+echo "== run ledger (flight recorder): apply x2, diff, regress gate =="
+SMOKE_LEDGER="$(mktemp -d)"
+env JAX_PLATFORMS=cpu SIMON_LEDGER_DIR="$SMOKE_LEDGER" python - <<'PYEOF'
+# the demo apply twice in one process: records 2 "apply" RunRecords with
+# identical result digests/config fingerprints, and run 2's sweep must be
+# ALL exec-cache hits (zero misses) — compile-once-run-many, witnessed by
+# the ledger's metric deltas
+import json, sys
+from open_simulator_tpu.cli.main import main
+from open_simulator_tpu.telemetry import ledger
+
+for i in range(2):
+    rc = main(["apply", "-f", "examples/config.yaml", "--max-new-nodes", "8",
+               "--output-file", "/dev/null"])
+    assert rc == 0, f"apply run {i} exited {rc}"
+recs = ledger.default_ledger().records(surface="apply")
+assert len(recs) == 2, f"expected 2 apply records, got {len(recs)}"
+a, b = recs
+assert a["result"]["digest"] == b["result"]["digest"], (a["result"], b["result"])
+assert a["fingerprint"] == b["fingerprint"], (a["fingerprint"], b["fingerprint"])
+hits = sum(v for k, v in b["metrics"].items()
+           if "simon_compile_cache_total" in k and "event=hit" in k)
+misses = sum(v for k, v in b["metrics"].items()
+             if "simon_compile_cache_total" in k and "event=miss" in k)
+assert hits > 0 and misses == 0, (
+    f"second apply run should be pure cache hits, got hits={hits} misses={misses}")
+print(f"ledger OK: 2 apply records, equal digests "
+      f"({a['result']['digest']}), second run {hits} cache hit(s), 0 misses")
+PYEOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: ledger stage exited $rc" >&2
+  exit "$rc"
+fi
+env JAX_PLATFORMS=cpu python -m open_simulator_tpu.cli runs \
+  --ledger-dir "$SMOKE_LEDGER" diff prev last
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: runs diff exited $rc" >&2
+  exit "$rc"
+fi
+# no bench records in the smoke ledger -> the gate must no-op cleanly
+env SIMON_LEDGER_DIR="$SMOKE_LEDGER" make bench-regress
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: bench-regress exited $rc (expected clean no-op)" >&2
+  exit "$rc"
+fi
+rm -rf "$SMOKE_LEDGER"
+
+echo
 echo "== simon-tpu explain on the example cluster =="
 env JAX_PLATFORMS=cpu python -m open_simulator_tpu.cli explain \
   -f examples/config.yaml --top-k 2
