@@ -2,7 +2,7 @@
 # graftlint + the tier-1 verify command from ROADMAP.md plus one chaos
 # scenario end to end (tools/smoke.sh).
 
-.PHONY: test lint smoke bench bench-smoke bench-regress
+.PHONY: test lint smoke bench bench-smoke bench-regress lifecycle-smoke
 
 test:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -28,6 +28,12 @@ lines=[l for l in sys.stdin if l.strip().startswith('{')]; \
 d=json.loads(lines[-1]); \
 assert d['value'] > 0, d; \
 print('bench-smoke OK:', d['metric'], d['value'], d['unit'])"
+
+# graceful-drain smoke against a real server process: SIGTERM with one
+# request in flight must flip /readyz (not /healthz), reject new work
+# with 503, finish the held request, and write the final ledger record
+lifecycle-smoke:
+	env JAX_PLATFORMS=cpu python tools/lifecycle_smoke.py
 
 # regression gate over the run ledger (SIMON_LEDGER_DIR or
 # BENCH_LEDGER_DIR=... make bench-regress): the newest bench record per

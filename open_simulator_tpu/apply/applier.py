@@ -69,6 +69,10 @@ class ApplyOptions:
     sweep_mode: str = "bisect"
     # opt-in jax persistent compilation cache directory (exec_cache)
     compile_cache_dir: str = ""
+    # resume a checkpointed bisection after a crash: sweep-id prefix (or
+    # "last") of a journal under <ledger>/checkpoints or
+    # SIMON_CHECKPOINT_DIR (resilience/lifecycle.py SweepJournal)
+    resume: str = ""
 
 
 class ApplyError(RuntimeError):
@@ -266,6 +270,11 @@ class Applier:
                      else self.opts.sweep_mode)
         thresholds = self._thresholds()
 
+        if self.opts.resume and (self.opts.interactive
+                                 or self.opts.sweep_mode != "bisect"):
+            raise ApplyError(
+                "--resume replays a checkpointed bisection; it requires "
+                "--sweep-mode bisect and is incompatible with --interactive")
         if self.opts.interactive:
             # interactive decodes arbitrary user-chosen counts, so it needs
             # every lane — bisection only probes the bracket
@@ -275,7 +284,18 @@ class Applier:
             # galloping bisection: feasibility is monotone in the count, so
             # ~log_W(max_new) W-lane rounds replace max_new+1 lanes and
             # every round reuses one compiled executable
-            plan = capacity_bisect(snapshot, cfg, max_new, thresholds)
+            plan = capacity_bisect(snapshot, cfg, max_new, thresholds,
+                                   resume=self.opts.resume or None)
+            if plan.sweep_id:
+                # name the journal in the report; after a crash the
+                # journal file itself survives and `--resume last`
+                # (or the id from a prior log) replays it
+                self._say(
+                    f"sweep checkpoint: {plan.sweep_id}"
+                    + (f" (resumed {plan.resumed_rounds} round(s))"
+                       if plan.resumed_rounds else
+                       " (crash recovery: simon-tpu apply ... --resume "
+                       f"{plan.sweep_id})"))
         else:
             # exhaustive: candidate counts 0..max_new, one lane each
             counts = list(range(max_new + 1))

@@ -68,6 +68,14 @@ def build_parser() -> argparse.ArgumentParser:
                     help="run-ledger directory: append one RunRecord for "
                          "this run (also honors SIMON_LEDGER_DIR); inspect "
                          "with `simon-tpu runs`")
+    ap.add_argument("--resume", default="", metavar="SWEEP_ID",
+                    help="resume a checkpointed capacity bisection after a "
+                         "crash: sweep-id prefix (or 'last') of a journal "
+                         "under <ledger>/checkpoints or SIMON_CHECKPOINT_DIR;"
+                         " recorded rounds replay after the config "
+                         "fingerprint is verified, and the final result is "
+                         "identical to an uninterrupted run (bisect mode "
+                         "only)")
 
     ex = sub.add_parser(
         "explain",
@@ -126,6 +134,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="run-ledger directory: every simulation this server runs "
              "appends one RunRecord, served back on GET /api/runs (also "
              "honors SIMON_LEDGER_DIR)")
+    sp.add_argument(
+        "--queue-depth", type=int, default=8,
+        help="bounded admission-queue depth for POSTs: beyond it requests "
+             "shed with 429 + a Retry-After computed from the queue's "
+             "EWMA service time")
+    sp.add_argument(
+        "--drain-timeout", type=float, default=30.0,
+        help="graceful-drain budget in seconds: on SIGTERM/SIGINT the "
+             "server flips /readyz to 503, finishes in-flight work up to "
+             "this long (then cancels it cooperatively), writes a final "
+             "ledger record, and exits")
 
     ch = sub.add_parser(
         "chaos",
@@ -359,6 +378,7 @@ def main(argv=None) -> int:
             max_new_nodes=args.max_new_nodes,
             sweep_mode=args.sweep_mode,
             compile_cache_dir=args.compile_cache_dir,
+            resume=args.resume,
         )
         try:
             with _trace_capture(args.trace_out):
@@ -451,6 +471,8 @@ def main(argv=None) -> int:
             explain_topk=args.explain_topk,
             compile_cache_dir=args.compile_cache_dir,
             ledger_dir=args.ledger_dir,
+            queue_depth=args.queue_depth,
+            drain_timeout_s=args.drain_timeout,
         )
 
     if args.command == "gen-doc":

@@ -25,7 +25,17 @@ Codes (the taxonomy table lives in ARCHITECTURE.md "Resilience layer"):
   E_WORKLOAD_NOT_FOUND scale target absent from the cluster snapshot
   E_PAYLOAD_TOO_LARGE  REST request body exceeds the configured cap
   E_TIMEOUT            simulation exceeded the per-request deadline
-  E_BUSY               single-flight lock held by another simulation
+                       (legacy code; the queued front end raises
+                       E_DEADLINE)
+  E_DEADLINE           request deadline passed; work stops cooperatively
+                       at its next round/event boundary, partial results
+                       ride in the error body (resilience/lifecycle.py)
+  E_CANCELLED          explicit cooperative cancellation (drain, client)
+  E_OVERLOADED         admission queue full; Retry-After carries the
+                       EWMA-based backoff estimate (HTTP 429)
+  E_RESUME             sweep checkpoint resume rejected: fingerprint or
+                       sweep-parameter drift since the journal was cut
+  E_BUSY               server is draining; not accepting new work
   E_BAD_REQUEST        unparsable request body
 """
 

@@ -17,8 +17,9 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 from dataclasses import dataclass, field
-from typing import Dict, List, NamedTuple, Optional, Sequence
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -86,6 +87,13 @@ class CapacityPlan:
     # satisfied=False, occupancy 0 (resilience: one bad trial no longer
     # kills the sweep)
     trial_errors: Dict[int, str] = field(default_factory=dict)
+    # checkpoint-journal id when the sweep ran with round checkpointing
+    # (resilience/lifecycle.py SweepJournal): `apply --resume <sweep_id>`
+    # or POST /api/capacity {"resume": <sweep_id>} replays from it
+    sweep_id: Optional[str] = None
+    # rounds replayed from a checkpoint instead of executed (0 on a
+    # fresh run) — the resume witness for tests and responses
+    resumed_rounds: int = 0
 
 
 def make_mesh(
@@ -288,8 +296,12 @@ def capacity_sweep(
 
     When feasibility alone is the question, `capacity_bisect` answers
     with ~log_W(max_new) W-lane rounds instead of one lane per count."""
+    from open_simulator_tpu.resilience import lifecycle
     from open_simulator_tpu.telemetry.spans import span
 
+    # deadline observed before the batch launches: the exhaustive sweep
+    # is one device program, so its only cooperative boundary is here
+    lifecycle.check_current("exhaustive sweep start")
     enable_persistent_cache(cfg.compile_cache_dir)
     arrs, _, n_pods = bucketed_device_arrays(snapshot.arrays)
     masks = _padded_lane_masks(
@@ -347,6 +359,45 @@ def _probe_ladder(max_new: int, lanes: int) -> List[int]:
     return ladder
 
 
+def _journal_lane_payload(rec: dict, cfg: EngineConfig) -> Dict[str, Any]:
+    """One lane's checkpoint record: everything the final plan (and its
+    digest) needs, JSON-exact — ints stay ints, floats round-trip via
+    repr, the gpu/vol picks are stored only when their op is compiled in
+    (disabled picks never reach the plan)."""
+    st = rec["stats"]
+    return {
+        "nodes": np.asarray(rec["nodes"]).tolist(),
+        "gpu": np.asarray(rec["gpu"]).tolist() if cfg.enable_gpu else None,
+        "vol": np.asarray(rec["vol"]).tolist() if cfg.enable_pv_match else None,
+        "error": rec["error"],
+        "stats": [bool(st.all_scheduled), float(st.cpu_pct),
+                  float(st.mem_pct), bool(st.satisfied)],
+    }
+
+
+def _seed_from_journal(journal) -> Dict[int, dict]:
+    """Rebuild the bisection's `records` dict from a checkpoint journal,
+    with the exact dtypes the live path hosts (int32 picks), so a
+    resumed plan's digest is bit-identical to an uninterrupted run's."""
+    out: Dict[int, dict] = {}
+    for c, p in journal.recorded_lanes().items():
+        s = p["stats"]
+        out[c] = dict(
+            nodes=np.asarray(p["nodes"], dtype=np.int32),
+            gpu=(np.asarray(p["gpu"], dtype=np.int32)
+                 if p.get("gpu") is not None else None),
+            vol=(np.asarray(p["vol"], dtype=np.int32)
+                 if p.get("vol") is not None else None),
+            error=p.get("error"),
+            stats=_LaneStats(bool(s[0]), float(s[1]), float(s[2]),
+                             bool(s[3])),
+        )
+    return out
+
+
+SWEEP_CHECKPOINT_ENV = "SIMON_SWEEP_CHECKPOINT"
+
+
 @_with_run_record
 def capacity_bisect(
     snapshot: ClusterSnapshot,
@@ -358,6 +409,8 @@ def capacity_bisect(
     retries: int = 2,
     backoff_s: float = 0.05,
     isolate_trials: bool = True,
+    resume: Optional[str] = None,
+    checkpoint: Optional[bool] = None,
 ) -> CapacityPlan:
     """Minimum satisfying node count by batched galloping bisection.
 
@@ -378,7 +431,21 @@ def capacity_bisect(
     Probes run with fail_reasons off always — callers that want per-op
     reasons in every lane need `capacity_sweep(fail_reasons=True)`.
     Retry/isolation semantics per round match the exhaustive sweep
-    (`trial_errors` keys index the sorted probed counts)."""
+    (`trial_errors` keys index the sorted probed counts).
+
+    **Checkpoint/resume** (resilience/lifecycle.py): when a checkpoint
+    directory is configured (SIMON_CHECKPOINT_DIR, or <ledger>/checkpoints
+    when the ledger is on; `checkpoint=False` opts out, `=True` requires
+    it), every completed round appends one journal line. ``resume`` names
+    a prior journal (sweep-id prefix or "last"): after verifying the
+    config fingerprint + sweep parameters match, recorded rounds are
+    replayed instead of executed and the bisection continues from the
+    first unprobed round — the final plan digest equals an uninterrupted
+    run's. **Deadlines**: an armed ``lifecycle`` cancel scope is observed
+    at every round boundary; cancellation raises ``CancelledError``
+    carrying the probed counts and best-so-far as partial results."""
+    from open_simulator_tpu.resilience import lifecycle
+    from open_simulator_tpu.telemetry import ledger
     from open_simulator_tpu.telemetry.spans import span
 
     if max_new < 0:
@@ -394,13 +461,52 @@ def capacity_bisect(
     sweep_cfg = cfg._replace(fail_reasons=False)
     lanes = max(1, min(lanes, max_new + 1))
 
+    # ---- checkpoint journal (create fresh, or load + verify on resume);
+    # the fingerprint hashes every snapshot content field, so it is only
+    # computed on the journaled paths — never on a plain bisect call
+    root = lifecycle.checkpoint_dir()
+    journal = None
     records: Dict[int, dict] = {}      # count -> hosted lane outputs
+    resumed_rounds = 0
+    if resume:
+        fp = ledger.config_fingerprint(cfg, snapshot=snapshot, arrs=arrs)
+        journal = lifecycle.SweepJournal.load(root or "", resume)
+        journal.verify(fp, max_new, lanes, tuple(thresholds))
+        records = _seed_from_journal(journal)
+        resumed_rounds = len(journal.rounds)
+        _log.info("resumed sweep %s: %d recorded round(s), %d count(s) "
+                  "replayed", journal.sweep_id, resumed_rounds, len(records))
+    elif checkpoint or (checkpoint is None and root
+                        and os.environ.get(SWEEP_CHECKPOINT_ENV, "1") != "0"):
+        if not root:
+            raise ValueError(
+                "checkpoint=True needs a checkpoint directory: set "
+                "SIMON_CHECKPOINT_DIR or configure a ledger dir")
+        fp = ledger.config_fingerprint(cfg, snapshot=snapshot, arrs=arrs)
+        journal = lifecycle.SweepJournal.create(
+            root, fp, max_new, lanes, tuple(thresholds))
+
+    def _partial() -> Dict[str, Any]:
+        sat = sorted(c for c, r in records.items() if r["stats"].satisfied)
+        return {"probed_counts": sorted(records),
+                "best_count_so_far": sat[0] if sat else None,
+                "sweep_id": journal.sweep_id if journal else None}
+
     carry_holder = {"carry": None}     # donated across rounds (mesh=None)
 
     def probe(counts_round: List[int]) -> None:
+        # counts already replayed from a checkpoint are never re-executed;
+        # a fully-recorded round (resume) costs nothing
+        new = [c for c in counts_round if c not in records]
+        if not new:
+            return
+        # the deadline/cancel boundary: a 504'd or draining request stops
+        # HERE, before the next device launch, instead of orphaning the
+        # worker for the rest of the bisection
+        lifecycle.check_current("sweep round boundary", partial=_partial)
         # fixed [lanes, N] mask shape: pad the round by repeating the
         # last probe so every round reuses one compiled executable
-        cs = list(counts_round) + [counts_round[-1]] * (lanes - len(counts_round))
+        cs = list(new) + [new[-1]] * (lanes - len(new))
         masks = _padded_lane_masks(
             active_masks_for_counts(snapshot, cs), n_pad)
         with span("sweep", lanes=lanes, mode="bisect"):
@@ -410,14 +516,22 @@ def capacity_bisect(
                 carry=carry_holder["carry"] if mesh is None else None,
                 return_state=mesh is None)
         carry_holder["carry"] = state
+        fresh: Dict[int, dict] = {}
         for i, c in enumerate(cs):
             if c in records:
                 continue
             stats = _lane_stats(alloc, cpu_i, mem_i, vg_cap, has_storage,
                                 masks[i], nodes[i], headroom[i], vg_used[i],
                                 errs.get(i), thresholds)
-            records[c] = dict(nodes=nodes[i], gpu=gpu[i], vol=vol[i],
-                              error=errs.get(i), stats=stats)
+            records[c] = fresh[c] = dict(
+                nodes=nodes[i], gpu=gpu[i], vol=vol[i],
+                error=errs.get(i), stats=stats)
+        if journal is not None and fresh:
+            # appended only when the round's outputs are fully hosted: a
+            # crash mid-round resumes from the previous complete round
+            journal.append_round(sorted(fresh), {
+                c: _journal_lane_payload(rec, cfg)
+                for c, rec in fresh.items()})
 
     probe(_probe_ladder(max_new, lanes))
 
@@ -441,7 +555,7 @@ def capacity_bisect(
 
     probed = sorted(records)
     stats = [records[c]["stats"] for c in probed]
-    return CapacityPlan(
+    plan = CapacityPlan(
         counts=probed,
         all_scheduled=[s.all_scheduled for s in stats],
         cpu_occupancy_pct=[s.cpu_pct for s in stats],
@@ -456,7 +570,12 @@ def capacity_bisect(
                   if cfg.enable_pv_match else None),
         trial_errors={i: records[c]["error"] for i, c in enumerate(probed)
                       if records[c]["error"]},
+        sweep_id=journal.sweep_id if journal is not None else None,
+        resumed_rounds=resumed_rounds,
     )
+    if journal is not None and journal.done is None:
+        journal.finish(plan.best_count, ledger.plan_digest(plan)["digest"])
+    return plan
 
 
 def _record_lane_error(trial_errors: Dict[int, str], si: int, msg: str) -> None:

@@ -12,7 +12,12 @@ Three parts (ARCHITECTURE.md "Resilience layer"):
   chaos      ChaosPlan fault injection (node kill / zone outage / drain)
              re-simulated through the engine's active-node mask, emitting
              a deterministic DisruptionReport
-  retry      retry-with-backoff around flaky device execution
+  retry      retry-with-backoff (full jitter, elapsed-time cap) around
+             flaky device execution
+  lifecycle  survivable serving: bounded admission queue with EWMA
+             Retry-After, per-request CancelToken deadlines observed at
+             sweep-round/chaos-event boundaries, sweep checkpoint
+             journals for crash/resume, graceful-drain plumbing
 """
 
 from open_simulator_tpu.errors import (  # noqa: F401
@@ -31,4 +36,19 @@ from open_simulator_tpu.resilience.chaos import (  # noqa: F401
     FaultEvent,
     run_chaos,
 )
-from open_simulator_tpu.resilience.retry import run_with_retries  # noqa: F401
+from open_simulator_tpu.resilience.lifecycle import (  # noqa: F401
+    AdmissionQueue,
+    CancelledError,
+    CancelToken,
+    QueueClosedError,
+    QueueFullError,
+    ResumeError,
+    SweepJournal,
+    cancel_scope,
+    check_current,
+    current_token,
+)
+from open_simulator_tpu.resilience.retry import (  # noqa: F401
+    backoff_delay,
+    run_with_retries,
+)

@@ -265,6 +265,15 @@ def _run_chaos_inner(
     )
 
     for ev in plan.events:
+        # deadline/cancellation boundary (resilience/lifecycle): a 504'd
+        # request stops before the next fault instead of simulating the
+        # rest of the plan for nobody; completed steps ride as partials
+        from open_simulator_tpu.resilience import lifecycle
+
+        lifecycle.check_current(
+            "chaos event boundary",
+            partial=lambda: {"events_completed": len(report.steps),
+                             "total_events": len(plan.events)})
         failed = _resolve_event(ev, plan.zone_key, node_names, node_labels,
                                 active)
         failed_mask = np.zeros(len(node_names), dtype=bool)

@@ -1,0 +1,665 @@
+"""Serving lifecycle: admission queue, deadlines, checkpoints, drain.
+
+The robustness spine under the serving roadmap (ROADMAP item 3,
+ARCHITECTURE.md §11). Four cooperating pieces:
+
+``CancelToken`` / ``cancel_scope``
+    A per-request deadline + cooperative cancellation flag. The REST
+    handler arms one per POST (from ``--request-timeout`` or the client's
+    ``deadline_s`` field) and the worker runs inside ``cancel_scope``;
+    long computations call ``check_current()`` at their natural phase
+    boundaries (sweep rounds, chaos events) and raise a structured
+    ``CancelledError`` (``E_DEADLINE`` / ``E_CANCELLED``) carrying
+    partial results. This is what turns a 504 from "orphaned thread
+    keeps burning the device" into "work stops at the next round".
+
+``AdmissionQueue``
+    A bounded FIFO drained by ONE worker thread (the device runs one
+    program at a time — single-flight is a feature, not a lock). A full
+    queue sheds load with a structured ``E_OVERLOADED`` whose
+    ``retry_after_s`` is computed from the queue's EWMA service time,
+    replacing the instant busy-503 (which remains only while draining).
+    Jobs whose deadline already passed while queued are skipped, not
+    executed. Depth, wait time, sheds, and in-flight all flow into the
+    telemetry registry.
+
+``SweepJournal``
+    Crash-survivable capacity sweeps: each completed bisection round
+    appends one JSON line (config fingerprint + probed counts + per-lane
+    outputs) to ``<checkpoint dir>/<sweep_id>.sweep.jsonl`` beside the
+    ledger. ``simon-tpu apply --resume <id>`` (or ``POST /api/capacity``
+    with ``resume``) replays the recorded rounds after verifying the
+    fingerprint matches and continues from the first unprobed round —
+    the final plan digest is identical to an uninterrupted run.
+
+drain helpers
+    ``begin_drain`` semantics live on the server (flip readiness, stop
+    admitting, finish in-flight up to ``--drain-timeout``, final ledger
+    record); this module provides the queue's ``close``/``join`` half.
+
+Everything here is HOST machinery (threads, files, monotonic clocks) —
+nothing runs inside jit/scan scope (graftlint GL4).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from open_simulator_tpu.errors import SimulationError
+
+CHECKPOINT_DIR_ENV = "SIMON_CHECKPOINT_DIR"
+SWEEP_JOURNAL_SUFFIX = ".sweep.jsonl"
+# completed journals kept per checkpoint dir (pruned oldest-first when a
+# new sweep starts); unfinished journals — crash evidence awaiting a
+# --resume — are never pruned automatically
+JOURNAL_KEEP_ENV = "SIMON_SWEEP_JOURNAL_KEEP"
+DEFAULT_JOURNAL_KEEP = 32
+
+
+# ---- cancellation --------------------------------------------------------
+
+
+class CancelledError(SimulationError):
+    """Cooperative cancellation observed at a phase boundary. ``partial``
+    carries whatever the computation had finished (probed counts, the
+    best count so far) so a deadline response is not an empty shrug."""
+
+    code = "E_CANCELLED"
+
+    def __init__(self, message: str, code: Optional[str] = None,
+                 partial: Optional[Dict[str, Any]] = None, **kw):
+        super().__init__(message, code=code, **kw)
+        self.partial = partial or {}
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = super().to_dict()
+        if self.partial:
+            out["partial"] = self.partial
+        return out
+
+
+class CancelToken:
+    """A deadline plus an explicit cancellation flag, shared between the
+    thread that owns the request (the REST handler) and the thread doing
+    the work. Thread-safe; checking is one Event read + one clock read."""
+
+    def __init__(self, deadline_s: Optional[float] = None,
+                 reason: str = ""):
+        self._event = threading.Event()
+        self._reason = reason
+        self.deadline = (time.monotonic() + float(deadline_s)
+                         if deadline_s is not None and deadline_s > 0
+                         else None)
+        self.deadline_s = (float(deadline_s)
+                           if deadline_s is not None and deadline_s > 0
+                           else None)
+
+    def cancel(self, reason: str = "cancelled") -> None:
+        self._reason = reason
+        self._event.set()
+
+    @property
+    def reason(self) -> str:
+        if self._reason:
+            return self._reason
+        if self.deadline is not None and time.monotonic() >= self.deadline:
+            return f"deadline of {self.deadline_s:.1f}s exceeded"
+        return ""
+
+    @property
+    def cancelled(self) -> bool:
+        if self._event.is_set():
+            return True
+        return self.deadline is not None and time.monotonic() >= self.deadline
+
+    def remaining(self) -> Optional[float]:
+        """Seconds until the deadline (None when no deadline is armed).
+        Already-cancelled tokens report 0."""
+        if self._event.is_set():
+            return 0.0
+        if self.deadline is None:
+            return None
+        return max(0.0, self.deadline - time.monotonic())
+
+    def error(self, where: str = "",
+              partial: Optional[Dict[str, Any]] = None) -> CancelledError:
+        """Build the structured error for this token's current state. A
+        passed deadline reports E_DEADLINE even when the owner also
+        cancelled explicitly (the handler cancels ON deadline — the
+        deadline is the story); E_CANCELLED is reserved for cancellation
+        ahead of any deadline (drain, client gone)."""
+        deadline_passed = (self.deadline is not None
+                           and time.monotonic() >= self.deadline)
+        code = ("E_DEADLINE" if deadline_passed
+                else "E_CANCELLED" if self._event.is_set() else "E_DEADLINE")
+        msg = self.reason or "cancelled"
+        if where:
+            msg = f"{msg} (observed at {where})"
+        return CancelledError(
+            msg, code=code, partial=partial, ref="request",
+            hint="partial results, if any, are in the 'partial' field; "
+                 "retry with a larger deadline_s / --request-timeout, or "
+                 "resume a checkpointed sweep with its sweep_id")
+
+    def check(self, where: str = "",
+              partial: Optional[Dict[str, Any]] = None) -> None:
+        if self.cancelled:
+            raise self.error(where, partial)
+
+
+_tls = threading.local()
+
+
+@contextlib.contextmanager
+def cancel_scope(token: Optional[CancelToken]):
+    """Install ``token`` as the current thread's cancellation context.
+    Workers wrap each job in this so library code (sweeps, chaos) can
+    observe cancellation without threading a parameter through every
+    call signature."""
+    prev = getattr(_tls, "token", None)
+    _tls.token = token
+    try:
+        yield token
+    finally:
+        _tls.token = prev
+
+
+def current_token() -> Optional[CancelToken]:
+    return getattr(_tls, "token", None)
+
+
+def check_current(where: str = "",
+                  partial: Optional[Callable[[], Dict[str, Any]]] = None) -> None:
+    """Raise CancelledError if the current scope's token is cancelled.
+    ``partial`` is a thunk so the partial-results dict is only built when
+    cancellation actually fires (the check itself must stay ~free)."""
+    tok = current_token()
+    if tok is not None and tok.cancelled:
+        raise tok.error(where, partial() if partial is not None else None)
+
+
+# ---- admission queue -----------------------------------------------------
+
+
+class QueueFullError(SimulationError):
+    """Bounded queue shed: carries the Retry-After estimate."""
+
+    code = "E_OVERLOADED"
+
+    def __init__(self, message: str, retry_after_s: float, **kw):
+        super().__init__(message, **kw)
+        self.retry_after_s = float(retry_after_s)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = super().to_dict()
+        out["retry_after_s"] = self.retry_after_s
+        return out
+
+
+class QueueClosedError(SimulationError):
+    """Submission after close(): the server is draining."""
+
+    code = "E_BUSY"
+
+
+class Job:
+    """One queued unit of work: ``fn`` runs on the worker thread under
+    ``cancel_scope(token)``; the submitting thread waits on ``done``.
+    ``error`` holds the exception if ``fn`` raised (the worker survives
+    a poisoned job — see ``_loop``); ``result`` stays None then."""
+
+    __slots__ = ("fn", "token", "label", "done", "result", "error",
+                 "queued_at", "abandoned")
+
+    def __init__(self, fn: Callable[[], Any], token: Optional[CancelToken],
+                 label: str):
+        self.fn = fn
+        self.token = token
+        self.label = label
+        self.done = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+        self.queued_at = time.monotonic()
+        self.abandoned = False
+
+    def wait(self, timeout: Optional[float]) -> bool:
+        return self.done.wait(timeout)
+
+    def abandon(self) -> None:
+        """The submitter gave up (deadline). The worker still accounts
+        the job, but skips execution if it has not started yet."""
+        self.abandoned = True
+
+
+def _queue_metrics():
+    from open_simulator_tpu import telemetry
+
+    return (
+        telemetry.gauge("simon_queue_depth",
+                        "admission-queue jobs waiting for the worker"),
+        telemetry.gauge("simon_queue_in_flight",
+                        "admission-queue jobs currently executing"),
+        telemetry.histogram("simon_queue_wait_seconds",
+                            "time jobs spent queued before execution"),
+        telemetry.counter("simon_queue_shed_total",
+                          "jobs rejected because the queue was full (429)"),
+        telemetry.counter(
+            "simon_queue_jobs_total",
+            "admission-queue job outcomes (done = executed to completion, "
+            "skipped = cancelled/abandoned before execution started)",
+            labelnames=("outcome",)),
+        telemetry.gauge("simon_queue_service_seconds_ewma",
+                        "EWMA of job service time (feeds Retry-After)"),
+    )
+
+
+class AdmissionQueue:
+    """Bounded FIFO + one worker thread. ``submit`` never blocks: a full
+    queue raises ``QueueFullError`` with a Retry-After computed from the
+    EWMA service time and the current backlog; a closed (draining) queue
+    raises ``QueueClosedError``."""
+
+    EWMA_ALPHA = 0.2
+
+    def __init__(self, depth: int = 8, initial_service_s: float = 1.0):
+        self.depth = max(1, int(depth))
+        self._jobs: deque = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self._in_flight = 0
+        self._ewma_s = float(initial_service_s)
+        self._worker: Optional[threading.Thread] = None
+        self._current: Optional[Job] = None
+
+    # -- submit side -----------------------------------------------------
+
+    def _retry_after_locked(self) -> float:
+        """Expected wait for a new job: everyone ahead of it (queued +
+        executing) times the EWMA service time, floored at 1s so clients
+        never busy-loop. Caller holds the condition lock."""
+        backlog = len(self._jobs) + self._in_flight
+        return max(1.0, math.ceil(self._ewma_s * (backlog + 1)))
+
+    def submit(self, fn: Callable[[], Any],
+               token: Optional[CancelToken] = None,
+               label: str = "") -> Job:
+        job = Job(fn, token, label)
+        with self._cv:
+            if self._closed:
+                raise QueueClosedError(
+                    "server is draining; not accepting new work",
+                    ref="server",
+                    hint="retry against another replica, or after restart")
+            if len(self._jobs) >= self.depth:
+                _, _, _, shed, _, _ = _queue_metrics()
+                shed.inc()
+                ra = self._retry_after_locked()
+                raise QueueFullError(
+                    f"admission queue is full ({self.depth} queued)",
+                    retry_after_s=ra, ref="server",
+                    hint=f"retry after ~{ra:.0f}s (Retry-After header)")
+            self._jobs.append(job)
+            depth_g, *_ = _queue_metrics()
+            depth_g.set(len(self._jobs))
+            self._ensure_worker()
+            self._cv.notify()
+        return job
+
+    def _ensure_worker(self) -> None:
+        # lazily started so bare SimulationServer() in unit tests costs no
+        # thread until the first queued POST; caller holds the lock
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._loop, name="simon-admission-worker", daemon=True)
+            self._worker.start()
+
+    # -- drain side ------------------------------------------------------
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait until the queue is empty and nothing is executing.
+        Returns False on timeout (in-flight work still running)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._jobs or self._in_flight:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+            return True
+
+    def cancel_all(self, reason: str = "drain timeout") -> None:
+        """Cancel the executing job's token (cooperative: it stops at its
+        next phase boundary) AND every queued job's — a drain past its
+        budget must not let the worker start fresh device work for
+        clients that are about to lose their connection; skipped jobs
+        resolve with a structured 504 instead of a reset."""
+        with self._cv:
+            jobs = list(self._jobs)
+            cur = self._current
+        for job in jobs:
+            if job.token is not None:
+                job.token.cancel(reason)
+        if cur is not None and cur.token is not None:
+            cur.token.cancel(reason)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cv:
+            return {"queued": len(self._jobs), "in_flight": self._in_flight,
+                    "closed": self._closed,
+                    "ewma_service_s": round(self._ewma_s, 3)}
+
+    # -- worker ----------------------------------------------------------
+
+    def _loop(self) -> None:
+        depth_g, inflight_g, wait_h, _, jobs_total, ewma_g = _queue_metrics()
+        while True:
+            with self._cv:
+                while not self._jobs:
+                    if self._closed:
+                        self._cv.notify_all()
+                        return
+                    self._cv.wait(timeout=1.0)
+                job = self._jobs.popleft()
+                depth_g.set(len(self._jobs))
+                self._in_flight += 1
+                self._current = job
+                inflight_g.set(self._in_flight)
+            wait_s = time.monotonic() - job.queued_at
+            wait_h.observe(wait_s)
+            t0 = time.monotonic()
+            try:
+                if job.abandoned or (job.token is not None
+                                     and job.token.cancelled):
+                    # the submitter's deadline passed while the job sat in
+                    # the queue — executing it would burn the device for a
+                    # response nobody is waiting for
+                    jobs_total.labels(outcome="skipped").inc()
+                    job.result = None
+                else:
+                    try:
+                        job.result = job.fn()
+                    except BaseException as e:  # noqa: BLE001 — the worker
+                        # is a singleton: a poisoned job must not kill it
+                        # and strand every job queued behind it; the
+                        # exception goes back to the submitter via .error
+                        job.error = e
+                        jobs_total.labels(outcome="error").inc()
+                    else:
+                        jobs_total.labels(outcome="done").inc()
+                        dur = time.monotonic() - t0
+                        with self._cv:
+                            self._ewma_s = (
+                                self.EWMA_ALPHA * dur
+                                + (1 - self.EWMA_ALPHA) * self._ewma_s)
+                            ewma_g.set(self._ewma_s)
+            finally:
+                with self._cv:
+                    self._in_flight -= 1
+                    self._current = None
+                    inflight_g.set(self._in_flight)
+                    self._cv.notify_all()
+                job.done.set()
+
+
+# ---- sweep checkpoint journal -------------------------------------------
+
+
+class ResumeError(SimulationError):
+    """Bad resume request: unknown id, fingerprint mismatch, parameter
+    drift."""
+
+    code = "E_RESUME"
+
+
+def checkpoint_dir() -> Optional[str]:
+    """Where sweep journals live: SIMON_CHECKPOINT_DIR, else
+    ``<ledger dir>/checkpoints`` beside the run ledger. None disables
+    checkpointing (and resume)."""
+    explicit = os.environ.get(CHECKPOINT_DIR_ENV)
+    if explicit:
+        return explicit
+    from open_simulator_tpu.telemetry import ledger
+
+    d = ledger.ledger_dir()
+    return os.path.join(d, "checkpoints") if d else None
+
+
+def _json_default(o):
+    import numpy as np
+
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not JSON serializable: {type(o).__name__}")
+
+
+class SweepJournal:
+    """Append-only per-sweep round log. One file per sweep; each line is
+    a self-contained JSON record:
+
+      {"kind": "header", "sweep_id", "ts", "fingerprint", "max_new",
+       "lanes", "thresholds", "surface"}
+      {"kind": "round", "round": N, "counts": [...],
+       "lanes": {"<count>": {"nodes": [...], "gpu": [[...]]|null,
+                             "vol": [[...]]|null, "error": null,
+                             "stats": [all_scheduled, cpu, mem, sat]}}}
+      {"kind": "done", "best_count", "digest"}
+
+    Rounds are appended only when COMPLETE (hosted outputs in hand), so a
+    crash mid-round resumes from the last complete round and recomputes
+    the interrupted one — bit-identical, since probes are deterministic.
+    Floats round-trip exactly through JSON (repr-based), so reconstructed
+    verdicts equal the originals.
+    """
+
+    def __init__(self, path: str, header: Dict[str, Any],
+                 rounds: Optional[List[Dict[str, Any]]] = None,
+                 done: Optional[Dict[str, Any]] = None):
+        self.path = path
+        self.header = header
+        self.rounds = rounds or []
+        self.done = done
+
+    @property
+    def sweep_id(self) -> str:
+        return self.header["sweep_id"]
+
+    # -- creation / loading ---------------------------------------------
+
+    @staticmethod
+    def _is_done(path: str) -> bool:
+        """Cheap completion probe: a done marker lives in the file's last
+        line — read only the tail, never parse the rounds."""
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(0, f.tell() - 4096))
+                tail = f.read()
+        except OSError:
+            return False
+        return b'"kind": "done"' in tail
+
+    @classmethod
+    def prune(cls, root: str, keep: Optional[int] = None) -> int:
+        """Bound the checkpoint dir (the run ledger rotates; its sibling
+        must too): delete COMPLETED journals oldest-first past ``keep``
+        (SIMON_SWEEP_JOURNAL_KEEP, default 32). Unfinished journals are
+        resumable crash evidence and are never auto-deleted. Returns the
+        number removed."""
+        if keep is None:
+            try:
+                keep = int(os.environ.get(JOURNAL_KEEP_ENV,
+                                          DEFAULT_JOURNAL_KEEP))
+            except ValueError:
+                keep = DEFAULT_JOURNAL_KEEP
+        keep = max(0, keep)
+        try:
+            names = [n for n in os.listdir(root)
+                     if n.endswith(SWEEP_JOURNAL_SUFFIX)]
+        except OSError:
+            return 0
+        done = [n for n in names if cls._is_done(os.path.join(root, n))]
+        done.sort(key=lambda n: os.path.getmtime(os.path.join(root, n)))
+        removed = 0
+        for n in done[:max(0, len(done) - keep)]:
+            try:
+                os.remove(os.path.join(root, n))
+                removed += 1
+            except OSError:
+                pass  # concurrent prune/cleanup: not our problem
+        return removed
+
+    @classmethod
+    def create(cls, root: str, fingerprint: Dict[str, Any], max_new: int,
+               lanes: int, thresholds: Tuple[float, ...],
+               surface: str = "sweep") -> "SweepJournal":
+        os.makedirs(root, exist_ok=True)
+        # each new sweep pays the bounded-disk tax for the dir: completed
+        # journals past the keep cap go, resumable ones stay
+        cls.prune(root)
+        sweep_id = uuid.uuid4().hex[:12]
+        header = {"kind": "header", "sweep_id": sweep_id,
+                  "ts": round(time.time(), 6), "fingerprint": fingerprint,
+                  "max_new": int(max_new), "lanes": int(lanes),
+                  "thresholds": [float(t) for t in thresholds],
+                  "surface": surface}
+        journal = cls(os.path.join(root, sweep_id + SWEEP_JOURNAL_SUFFIX),
+                      header)
+        journal._append(header)
+        return journal
+
+    @classmethod
+    def load(cls, root: str, token: str) -> "SweepJournal":
+        """Resolve ``token`` (unique sweep-id prefix, or ``last`` for the
+        newest journal) and parse the file. Corrupt trailing lines (a
+        crash mid-append) are dropped, not fatal."""
+        if not root or not os.path.isdir(root):
+            raise ResumeError(
+                f"no checkpoint directory at {root!r}",
+                ref="resume", hint="run with --ledger-dir (checkpoints live "
+                "in <ledger>/checkpoints) or set SIMON_CHECKPOINT_DIR")
+        names = sorted(n for n in os.listdir(root)
+                       if n.endswith(SWEEP_JOURNAL_SUFFIX))
+        if not names:
+            raise ResumeError(f"no sweep checkpoints under {root}",
+                              ref="resume")
+        if token in ("last", "latest"):
+            pick = max(names, key=lambda n: os.path.getmtime(
+                os.path.join(root, n)))
+        else:
+            hits = [n for n in names if n.startswith(token)]
+            if not hits:
+                raise ResumeError(
+                    f"no sweep checkpoint matches {token!r}", ref="resume",
+                    hint=f"known: {[n.split('.')[0] for n in names]}")
+            if len(hits) > 1:
+                raise ResumeError(
+                    f"sweep id prefix {token!r} is ambiguous: "
+                    f"{[n.split('.')[0] for n in hits]}", ref="resume")
+            pick = hits[0]
+        path = os.path.join(root, pick)
+        header, rounds, done = None, [], None
+        with open(path, "r", encoding="utf-8") as f:
+            for ln in f:
+                try:
+                    rec = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue  # crash mid-append: drop the torn line
+                kind = rec.get("kind")
+                if kind == "header":
+                    header = rec
+                elif kind == "round":
+                    rounds.append(rec)
+                elif kind == "done":
+                    done = rec
+        if header is None:
+            raise ResumeError(f"checkpoint {pick} has no header line",
+                              ref="resume")
+        return cls(path, header, rounds, done)
+
+    # -- verification ----------------------------------------------------
+
+    def verify(self, fingerprint: Dict[str, Any], max_new: int, lanes: int,
+               thresholds: Tuple[float, ...]) -> None:
+        """The resume contract: the re-encoded cluster must ask the engine
+        the SAME question the checkpointed run asked. A drifted
+        fingerprint means recorded lane outputs do not apply; a drifted
+        max_new/lanes/thresholds means the bisection would probe
+        different rounds."""
+        want = self.header.get("fingerprint") or {}
+        if want != fingerprint:
+            drift = [k for k in set(want) | set(fingerprint)
+                     if want.get(k) != fingerprint.get(k)]
+            raise ResumeError(
+                f"config fingerprint drifted since the checkpoint "
+                f"(changed: {sorted(drift)}): recorded rounds answer a "
+                f"different question", ref=f"sweep/{self.sweep_id}",
+                field="fingerprint",
+                hint="re-run without --resume, or restore the original "
+                     "config/cluster inputs")
+        mismatches = []
+        if int(self.header.get("max_new", -1)) != int(max_new):
+            mismatches.append(
+                f"max_new {self.header.get('max_new')} -> {max_new}")
+        if int(self.header.get("lanes", -1)) != int(lanes):
+            mismatches.append(f"lanes {self.header.get('lanes')} -> {lanes}")
+        if [float(t) for t in self.header.get("thresholds", [])] != \
+                [float(t) for t in thresholds]:
+            mismatches.append("thresholds changed")
+        if mismatches:
+            raise ResumeError(
+                "sweep parameters drifted since the checkpoint: "
+                + "; ".join(mismatches), ref=f"sweep/{self.sweep_id}",
+                hint="resume with the original --max-new-nodes/thresholds")
+
+    # -- writing ---------------------------------------------------------
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        line = json.dumps(rec, sort_keys=True, default=_json_default) + "\n"
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(line)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def append_round(self, counts: List[int],
+                     lanes: Dict[int, Dict[str, Any]]) -> None:
+        rec = {"kind": "round", "round": len(self.rounds) + 1,
+               "counts": [int(c) for c in counts],
+               "lanes": {str(c): payload for c, payload in lanes.items()}}
+        self._append(rec)
+        self.rounds.append(rec)
+
+    def finish(self, best_count: Optional[int], digest: str) -> None:
+        rec = {"kind": "done",
+               "best_count": None if best_count is None else int(best_count),
+               "digest": digest}
+        self._append(rec)
+        self.done = rec
+
+    # -- replay ----------------------------------------------------------
+
+    def recorded_lanes(self) -> Dict[int, Dict[str, Any]]:
+        """All recorded per-count lane payloads, later rounds winning
+        (they never conflict: a count is probed once)."""
+        out: Dict[int, Dict[str, Any]] = {}
+        for rnd in self.rounds:
+            for c, payload in (rnd.get("lanes") or {}).items():
+                out[int(c)] = payload
+        return out

@@ -4,14 +4,39 @@ Device execution can fail transiently (preempted TPU slice, OOM from a
 neighboring process, transport hiccups). A bounded exponential backoff
 turns those into latency instead of failures; persistent errors still
 propagate after the attempts are exhausted so real bugs surface.
+
+Two knobs harden the schedule for fleet use:
+
+* **full jitter** (``jitter=True``): each sleep is drawn uniformly from
+  ``[0, backoff_s * 2^attempt]`` (capped). A fleet of workers that all
+  hit the same transient at the same instant must not retry in lockstep
+  — deterministic backoff synchronizes the herd, jitter disperses it.
+* **max_elapsed_s**: a wall-clock cap on the WHOLE retry loop. The old
+  schedule was unbounded in total time (`retries` bounds attempts, not
+  seconds); a serving path with a request deadline needs "give up after
+  N seconds" regardless of how the per-attempt math works out.
 """
 
 from __future__ import annotations
 
+import random
 import time
-from typing import Callable, Tuple, Type, TypeVar
+from typing import Callable, Optional, Tuple, Type, TypeVar
 
 T = TypeVar("T")
+
+
+def backoff_delay(attempt: int, backoff_s: float, max_backoff_s: float,
+                  jitter: bool = False,
+                  rng: Optional[random.Random] = None) -> float:
+    """The sleep before retry number ``attempt`` (0-based): exponential
+    ``backoff_s * 2^attempt`` capped at ``max_backoff_s``; with
+    ``jitter``, drawn uniformly from ``[0, capped]`` (AWS "full jitter").
+    Pure given ``rng`` — unit-testable with a seeded generator."""
+    capped = min(backoff_s * (2.0 ** attempt), max_backoff_s)
+    if not jitter or capped <= 0.0:
+        return capped
+    return (rng or random).uniform(0.0, capped)
 
 
 def run_with_retries(
@@ -19,22 +44,32 @@ def run_with_retries(
     retries: int = 2,
     backoff_s: float = 0.05,
     max_backoff_s: float = 2.0,
+    max_elapsed_s: Optional[float] = None,
+    jitter: bool = False,
+    rng: Optional[random.Random] = None,
     retry_on: Tuple[Type[BaseException], ...] = (Exception,),
     sleep: Callable[[float], None] = time.sleep,
 ) -> T:
-    """Call fn(); on a retryable exception wait backoff_s * 2^attempt
-    (capped) and try again, up to `retries` extra attempts. The last
-    failure is re-raised unchanged.
+    """Call fn(); on a retryable exception wait ``backoff_delay(attempt)``
+    and try again, up to ``retries`` extra attempts. The last failure is
+    re-raised unchanged.
+
+    ``max_elapsed_s`` caps the loop in wall-clock terms: once the elapsed
+    time plus the NEXT planned sleep would exceed it, the loop stops
+    retrying and re-raises — attempts remaining or not. (Checked before
+    sleeping, so the cap is never overshot by a full backoff.)
 
     Outcomes feed simon_retry_total{outcome}: `retried` per backoff taken,
     `recovered` when a retried call eventually succeeds, `exhausted` when
-    the attempts run out — the series that tells flaky-device latency
-    apart from persistent failure on a dashboard."""
+    the attempts run out, `elapsed_capped` when max_elapsed_s stops the
+    loop — the series that tells flaky-device latency apart from
+    persistent failure on a dashboard."""
     from open_simulator_tpu.telemetry import counter
 
     outcomes = counter("simon_retry_total",
                        "retry-with-backoff outcomes around device execution",
                        labelnames=("outcome",))
+    t0 = time.monotonic()
     attempt = 0
     while True:
         try:
@@ -46,6 +81,12 @@ def run_with_retries(
             if attempt >= retries:
                 outcomes.labels(outcome="exhausted").inc()
                 raise
+            delay = backoff_delay(attempt, backoff_s, max_backoff_s,
+                                  jitter=jitter, rng=rng)
+            if max_elapsed_s is not None and (
+                    time.monotonic() - t0) + delay > max_elapsed_s:
+                outcomes.labels(outcome="elapsed_capped").inc()
+                raise
             outcomes.labels(outcome="retried").inc()
-            sleep(min(backoff_s * (2.0 ** attempt), max_backoff_s))
+            sleep(delay)
             attempt += 1

@@ -2,7 +2,13 @@
 
 Endpoint parity with the reference (pkg/server/server.go:148-314):
 
-  GET  /healthz           -> {"status": "healthy"}
+  GET  /healthz           -> {"status": "healthy"} — LIVENESS: answers 200
+                             for as long as the process runs, draining or
+                             not (restart me only if this stops answering)
+  GET  /readyz            -> READINESS: 200 {"ready": true} while the
+                             server admits work; 503 {"ready": false}
+                             once draining begins (take me out of the
+                             load balancer, do not restart me)
   GET  /test              -> liveness echo
   GET  /metrics           -> Prometheus text exposition of the default
                              telemetry registry (request/scheduling/
@@ -35,18 +41,36 @@ Endpoint parity with the reference (pkg/server/server.go:148-314):
                               {"events": [{"kind": "kill_node", "target": "n0"}],
                                "zone_key": "topology.kubernetes.io/zone"}}
 
+Survivable serving (resilience/lifecycle.py, ARCHITECTURE.md §11):
+
+* **Admission queue.** POSTs enqueue into a bounded FIFO drained by ONE
+  worker thread (the device runs one program at a time — single-flight
+  is preserved by construction, not a TryLock). A full queue sheds with
+  429 + a `Retry-After` header computed from the queue's EWMA service
+  time; the instant busy-503 (E_BUSY) remains only while draining.
+* **Deadlines + cooperative cancellation.** Every POST runs under a
+  `CancelToken` armed from `--request-timeout` or the request's
+  `deadline_s` field (the smaller wins). Past the deadline the handler
+  replies 504 with an `E_DEADLINE` structured body — including partial
+  results when the worker reaches a cancellation boundary (sweep round,
+  chaos event) within the grace window — and the worker STOPS at its
+  next boundary instead of orphaning the device. Jobs whose deadline
+  lapsed while still queued are skipped, never executed.
+* **Graceful drain.** SIGTERM/SIGINT flips `/readyz` to 503, stops
+  admitting (new POSTs get 503 E_BUSY), finishes in-flight work up to
+  `--drain-timeout` (then cancels it cooperatively), writes a final
+  ledger record, and exits. `/healthz` stays 200 throughout — liveness
+  and readiness are different questions.
+
 Hardened paths (resilience layer): request bodies above `max_body_bytes`
-are rejected 413 before being read; every simulation runs under
-`request_timeout_s` (timeout -> 504 while the stale computation finishes
-off-thread, keeping single-flight semantics); malformed specs surface as
+are rejected 413 before being read; malformed specs surface as
 structured error bodies ({"error", "code", "ref", "field", "hint",
 "errors": [...]}) from the admission pass instead of 500 tracebacks.
 
 Differences, by design of this environment: the reference watches a live
 cluster through a kubeconfig; here the "live cluster" is a YAML snapshot
 directory (--cluster-config) and/or an inline `cluster` field in the
-request body. Single-flight busy semantics are kept: concurrent
-simulations get 503 (TryLock analog, server.go:167,234).
+request body.
 
 Request bodies (JSON):
   deploy-apps: {"apps": [{"name": "a1", "yaml": "<multi-doc k8s yaml>"}],
@@ -70,6 +94,7 @@ import yaml
 from open_simulator_tpu import telemetry
 from open_simulator_tpu.core import AppResource, SimulateResult, simulate
 from open_simulator_tpu.errors import SimulationError
+from open_simulator_tpu.resilience import lifecycle
 from open_simulator_tpu.k8s.loader import (
     ClusterResources,
     demux_object,
@@ -83,6 +108,12 @@ from open_simulator_tpu.k8s.objects import LABEL_APP_NAME, Node
 
 DEFAULT_MAX_BODY_BYTES = 8 * 1024 * 1024
 DEFAULT_REQUEST_TIMEOUT_S = 300.0
+DEFAULT_QUEUE_DEPTH = 8
+DEFAULT_DRAIN_TIMEOUT_S = 30.0
+# after cancelling a timed-out job's token, how long the handler waits
+# for the worker to reach a cancellation boundary and surface partial
+# results before replying with a bare E_DEADLINE body
+CANCEL_GRACE_S = 0.25
 
 # access log (satellite of the telemetry PR): one debug line per request
 # with method, path, status, duration — silent by default, switched on
@@ -92,7 +123,8 @@ access_log = logging.getLogger("simon-tpu.http")
 # request-metric path label vocabulary (unknown paths collapse to "other"
 # so a scanner can't inflate the label cardinality)
 _KNOWN_PATHS = frozenset({
-    "/healthz", "/test", "/metrics", "/debug/stats", "/debug/profile",
+    "/healthz", "/readyz", "/test", "/metrics", "/debug/stats",
+    "/debug/profile",
     "/api/explain", "/api/deploy-apps", "/api/scale-apps", "/api/chaos",
     "/api/capacity", "/api/runs", "/api/trace",
 })
@@ -127,7 +159,9 @@ class SimulationServer:
                  max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
                  request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
                  explain_topk: int = DEFAULT_EXPLAIN_TOPK,
-                 compile_cache_dir: str = "", ledger_dir: str = ""):
+                 compile_cache_dir: str = "", ledger_dir: str = "",
+                 queue_depth: int = DEFAULT_QUEUE_DEPTH,
+                 drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S):
         self.cluster_config = cluster_config
         # recorded API dump standing in for the reference's 10 live
         # informers (pkg/server/server.go:97-137; no cluster access here)
@@ -138,7 +172,12 @@ class SimulationServer:
         # GET /api/explain can break scores down without re-running;
         # 0 disables the recording (and the explain candidate lists)
         self.explain_topk = max(0, int(explain_topk))
-        self._lock = threading.Lock()
+        self.drain_timeout_s = float(drain_timeout_s)
+        # bounded admission queue drained by one worker thread: the
+        # single-flight front end (resilience/lifecycle.py) — POSTs wait
+        # in line instead of bouncing off a TryLock, full = 429 + Retry-After
+        self._queue = lifecycle.AdmissionQueue(depth=queue_depth)
+        self._draining = threading.Event()
         self._stats = {"requests": 0, "simulations": 0, "errors": 0,
                        "last_elapsed_s": 0.0, "started_at": time.time()}
         self._profile_dir = ""
@@ -160,6 +199,49 @@ class SimulationServer:
             )
 
             enable_persistent_cache(compile_cache_dir)
+
+    # ---- lifecycle -----------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def begin_drain(self) -> Dict[str, Any]:
+        """Graceful shutdown, phase one (idempotent): flip readiness
+        (readyz -> 503), stop admitting work (new POSTs -> 503 E_BUSY),
+        finish in-flight jobs up to ``drain_timeout_s`` — past it, cancel
+        the running job's token so it stops at its next cooperative
+        boundary — then write the final ledger record. The caller (the
+        signal handler in ``serve``) shuts the HTTP listener down after
+        this returns, so responses for finished work still go out."""
+        t0 = time.monotonic()
+        if self._draining.is_set():
+            return {"draining": True, "already_draining": True}
+        self._draining.set()
+        self._queue.close()
+        clean = self._queue.join(self.drain_timeout_s)
+        if not clean:
+            # past the budget: cancel the running job (stops at its next
+            # cooperative boundary) AND everything still queued (skipped
+            # by the worker, resolved with structured 504s) — no fresh
+            # device work may start during shutdown
+            self._queue.cancel_all("server draining")
+            # one short follow-up wait: cooperative cancellation needs the
+            # worker to reach its next round/event boundary
+            clean = self._queue.join(max(1.0, 0.1 * self.drain_timeout_s))
+        from open_simulator_tpu.telemetry import ledger
+
+        run_id = ledger.append_event(
+            "server:drain",
+            tags={"requests": self._stats["requests"],
+                  "simulations": self._stats["simulations"],
+                  "errors": self._stats["errors"],
+                  "drained_clean": bool(clean),
+                  **self._queue.stats()},
+            wall_s=time.monotonic() - t0)
+        return {"draining": True, "drained_clean": bool(clean),
+                "ledger_run_id": run_id,
+                "wall_s": round(time.monotonic() - t0, 3)}
 
     # ---- debug surface (the gin pprof analog, server.go:148-152) -------
 
@@ -245,7 +327,14 @@ class SimulationServer:
         Body: {"cluster": {...}?, "apps": [{"name", "yaml"}, ...],
                "new_node": {"spec_yaml": "<Node yaml>"},
                "max_new_nodes": 64?, "sweep_mode": "bisect"|"exhaustive"?,
-               "thresholds": {"max_cpu_pct", "max_memory_pct", "max_vg_pct"}?}
+               "thresholds": {"max_cpu_pct", "max_memory_pct", "max_vg_pct"}?,
+               "resume": "<sweep_id prefix | last>"?,
+               "deadline_s": 30?}
+
+        With a checkpoint directory configured (a ledger dir, or
+        SIMON_CHECKPOINT_DIR) every bisect round is journaled; the
+        response's "sweep_id" names the journal and "resume" replays it
+        after a crash — the digest matches an uninterrupted run.
         """
         from open_simulator_tpu.core import build_pod_sequence, with_volume_objects
         from open_simulator_tpu.encode.snapshot import EncodeOptions, encode_cluster
@@ -285,6 +374,13 @@ class SimulationServer:
                 f"unknown sweep_mode {mode!r}",
                 code="E_BAD_REQUEST", ref="request", field="sweep_mode",
                 hint='use "bisect" (default) or "exhaustive"')
+        resume = body.get("resume") or None
+        if resume is not None and mode != "bisect":
+            raise SimulationError(
+                "resume requires sweep_mode \"bisect\" (only bisection "
+                "rounds are checkpointed)",
+                code="E_BAD_REQUEST", ref="request", field="resume",
+                hint='drop "sweep_mode" or set it to "bisect"')
         th = body.get("thresholds") or {}
         thresholds = SweepThresholds(
             max_cpu_pct=float(th.get("max_cpu_pct", 100.0)),
@@ -299,7 +395,8 @@ class SimulationServer:
                 cluster, apps))
         cfg = make_config(snapshot)
         if mode == "bisect":
-            plan = capacity_bisect(snapshot, cfg, max_new, thresholds)
+            plan = capacity_bisect(snapshot, cfg, max_new, thresholds,
+                                   resume=resume)
         else:
             plan = capacity_sweep(snapshot, cfg, list(range(max_new + 1)),
                                   thresholds)
@@ -314,6 +411,8 @@ class SimulationServer:
             "cpu_occupancy_pct": [round(v, 2) for v in plan.cpu_occupancy_pct],
             "mem_occupancy_pct": [round(v, 2) for v in plan.mem_occupancy_pct],
             "trial_errors": {str(k): v for k, v in plan.trial_errors.items()},
+            "sweep_id": plan.sweep_id,
+            "resumed_rounds": plan.resumed_rounds,
         }
 
     def chaos(self, body: Dict[str, Any]) -> Dict[str, Any]:
@@ -538,17 +637,21 @@ def _make_handler(server: SimulationServer):
             access_log.debug("%s %s -> %d %.1fms", method, path, status,
                              dur_s * 1000.0)
 
-        def _send_raw(self, code: int, data: bytes, ctype: str) -> None:
+        def _send_raw(self, code: int, data: bytes, ctype: str,
+                      headers: tuple = ()) -> None:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            for name, value in headers:
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(data)
             self._account(code)
 
-        def _send(self, code: int, payload: Dict[str, Any]) -> None:
+        def _send(self, code: int, payload: Dict[str, Any],
+                  headers: tuple = ()) -> None:
             self._send_raw(code, json.dumps(payload).encode(),
-                           "application/json")
+                           "application/json", headers=headers)
 
         def do_GET(self):
             self._t0 = time.perf_counter()
@@ -560,7 +663,18 @@ def _make_handler(server: SimulationServer):
 
         def _do_get(self):
             if self.path == "/healthz":
-                self._send(200, {"status": "healthy"})
+                # liveness: 200 while the process runs, even mid-drain —
+                # an orchestrator must not SIGKILL a draining server
+                # whose in-flight work is still finishing
+                self._send(200, {"status": "healthy",
+                                 "draining": server.draining})
+            elif self.path == "/readyz":
+                # readiness: flips to 503 the moment drain begins, BEFORE
+                # healthz ever changes — take-out-of-rotation vs restart
+                if server.draining:
+                    self._send(503, {"ready": False, "draining": True})
+                else:
+                    self._send(200, {"ready": True})
             elif self.path == "/test":
                 self._send(200, {"message": "simon-tpu server is running"})
             elif self.path == "/metrics":
@@ -676,57 +790,125 @@ def _make_handler(server: SimulationServer):
                     f"bad json: {e}", code="E_BAD_REQUEST", ref="request",
                     hint="the body must be a JSON object")))
                 return
-            if not server._lock.acquire(blocking=False):
-                self._send(503, _err_payload(SimulationError(
-                    "a simulation is already running", code="E_BUSY",
-                    hint="retry after the in-flight simulation finishes")))
+            if not isinstance(body, dict):
+                # valid JSON but not an object (42, [], "x"): every field
+                # read below assumes a dict — reject structurally instead
+                # of crashing the handler thread
+                self._send(400, _err_payload(SimulationError(
+                    f"request body must be a JSON object, got "
+                    f"{type(body).__name__}",
+                    code="E_BAD_REQUEST", ref="request",
+                    hint='wrap the payload in an object: {"apps": [...]}')))
                 return
-            # Compute in a worker under the lock; send after the work (or
-            # the deadline) — the lock is released by the WORKER when the
-            # computation truly ends, so a timed-out simulation keeps
-            # single-flight semantics (later requests see 503) instead of
-            # racing a zombie computation.
-            box: Dict[str, Any] = {}
-            # window marker for GET /api/trace: the spans recorded from
-            # here on belong to this (single-flight) request
-            from open_simulator_tpu.telemetry.ledger import surface_override
-            from open_simulator_tpu.telemetry.spans import RECORDER
-
-            server._trace_mark = RECORDER.mark()
+            # (no draining pre-check here: begin_drain closes the queue,
+            # so a draining server rejects at submit with the same 503
+            # E_BUSY — one rejection path, not two copies)
+            # per-request deadline: --request-timeout, tightened by the
+            # client's own deadline_s (a client never widens the server's)
+            deadline_s = server.request_timeout_s
+            raw_deadline = body.get("deadline_s")
+            if raw_deadline is not None:
+                try:
+                    client_deadline = float(raw_deadline)
+                except (TypeError, ValueError):
+                    self._send(400, _err_payload(SimulationError(
+                        f"deadline_s must be a number, got {raw_deadline!r}",
+                        code="E_BAD_REQUEST", ref="request",
+                        field="deadline_s", hint='e.g. {"deadline_s": 30}')))
+                    return
+                if client_deadline <= 0:
+                    self._send(400, _err_payload(SimulationError(
+                        f"deadline_s must be positive, got {client_deadline}",
+                        code="E_BAD_REQUEST", ref="request",
+                        field="deadline_s", hint='e.g. {"deadline_s": 30}')))
+                    return
+                deadline_s = min(deadline_s, client_deadline)
+            token = lifecycle.CancelToken(deadline_s)
             route = self.path
 
             def work():
-                try:
-                    try:
-                        # the run the handler triggers records its ledger
-                        # entry under this route's surface name
-                        with surface_override(f"server:{route}"):
-                            box["resp"] = (200, handler_fn(body))
-                    except SimulationError as e:
-                        server._stats["errors"] += 1
-                        box["resp"] = (_status_for(e), _err_payload(e))
-                    except ValueError as e:
-                        server._stats["errors"] += 1
-                        box["resp"] = (400, {"error": str(e)})
-                    except Exception as e:  # noqa: BLE001 — 500 with message
-                        server._stats["errors"] += 1
-                        box["resp"] = (500, {"error": f"{type(e).__name__}: {e}"})
-                finally:
-                    server._lock.release()
+                # window marker for GET /api/trace: spans recorded from
+                # execution start belong to this (single-worker) request
+                from open_simulator_tpu.telemetry.ledger import (
+                    surface_override,
+                )
+                from open_simulator_tpu.telemetry.spans import RECORDER
 
-            t = threading.Thread(target=work, daemon=True)
-            t.start()
-            t.join(server.request_timeout_s)
-            if t.is_alive():
+                server._trace_mark = RECORDER.mark()
+                try:
+                    # the run the handler triggers records its ledger
+                    # entry under this route's surface name; the cancel
+                    # scope lets sweeps/chaos observe the deadline at
+                    # their round/event boundaries
+                    with lifecycle.cancel_scope(token), \
+                            surface_override(f"server:{route}"):
+                        return (200, handler_fn(body))
+                except SimulationError as e:
+                    # includes CancelledError: E_DEADLINE/E_CANCELLED map
+                    # to 504 and carry partial results in the body
+                    server._stats["errors"] += 1
+                    return (_status_for(e), _err_payload(e))
+                except ValueError as e:
+                    server._stats["errors"] += 1
+                    return (400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001 — 500 with message
+                    server._stats["errors"] += 1
+                    return (500, {"error": f"{type(e).__name__}: {e}"})
+
+            try:
+                job = server._queue.submit(work, token=token, label=route)
+            except lifecycle.QueueClosedError as e:
                 server._stats["errors"] += 1
-                self._send(504, _err_payload(SimulationError(
-                    f"simulation exceeded the {server.request_timeout_s:.0f}s "
-                    "deadline", code="E_TIMEOUT",
-                    hint="shrink the request or raise --request-timeout; the "
-                         "stale computation finishes in the background")))
+                self._send(_status_for(e), _err_payload(e))
                 return
-            code, payload = box["resp"]
-            self._send(code, payload)
+            except lifecycle.QueueFullError as e:
+                # load shed: Retry-After from the queue's EWMA service
+                # time x backlog, so clients pace themselves instead of
+                # hammering a saturated server
+                server._stats["errors"] += 1
+                self._send(_status_for(e), _err_payload(e),
+                           headers=(("Retry-After",
+                                     str(int(e.retry_after_s))),))
+                return
+            if not job.wait(deadline_s):
+                # deadline passed (queued or executing): cancel
+                # cooperatively, then give the worker one short grace
+                # window to reach a boundary and hand back partials
+                token.cancel(f"request deadline of {deadline_s:.1f}s "
+                             "exceeded")
+                job.wait(CANCEL_GRACE_S)
+                job.abandon()
+                resp = job.result if job.done.is_set() else None
+                if resp is not None and resp[0] == 504:
+                    # the worker's own CancelledError body (has partials)
+                    self._send(*resp)
+                    return
+                server._stats["errors"] += 1
+                self._send(504, _err_payload(lifecycle.CancelledError(
+                    f"request exceeded the {deadline_s:.1f}s deadline",
+                    code="E_DEADLINE", ref="request",
+                    hint="shrink the request, raise --request-timeout / "
+                         "deadline_s, or resume a checkpointed sweep; the "
+                         "worker stops at its next round boundary")))
+                return
+            if job.error is not None:
+                # work() catches Exception itself, so this is the escape
+                # hatch for BaseException-grade failures — the queue
+                # worker survived it; the client still gets an answer
+                server._stats["errors"] += 1
+                self._send(500, {"error": f"{type(job.error).__name__}: "
+                                          f"{job.error}"})
+                return
+            if job.result is None:
+                # skipped before execution: the token was cancelled while
+                # the job sat in the queue (deadline lapse, or a drain
+                # past its budget) — the token knows which story to tell
+                server._stats["errors"] += 1
+                err = token.error("admission queue; the job was never "
+                                  "started")
+                self._send(_status_for(err), _err_payload(err))
+                return
+            self._send(*job.result)
 
     return Handler
 
@@ -742,7 +924,11 @@ def _err_payload(e: SimulationError) -> Dict[str, Any]:
 _STATUS_BY_CODE = {
     "E_PAYLOAD_TOO_LARGE": 413,
     "E_TIMEOUT": 504,
-    "E_BUSY": 503,
+    "E_DEADLINE": 504,     # deadline observed (handler- or worker-side)
+    "E_CANCELLED": 504,    # explicit cooperative cancellation
+    "E_OVERLOADED": 429,   # admission queue full (Retry-After attached)
+    "E_BUSY": 503,         # draining: not accepting new work
+    "E_RESUME": 409,       # checkpoint fingerprint/parameter mismatch
     "E_NO_SIMULATION": 404,
     "E_NO_RUN": 404,
 }
@@ -757,7 +943,9 @@ def serve(address: str = "127.0.0.1", port: int = 8899, cluster_config: str = ""
           max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
           request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
           explain_topk: int = DEFAULT_EXPLAIN_TOPK,
-          compile_cache_dir: str = "", ledger_dir: str = "") -> int:
+          compile_cache_dir: str = "", ledger_dir: str = "",
+          queue_depth: int = DEFAULT_QUEUE_DEPTH,
+          drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S) -> int:
     if kubeconfig:
         # validate up front so a real kubeconfig fails fast with the
         # record-a-dump recipe instead of 500s per request
@@ -769,11 +957,44 @@ def serve(address: str = "127.0.0.1", port: int = 8899, cluster_config: str = ""
                                   request_timeout_s=request_timeout_s,
                                   explain_topk=explain_topk,
                                   compile_cache_dir=compile_cache_dir,
-                                  ledger_dir=ledger_dir)
+                                  ledger_dir=ledger_dir,
+                                  queue_depth=queue_depth,
+                                  drain_timeout_s=drain_timeout_s)
     httpd = ThreadingHTTPServer((address, port), _make_handler(sim_server))
+
+    def _drain_and_stop(signame: str) -> None:
+        print(f"{signame}: draining (readyz -> 503, finishing in-flight "
+              f"work, up to {drain_timeout_s:.0f}s)", flush=True)
+        info = sim_server.begin_drain()
+        print(f"drain finished (clean={info.get('drained_clean')}); "
+              "shutting down", flush=True)
+        # brief settle: handler threads waiting on just-finished jobs get
+        # their response bytes out before the listener goes away
+        time.sleep(0.2)
+        httpd.shutdown()
+
+    def _on_signal(signum, frame):
+        if sim_server.draining:
+            return  # second signal during drain: the drain keeps going
+        import signal as _signal
+
+        name = _signal.Signals(signum).name
+        # drain off the signal frame: handlers must not block, and
+        # httpd.shutdown() deadlocks if called from serve_forever's thread
+        threading.Thread(target=_drain_and_stop, args=(name,),
+                         daemon=True).start()
+
+    try:
+        import signal
+
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+    except ValueError:
+        pass  # embedded serve() off the main thread: no signal hooks
     print(f"simon-tpu server listening on http://{address}:{port}")
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
+        # signal hooks absent (non-main thread): legacy hard stop
         pass
     return 0

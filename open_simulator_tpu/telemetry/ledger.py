@@ -332,6 +332,37 @@ class RunCapture:
         }
 
 
+def append_event(surface: str, tags: Optional[Dict[str, Any]] = None,
+                 wall_s: float = 0.0) -> Optional[str]:
+    """Append a minimal lifecycle record (no fingerprint/result): the
+    graceful-drain path writes one as the server's last word — how many
+    requests it served, whether the drain finished clean — so a restart
+    loop leaves an audit trail even when no simulation was in flight.
+    Returns the run_id, or None when the ledger is disabled."""
+    led = default_ledger()
+    if led is None:
+        return None
+    rec = {
+        "schema": SCHEMA_VERSION,
+        "run_id": uuid.uuid4().hex[:12],
+        "ts": round(time.time(), 6),
+        "surface": surface,
+        "wall_s": round(float(wall_s), 6),
+        "fingerprint": None,
+        "phases": {},
+        "metrics": {},
+        "result": None,
+        "env": _environment(),
+        "tags": dict(tags or {}),
+    }
+    try:
+        led.append(rec)
+    except Exception as e:  # noqa: BLE001 — lifecycle records are best-effort
+        _log.warning("ledger append failed (%s): %s", led.path, e)
+        return None
+    return rec["run_id"]
+
+
 @contextlib.contextmanager
 def surface_override(name: str) -> Iterator[None]:
     """Name the entry point for any capture opened inside this scope (a

@@ -109,6 +109,15 @@ class Metric:
     def _make_child(self):  # pragma: no cover - abstract
         raise NotImplementedError
 
+    def collect_values(self) -> Dict[LabelValues, float]:
+        """All scalar children as {label_values: value} in one lock hold —
+        the cheap stored-state read path (the ledger's counter snapshot,
+        the lifecycle drain summary). Valid for counters and gauges
+        (callback gauges are NOT sampled); histogram children have no
+        single value and must use child_stats instead."""
+        with self._lock:
+            return {k: c.v for k, c in self._children.items()}
+
     def render(self) -> List[str]:
         with self._lock:
             children = list(self._children.items())
@@ -154,12 +163,6 @@ class Counter(Metric):
         with self._lock:
             child = self._children.get(key)
             return child.v if child is not None else 0.0
-
-    def collect_values(self) -> Dict[LabelValues, float]:
-        """All children as {label_values: value} — the ledger's start/end
-        counter snapshot (one lock hold, no rendering)."""
-        with self._lock:
-            return {k: c.v for k, c in self._children.items()}
 
 
 class _BoundCounter:

@@ -1,0 +1,737 @@
+"""Survivable serving: admission queue, deadlines/cancellation, sweep
+checkpoint/resume, graceful drain (resilience/lifecycle.py + the reworked
+server front end). The SIGKILL crash-recovery path has its own file
+(test_resume_crash.py) — here the "crash" is a truncated journal."""
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu import telemetry
+from open_simulator_tpu.resilience import lifecycle
+from open_simulator_tpu.resilience.retry import backoff_delay, run_with_retries
+from open_simulator_tpu.server.rest import SimulationServer, _make_handler
+
+
+# ---- CancelToken ---------------------------------------------------------
+
+
+def test_cancel_token_deadline_and_explicit():
+    tok = lifecycle.CancelToken(deadline_s=60.0)
+    assert not tok.cancelled
+    assert 0 < tok.remaining() <= 60.0
+    tok.cancel("client went away")
+    assert tok.cancelled and tok.remaining() == 0.0
+    err = tok.error("somewhere")
+    assert err.code == "E_CANCELLED"
+    assert "client went away" in err.message and "somewhere" in err.message
+
+    expired = lifecycle.CancelToken(deadline_s=0.001)
+    time.sleep(0.005)
+    assert expired.cancelled
+    err = expired.error(partial={"probed_counts": [0, 8]})
+    assert err.code == "E_DEADLINE"
+    assert err.to_dict()["partial"] == {"probed_counts": [0, 8]}
+    with pytest.raises(lifecycle.CancelledError):
+        expired.check("round boundary")
+
+    # no deadline, never cancelled: free to run forever
+    free = lifecycle.CancelToken()
+    assert not free.cancelled and free.remaining() is None
+    free.check()
+
+
+def test_cancel_scope_threads_token_to_library_code():
+    assert lifecycle.current_token() is None
+    lifecycle.check_current("no scope")  # no-op outside a scope
+    tok = lifecycle.CancelToken()
+    with lifecycle.cancel_scope(tok):
+        assert lifecycle.current_token() is tok
+        lifecycle.check_current()
+        tok.cancel()
+        with pytest.raises(lifecycle.CancelledError) as ei:
+            lifecycle.check_current("loop", partial=lambda: {"done": 3})
+        assert ei.value.partial == {"done": 3}
+    assert lifecycle.current_token() is None
+
+
+# ---- retry satellite: jitter + elapsed cap -------------------------------
+
+
+def test_backoff_delay_schedule_deterministic_and_jittered():
+    # deterministic: exponential, capped
+    assert [backoff_delay(a, 0.1, 0.5) for a in range(4)] == [
+        0.1, 0.2, 0.4, 0.5]
+    # full jitter: uniform in [0, capped], reproducible with a seeded rng
+    rng = random.Random(7)
+    draws = [backoff_delay(a, 0.1, 0.5, jitter=True, rng=rng)
+             for a in range(50)]
+    caps = [min(0.1 * 2.0 ** a, 0.5) for a in range(50)]
+    assert all(0.0 <= d <= c for d, c in zip(draws, caps))
+    assert len(set(draws)) > 10  # actually jittered, not constant
+    # same seed, same schedule
+    rng2 = random.Random(7)
+    assert draws == [backoff_delay(a, 0.1, 0.5, jitter=True, rng=rng2)
+                     for a in range(50)]
+
+
+def test_run_with_retries_jitter_bounds_sleeps():
+    sleeps = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert run_with_retries(flaky, retries=5, backoff_s=0.1,
+                            jitter=True, rng=random.Random(3),
+                            sleep=sleeps.append) == "ok"
+    assert len(sleeps) == 3
+    for i, s in enumerate(sleeps):
+        assert 0.0 <= s <= min(0.1 * 2.0 ** i, 2.0)
+
+
+def test_run_with_retries_max_elapsed_caps_the_loop():
+    """The next planned sleep would blow the wall-clock budget: stop
+    retrying and re-raise even though attempts remain."""
+    sleeps = []
+
+    def always():
+        raise RuntimeError("hard")
+
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="hard"):
+        run_with_retries(always, retries=50, backoff_s=0.2,
+                         max_elapsed_s=0.1, sleep=sleeps.append)
+    # first planned sleep (0.2s) already exceeds the 0.1s budget
+    assert sleeps == []
+    assert time.monotonic() - t0 < 1.0
+
+    # a budget that allows one backoff but not two
+    sleeps2 = []
+    with pytest.raises(RuntimeError):
+        run_with_retries(always, retries=50, backoff_s=0.04,
+                         max_backoff_s=10.0, max_elapsed_s=0.05,
+                         sleep=lambda s: (sleeps2.append(s), time.sleep(s)))
+    assert len(sleeps2) == 1
+
+
+# ---- AdmissionQueue ------------------------------------------------------
+
+
+def test_queue_runs_jobs_in_order_and_sheds_when_full():
+    q = lifecycle.AdmissionQueue(depth=2, initial_service_s=0.5)
+    gate = threading.Event()
+    order = []
+
+    def make(i):
+        def fn():
+            gate.wait(2.0)
+            order.append(i)
+            return i
+        return fn
+
+    jobs = [q.submit(make(0))]          # starts executing, blocks on gate
+    time.sleep(0.05)                    # let the worker pick job 0 up
+    jobs += [q.submit(make(1)), q.submit(make(2))]  # fills depth-2 queue
+    with pytest.raises(lifecycle.QueueFullError) as ei:
+        q.submit(make(3))
+    # backlog = 2 queued + 1 in flight; EWMA 0.5s -> ceil(0.5 * 4) = 2
+    assert ei.value.retry_after_s >= 1.0
+    assert ei.value.to_dict()["retry_after_s"] == ei.value.retry_after_s
+    gate.set()
+    for j in jobs:
+        assert j.wait(2.0)
+    assert order == [0, 1, 2] and [j.result for j in jobs] == [0, 1, 2]
+    assert q.join(1.0)
+
+
+def test_queue_skips_jobs_whose_deadline_lapsed_while_queued():
+    q = lifecycle.AdmissionQueue(depth=4)
+    gate = threading.Event()
+    ran = []
+    q.submit(lambda: gate.wait(2.0))
+    time.sleep(0.05)
+    dead = lifecycle.CancelToken()
+    dead.cancel("deadline lapsed in queue")
+    j_dead = q.submit(lambda: ran.append("dead"), token=dead)
+    j_live = q.submit(lambda: ran.append("live") or "ok")
+    gate.set()
+    assert j_dead.wait(2.0) and j_live.wait(2.0)
+    assert ran == ["live"]          # the cancelled job never executed
+    assert j_dead.result is None and j_live.result == "ok"
+
+
+def test_queue_worker_survives_poisoned_job():
+    """A job whose fn raises must not kill the singleton worker: the
+    exception lands on job.error, jobs queued behind it still run."""
+    q = lifecycle.AdmissionQueue(depth=4)
+
+    class Rude(BaseException):
+        pass
+
+    def poison():
+        raise Rude("boom")
+
+    j_bad = q.submit(poison)
+    j_ok = q.submit(lambda: "fine")
+    assert j_bad.wait(2.0) and j_ok.wait(2.0)
+    assert isinstance(j_bad.error, Rude) and j_bad.result is None
+    assert j_ok.error is None and j_ok.result == "fine"
+    assert q.join(1.0)
+
+
+def test_sweep_journal_prune_keeps_unfinished(tmp_path):
+    """prune: completed journals past the keep cap go oldest-first;
+    unfinished journals (resumable crash evidence) always stay."""
+    fp = {"engine": "e", "bucket": [4, 8], "workload": "w"}
+    ids = []
+    for i in range(4):
+        j = lifecycle.SweepJournal.create(str(tmp_path), fp, 4, 2,
+                                          (100.0, 100.0, 100.0))
+        if i != 2:                       # journal 2 stays unfinished
+            j.finish(1, f"d{i}")
+        ids.append(j.sweep_id)
+        import os as _os
+        _os.utime(j.path, (1000.0 + i, 1000.0 + i))
+    removed = lifecycle.SweepJournal.prune(str(tmp_path), keep=1)
+    assert removed == 2                  # journals 0 and 1 (oldest done)
+    left = {p.name.split(".")[0] for p in tmp_path.iterdir()}
+    assert left == {ids[2], ids[3]}      # unfinished + newest done
+
+
+def test_queue_close_rejects_and_join_waits():
+    q = lifecycle.AdmissionQueue(depth=4)
+    done = []
+    q.submit(lambda: (time.sleep(0.1), done.append(1)))
+    q.close()
+    with pytest.raises(lifecycle.QueueClosedError):
+        q.submit(lambda: None)
+    assert q.join(2.0)              # in-flight work finished the drain
+    assert done == [1]
+    assert q.stats()["closed"] and q.stats()["in_flight"] == 0
+
+
+# ---- SweepJournal --------------------------------------------------------
+
+
+def _journal_roundtrip_dir(tmp_path):
+    fp = {"engine": "e0", "bucket": [8, 16], "workload": "w0"}
+    j = lifecycle.SweepJournal.create(str(tmp_path), fp, max_new=8, lanes=4,
+                                      thresholds=(100.0, 100.0, 100.0))
+    j.append_round([0, 1, 8], {
+        0: {"nodes": [0, -1], "gpu": None, "vol": None, "error": None,
+            "stats": [False, 50.0, 25.0, False]},
+        1: {"nodes": [0, 1], "gpu": None, "vol": None, "error": None,
+            "stats": [True, 40.0, 20.0, True]},
+        8: {"nodes": [0, 1], "gpu": None, "vol": None, "error": None,
+            "stats": [True, 10.0, 5.0, True]},
+    })
+    return fp, j
+
+
+def test_sweep_journal_roundtrip_prefix_and_last(tmp_path):
+    fp, j = _journal_roundtrip_dir(tmp_path)
+    j.finish(1, "abcd")
+    loaded = lifecycle.SweepJournal.load(str(tmp_path), j.sweep_id[:6])
+    assert loaded.sweep_id == j.sweep_id
+    assert loaded.done["best_count"] == 1 and loaded.done["digest"] == "abcd"
+    lanes = loaded.recorded_lanes()
+    assert sorted(lanes) == [0, 1, 8]
+    assert lanes[1]["stats"] == [True, 40.0, 20.0, True]
+    loaded.verify(fp, 8, 4, (100.0, 100.0, 100.0))
+    assert lifecycle.SweepJournal.load(str(tmp_path), "last").sweep_id == j.sweep_id
+
+
+def test_sweep_journal_verify_rejects_drift(tmp_path):
+    fp, j = _journal_roundtrip_dir(tmp_path)
+    loaded = lifecycle.SweepJournal.load(str(tmp_path), j.sweep_id)
+    with pytest.raises(lifecycle.ResumeError, match="fingerprint drifted"):
+        loaded.verify({**fp, "workload": "CHANGED"}, 8, 4,
+                      (100.0, 100.0, 100.0))
+    with pytest.raises(lifecycle.ResumeError, match="max_new 8 -> 16"):
+        loaded.verify(fp, 16, 4, (100.0, 100.0, 100.0))
+    with pytest.raises(lifecycle.ResumeError, match="thresholds changed"):
+        loaded.verify(fp, 8, 4, (90.0, 100.0, 100.0))
+    with pytest.raises(lifecycle.ResumeError, match="no sweep checkpoint "
+                                                    "matches"):
+        lifecycle.SweepJournal.load(str(tmp_path), "zzzzzz")
+
+
+def test_sweep_journal_drops_torn_trailing_line(tmp_path):
+    fp, j = _journal_roundtrip_dir(tmp_path)
+    with open(j.path, "a", encoding="utf-8") as f:
+        f.write('{"kind": "round", "round": 2, "counts": [4], "la')  # torn
+    loaded = lifecycle.SweepJournal.load(str(tmp_path), j.sweep_id)
+    assert len(loaded.rounds) == 1 and loaded.done is None
+
+
+# ---- bisect checkpoint/resume + cancellation -----------------------------
+
+
+def _snapshot(n_pods=12, pod_cpu="1500m", max_new=8):
+    from open_simulator_tpu.core import AppResource, build_pod_sequence
+    from open_simulator_tpu.encode.snapshot import EncodeOptions, encode_cluster
+    from open_simulator_tpu.k8s.loader import ClusterResources, make_valid_node
+    from tests.conftest import make_node, make_pod
+
+    cluster = ClusterResources()
+    cluster.nodes = [make_node("real-0", cpu_m=4000, mem_mib=8192)]
+    app = ClusterResources()
+    app.pods = [make_pod(f"p{i}", cpu=pod_cpu, mem="512Mi")
+                for i in range(n_pods)]
+    pods = build_pod_sequence(
+        cluster, [AppResource(name="a", resources=app)])
+    template = make_node("template", cpu_m=4000, mem_mib=8192)
+    return encode_cluster(
+        [make_valid_node(n) for n in cluster.nodes], pods,
+        EncodeOptions(max_new_nodes=max_new, new_node_template=template))
+
+
+def test_bisect_checkpoints_and_resumes_identically(tmp_path, monkeypatch):
+    """In-process crash sim: run with checkpointing, truncate the journal
+    to its first round ("crash"), resume — the resumed plan's digest must
+    equal the uninterrupted run's, with fewer executed rounds."""
+    from open_simulator_tpu.engine.scheduler import make_config
+    from open_simulator_tpu.parallel.sweep import capacity_bisect
+    from open_simulator_tpu.telemetry.ledger import plan_digest
+
+    monkeypatch.setenv(lifecycle.CHECKPOINT_DIR_ENV, str(tmp_path))
+    snap = _snapshot()
+    cfg = make_config(snap)
+    plan = capacity_bisect(snap, cfg, 8, lanes=2)
+    assert plan.best_count == 5          # 12 pods x 1500m, 2 per node
+    assert plan.sweep_id and plan.resumed_rounds == 0
+    full = lifecycle.SweepJournal.load(str(tmp_path), plan.sweep_id)
+    assert len(full.rounds) >= 2 and full.done["best_count"] == 5
+    assert full.done["digest"] == plan_digest(plan)["digest"]
+
+    # "crash" after round 1: drop every later line
+    with open(full.path, "r", encoding="utf-8") as f:
+        lines = f.readlines()
+    kept = [ln for ln in lines
+            if json.loads(ln).get("kind") == "header"
+            or json.loads(ln).get("round") == 1]
+    with open(full.path, "w", encoding="utf-8") as f:
+        f.writelines(kept)
+
+    resumed = capacity_bisect(snap, cfg, 8, lanes=2, resume=plan.sweep_id)
+    assert resumed.resumed_rounds == 1
+    assert resumed.best_count == plan.best_count
+    assert resumed.counts == plan.counts
+    assert plan_digest(resumed)["digest"] == plan_digest(plan)["digest"]
+    np.testing.assert_array_equal(resumed.nodes_per_scenario,
+                                  plan.nodes_per_scenario)
+
+    # resuming the COMPLETE journal executes nothing and still agrees
+    replay = capacity_bisect(snap, cfg, 8, lanes=2, resume=plan.sweep_id)
+    assert plan_digest(replay)["digest"] == plan_digest(plan)["digest"]
+
+
+def test_bisect_resume_rejects_workload_drift(tmp_path, monkeypatch):
+    from open_simulator_tpu.engine.scheduler import make_config
+    from open_simulator_tpu.parallel.sweep import capacity_bisect
+
+    monkeypatch.setenv(lifecycle.CHECKPOINT_DIR_ENV, str(tmp_path))
+    snap = _snapshot()
+    plan = capacity_bisect(snap, make_config(snap), 8, lanes=2)
+    other = _snapshot(n_pods=10)         # different workload, same shapes
+    with pytest.raises(lifecycle.ResumeError, match="fingerprint drifted"):
+        capacity_bisect(other, make_config(other), 8, lanes=2,
+                        resume=plan.sweep_id)
+
+
+def test_bisect_disabled_checkpointing_writes_nothing(tmp_path, monkeypatch):
+    from open_simulator_tpu.engine.scheduler import make_config
+    from open_simulator_tpu.parallel.sweep import capacity_bisect
+
+    monkeypatch.setenv(lifecycle.CHECKPOINT_DIR_ENV, str(tmp_path))
+    monkeypatch.setenv("SIMON_SWEEP_CHECKPOINT", "0")
+    snap = _snapshot()
+    plan = capacity_bisect(snap, make_config(snap), 8, lanes=2)
+    assert plan.sweep_id is None
+    assert not list(tmp_path.iterdir())
+
+
+def test_bisect_observes_cancellation_at_round_boundary():
+    """A token cancelled after the first round stops the bisection at the
+    next boundary with partial results (probed counts, best so far)."""
+    from open_simulator_tpu.engine.scheduler import make_config
+    from open_simulator_tpu.parallel.sweep import capacity_bisect
+
+    class CountdownToken(lifecycle.CancelToken):
+        """Cancelled from the Nth .cancelled query on (deadline-style)."""
+
+        def __init__(self, allow_checks: int):
+            super().__init__()
+            self.allow = allow_checks
+
+        @property
+        def cancelled(self):
+            if self.allow > 0:
+                self.allow -= 1
+                return False
+            return True
+
+    snap = _snapshot()
+    cfg = make_config(snap)
+    tok = CountdownToken(allow_checks=1)   # round 1 runs; round 2 cancels
+    with lifecycle.cancel_scope(tok):
+        with pytest.raises(lifecycle.CancelledError) as ei:
+            capacity_bisect(snap, cfg, 8, lanes=2, checkpoint=False)
+    partial = ei.value.partial
+    assert partial["probed_counts"]        # round 1's ladder landed
+    assert set(partial["probed_counts"]) < set(range(9))
+    assert ei.value.code == "E_DEADLINE"
+
+
+# ---- server: 429/Retry-After, soak, orphan fix, drain --------------------
+
+
+CLUSTER_YAML = """
+apiVersion: v1
+kind: Node
+metadata: {name: s0}
+status:
+  allocatable: {cpu: "8", memory: 16Gi, pods: "110"}
+"""
+
+
+def _mini_server(depth=1, request_timeout_s=300.0, drain_timeout_s=5.0):
+    srv = SimulationServer(queue_depth=depth,
+                           request_timeout_s=request_timeout_s,
+                           drain_timeout_s=drain_timeout_s)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _make_handler(srv))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return srv, httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def _post_status(url, payload):
+    """POST returning (status, headers, body) without raising."""
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def test_server_soak_queue_backpressure_and_no_deadlock():
+    """Threaded soak: POSTs queue/shed while GETs scrape concurrently.
+    Full queue -> 429 with a Retry-After header; nothing deadlocks; the
+    shed counter moves exactly once per 429; depth/in-flight return to 0."""
+    srv, httpd, url = _mini_server(depth=1)
+    srv.deploy_apps = lambda body: (time.sleep(0.25), {"ok": True})[1]
+    shed0 = telemetry.counter("simon_queue_shed_total").value()
+    wait_h = telemetry.REGISTRY.histogram("simon_queue_wait_seconds")
+    waits0, _ = wait_h.child_stats()
+
+    results = []
+    res_lock = threading.Lock()
+    barrier = threading.Barrier(8)
+
+    def post():
+        barrier.wait(5.0)
+        out = _post_status(url + "/api/deploy-apps", {"apps": []})
+        with res_lock:
+            results.append(out)
+
+    get_errors = []
+
+    def scrape():
+        barrier.wait(5.0)
+        for _ in range(10):
+            try:
+                with urllib.request.urlopen(url + "/metrics") as r:
+                    assert b"simon_queue_depth" in r.read()
+                with urllib.request.urlopen(url + "/api/runs") as r:
+                    json.loads(r.read())
+            except Exception as e:  # noqa: BLE001
+                get_errors.append(e)
+
+    threads = [threading.Thread(target=post) for _ in range(6)] + \
+              [threading.Thread(target=scrape) for _ in range(2)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(15.0)
+            assert not t.is_alive(), "soak deadlocked"
+        assert not get_errors, get_errors
+        statuses = sorted(s for s, _, _ in results)
+        assert len(statuses) == 6 and set(statuses) <= {200, 429}
+        n429 = statuses.count(429)
+        assert statuses.count(200) >= 1 and n429 >= 1
+        for status, headers, body in results:
+            if status == 429:
+                assert int(headers["Retry-After"]) >= 1
+                assert body["code"] == "E_OVERLOADED"
+                assert body["retry_after_s"] >= 1.0
+        # monotone queue metrics: one shed per 429, one wait observation
+        # per executed job, gauges back to 0
+        assert telemetry.counter("simon_queue_shed_total").value() - shed0 \
+            == n429
+        waits1, _ = wait_h.child_stats()
+        assert waits1 - waits0 == statuses.count(200)
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and (
+                telemetry.gauge("simon_queue_depth").value() != 0
+                or telemetry.gauge("simon_queue_in_flight").value() != 0):
+            time.sleep(0.02)
+        assert telemetry.gauge("simon_queue_depth").value() == 0
+        assert telemetry.gauge("simon_queue_in_flight").value() == 0
+    finally:
+        httpd.shutdown()
+
+
+def test_504_cancels_worker_no_orphan():
+    """The PR-1 regression: the old timeout path left the worker thread
+    burning the device. Now the 504 cancels the token; a cooperative
+    handler stops at its next boundary and the in-flight gauge returns
+    to 0 within one 'round'."""
+    srv, httpd, url = _mini_server(depth=2, request_timeout_s=0.15)
+
+    def cooperative(body):
+        while True:                       # a sweep-round-like loop
+            lifecycle.check_current("test round boundary")
+            time.sleep(0.01)
+
+    srv.deploy_apps = cooperative
+    try:
+        status, _, body = _post_status(url + "/api/deploy-apps", {"apps": []})
+        assert status == 504
+        assert body["code"] == "E_DEADLINE"
+        # the worker observed the cancellation: in-flight drains to 0
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and \
+                telemetry.gauge("simon_queue_in_flight").value() != 0:
+            time.sleep(0.02)
+        assert telemetry.gauge("simon_queue_in_flight").value() == 0
+        # and the queue is alive for the next request
+        srv.deploy_apps = lambda b: {"ok": True}
+        status, _, body = _post_status(url + "/api/deploy-apps", {"apps": []})
+        assert status == 200 and body == {"ok": True}
+    finally:
+        httpd.shutdown()
+
+
+def test_non_object_json_body_is_structured_400():
+    """Valid JSON that is not an object (42, [], \"x\") must get a
+    structured 400, not an AttributeError-killed connection."""
+    srv, httpd, url = _mini_server(depth=2)
+    try:
+        for raw in (b"42", b"[]", b'"zap"'):
+            req = urllib.request.Request(
+                url + "/api/deploy-apps", data=raw,
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            assert ei.value.code == 400
+            body = json.loads(ei.value.read())
+            assert body["code"] == "E_BAD_REQUEST"
+            assert "JSON object" in body["error"]
+    finally:
+        httpd.shutdown()
+
+
+def test_drain_timeout_cancels_queued_jobs_too():
+    """Past --drain-timeout, QUEUED jobs are cancelled as well: the
+    worker must not start fresh device work during shutdown, and the
+    queued clients get a structured 504 rather than a connection reset."""
+    srv, httpd, url = _mini_server(depth=4, drain_timeout_s=0.2)
+    started = []
+
+    def cooperative(body):
+        started.append(1)
+        while True:
+            lifecycle.check_current("slow loop")
+            time.sleep(0.01)
+
+    srv.deploy_apps = cooperative
+    outs = {}
+
+    def post(i):
+        outs[i] = _post_status(url + "/api/deploy-apps", {"apps": []})
+
+    threads = [threading.Thread(target=post, args=(i,)) for i in range(3)]
+    try:
+        threads[0].start()
+        time.sleep(0.1)                 # job 0 executing
+        threads[1].start()
+        threads[2].start()
+        time.sleep(0.1)                 # jobs 1, 2 queued
+        info = srv.begin_drain()
+        assert info["drained_clean"] is True
+        for t in threads:
+            t.join(5.0)
+            assert not t.is_alive()
+        assert len(started) == 1        # queued jobs never executed
+        assert outs[0][0] == 504        # in-flight: cancelled at boundary
+        for i in (1, 2):
+            status, _, body = outs[i]
+            assert status == 504 and body["code"] == "E_CANCELLED"
+            assert "draining" in body["error"]
+    finally:
+        httpd.shutdown()
+
+
+def test_client_deadline_s_validated_and_enforced():
+    srv, httpd, url = _mini_server(depth=2)
+    srv.deploy_apps = lambda body: (time.sleep(0.5), {"ok": True})[1]
+    try:
+        status, _, body = _post_status(
+            url + "/api/deploy-apps", {"deadline_s": "soon"})
+        assert status == 400 and body["field"] == "deadline_s"
+        status, _, body = _post_status(
+            url + "/api/deploy-apps", {"deadline_s": -3})
+        assert status == 400 and body["field"] == "deadline_s"
+        # a client deadline tighter than --request-timeout wins
+        status, _, body = _post_status(
+            url + "/api/deploy-apps", {"deadline_s": 0.05})
+        assert status == 504 and body["code"] == "E_DEADLINE"
+    finally:
+        httpd.shutdown()
+
+
+def test_graceful_drain_finishes_inflight_rejects_new(tmp_path, monkeypatch):
+    """begin_drain: readyz flips (healthz does not), the in-flight request
+    completes, new POSTs bounce with 503 E_BUSY, and the final ledger
+    record lands."""
+    from open_simulator_tpu.telemetry import ledger
+
+    monkeypatch.delenv(ledger.LEDGER_DIR_ENV, raising=False)
+    ledger.configure(str(tmp_path))
+    srv, httpd, url = _mini_server(depth=2, drain_timeout_s=5.0)
+    release = threading.Event()
+
+    def slow(body):
+        release.wait(5.0)
+        return {"finished": True}
+
+    srv.deploy_apps = slow
+    inflight = {}
+
+    def post_inflight():
+        inflight["out"] = _post_status(url + "/api/deploy-apps", {"apps": []})
+
+    t = threading.Thread(target=post_inflight)
+    drain_info = {}
+    try:
+        t.start()
+        time.sleep(0.1)                   # the slow POST is executing
+        drainer = threading.Thread(
+            target=lambda: drain_info.update(srv.begin_drain()))
+        drainer.start()
+        time.sleep(0.1)                   # drain has begun, work in flight
+        # readyz flipped BEFORE healthz ever would (healthz never flips)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/readyz")
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read()) == {"ready": False,
+                                               "draining": True}
+        with urllib.request.urlopen(url + "/healthz") as r:
+            hz = json.loads(r.read())
+        assert hz["status"] == "healthy" and hz["draining"] is True
+        # new work is rejected with the draining busy-503
+        status, _, body = _post_status(url + "/api/deploy-apps", {"apps": []})
+        assert status == 503 and body["code"] == "E_BUSY"
+        assert "draining" in body["error"]
+        # the held request still completes
+        release.set()
+        t.join(5.0)
+        drainer.join(5.0)
+        assert inflight["out"][0] == 200
+        assert inflight["out"][2] == {"finished": True}
+        assert drain_info["drained_clean"] is True
+        [rec] = [r for r in ledger.default_ledger().records()
+                 if r["surface"] == "server:drain"]
+        assert rec["run_id"] == drain_info["ledger_run_id"]
+        assert rec["tags"]["drained_clean"] is True
+    finally:
+        ledger.configure(None)
+        httpd.shutdown()
+
+
+def test_drain_timeout_cancels_stuck_inflight():
+    """Work that outlives --drain-timeout is cancelled cooperatively: the
+    drain still converges instead of hanging shutdown forever."""
+    srv, httpd, url = _mini_server(depth=2, drain_timeout_s=0.2)
+
+    def stuck_but_cooperative(body):
+        while True:
+            lifecycle.check_current("stuck loop")
+            time.sleep(0.01)
+
+    srv.deploy_apps = stuck_but_cooperative
+    try:
+        t = threading.Thread(target=lambda: _post_status(
+            url + "/api/deploy-apps", {"apps": []}))
+        t.start()
+        time.sleep(0.1)
+        info = srv.begin_drain()
+        assert info["drained_clean"] is True   # cancellation converged it
+        t.join(5.0)
+        assert not t.is_alive()
+    finally:
+        httpd.shutdown()
+
+
+def test_capacity_endpoint_checkpoints_and_resumes(tmp_path, monkeypatch):
+    """POST /api/capacity returns a sweep_id when checkpointing is on;
+    posting again with resume replays the recorded rounds and agrees."""
+    srv, httpd, url = _mini_server(depth=2)
+    monkeypatch.setenv(lifecycle.CHECKPOINT_DIR_ENV, str(tmp_path))
+    node_spec = ("apiVersion: v1\nkind: Node\nmetadata: {name: template}\n"
+                 "status:\n  allocatable: {cpu: '8', memory: 16Gi, "
+                 "pods: '110'}\n")
+    app_yaml = """
+apiVersion: apps/v1
+kind: Deployment
+metadata: {name: a, namespace: default}
+spec:
+  replicas: 40
+  selector: {matchLabels: {app: a}}
+  template:
+    metadata: {labels: {app: a}}
+    spec:
+      containers:
+        - name: c
+          resources: {requests: {cpu: "2", memory: 2Gi}}
+"""
+    body = {"cluster": {"yaml": CLUSTER_YAML},
+            "apps": [{"name": "a", "yaml": app_yaml}],
+            "new_node": {"spec_yaml": node_spec},
+            "max_new_nodes": 16}
+    try:
+        s1, _, out1 = _post_status(url + "/api/capacity", body)
+        assert s1 == 200 and out1["sweep_id"] and out1["resumed_rounds"] == 0
+        s2, _, out2 = _post_status(
+            url + "/api/capacity", {**body, "resume": out1["sweep_id"]})
+        assert s2 == 200
+        assert out2["best_count"] == out1["best_count"]
+        assert out2["counts"] == out1["counts"]
+        assert out2["resumed_rounds"] >= 1
+        # drifted request (different max_new) -> structured 409
+        s3, _, out3 = _post_status(
+            url + "/api/capacity",
+            {**body, "max_new_nodes": 8, "resume": out1["sweep_id"]})
+        assert s3 == 409 and out3["code"] == "E_RESUME"
+        # resume only exists for bisect
+        s4, _, out4 = _post_status(
+            url + "/api/capacity",
+            {**body, "sweep_mode": "exhaustive", "resume": out1["sweep_id"]})
+        assert s4 == 400 and out4["field"] == "resume"
+    finally:
+        httpd.shutdown()

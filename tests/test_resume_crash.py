@@ -1,0 +1,123 @@
+"""Crash recovery acceptance: a capacity bisection SIGKILLed mid-sweep,
+then resumed with --resume, must produce a result digest identical to an
+uninterrupted run (ISSUE 6 acceptance criterion).
+
+The child process (`_child_main`, re-invoked via `python -c` from the
+test) wraps SweepJournal.append_round so the process SIGKILLs ITSELF the
+moment round 2 hits the disk — a real uncatchable kill between rounds,
+not an exception the interpreter can unwind."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from open_simulator_tpu.resilience import lifecycle
+
+KILL_AFTER_ROUNDS = 2
+MAX_NEW = 8
+LANES = 2
+
+
+def _snapshot():
+    """12 pods x 1500m on one 4-cpu node, bisecting up to 8 new nodes with
+    2 lanes: five bisection rounds to best_count=5 — plenty of rounds on
+    either side of the kill point. MUST build identically in the parent
+    and the child (the resume fingerprint check enforces it)."""
+    from open_simulator_tpu.core import AppResource, build_pod_sequence
+    from open_simulator_tpu.encode.snapshot import EncodeOptions, encode_cluster
+    from open_simulator_tpu.k8s.loader import ClusterResources, make_valid_node
+    from tests.conftest import make_node, make_pod
+
+    cluster = ClusterResources()
+    cluster.nodes = [make_node("real-0", cpu_m=4000, mem_mib=8192)]
+    app = ClusterResources()
+    app.pods = [make_pod(f"p{i}", cpu="1500m", mem="512Mi")
+                for i in range(12)]
+    pods = build_pod_sequence(cluster, [AppResource(name="a", resources=app)])
+    template = make_node("template", cpu_m=4000, mem_mib=8192)
+    return encode_cluster(
+        [make_valid_node(n) for n in cluster.nodes], pods,
+        EncodeOptions(max_new_nodes=MAX_NEW, new_node_template=template))
+
+
+def _run_bisect(**kw):
+    from open_simulator_tpu.engine.scheduler import make_config
+    from open_simulator_tpu.parallel.sweep import capacity_bisect
+
+    snap = _snapshot()
+    return capacity_bisect(snap, make_config(snap), MAX_NEW, lanes=LANES,
+                           **kw)
+
+
+def _child_main():
+    """Entry point for the crash subprocess: journal every round, SIGKILL
+    self right after round KILL_AFTER_ROUNDS lands on disk."""
+    real_append = lifecycle.SweepJournal.append_round
+
+    def kamikaze(self, counts, lanes):
+        real_append(self, counts, lanes)
+        if len(self.rounds) >= KILL_AFTER_ROUNDS:
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    lifecycle.SweepJournal.append_round = kamikaze
+    _run_bisect()
+    raise SystemExit("unreachable: the kill must fire mid-sweep")
+
+
+def test_sigkill_mid_sweep_then_resume_matches_uninterrupted(tmp_path):
+    from open_simulator_tpu.telemetry.ledger import plan_digest
+
+    # 1) the uninterrupted reference, no journal noise in tmp_path
+    reference = _run_bisect(checkpoint=False)
+    assert reference.best_count == 5
+
+    # 2) crash run: a fresh process that SIGKILLs itself after round 2
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           lifecycle.CHECKPOINT_DIR_ENV: str(tmp_path)}
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from tests.test_resume_crash import _child_main; _child_main()"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == -signal.SIGKILL, (
+        f"child should die by SIGKILL, got rc={proc.returncode}\n"
+        f"stdout: {proc.stdout}\nstderr: {proc.stderr}")
+
+    # 3) the journal survived the kill: header + 2 complete rounds, no
+    #    done marker — a torn run, exactly what resume is for
+    [journal_name] = [n for n in os.listdir(tmp_path)
+                      if n.endswith(lifecycle.SWEEP_JOURNAL_SUFFIX)]
+    with open(tmp_path / journal_name, encoding="utf-8") as f:
+        kinds = [json.loads(ln)["kind"] for ln in f if ln.strip()]
+    assert kinds == ["header", "round", "round"]
+
+    # 4) resume replays the two recorded rounds and finishes the rest:
+    #    identical best_count AND identical result digest
+    os.environ[lifecycle.CHECKPOINT_DIR_ENV] = str(tmp_path)
+    try:
+        resumed = _run_bisect(resume="last")
+    finally:
+        del os.environ[lifecycle.CHECKPOINT_DIR_ENV]
+    assert resumed.resumed_rounds == KILL_AFTER_ROUNDS
+    assert resumed.best_count == reference.best_count
+    assert resumed.counts == reference.counts
+    assert plan_digest(resumed)["digest"] == plan_digest(reference)["digest"]
+    # and the journal is now finished with that digest
+    done = lifecycle.SweepJournal.load(str(tmp_path), "last").done
+    assert done["best_count"] == 5
+    assert done["digest"] == plan_digest(reference)["digest"]
+
+
+def test_resume_without_checkpoint_dir_is_structured(monkeypatch):
+    monkeypatch.delenv(lifecycle.CHECKPOINT_DIR_ENV, raising=False)
+    monkeypatch.delenv("SIMON_LEDGER_DIR", raising=False)
+    from open_simulator_tpu.telemetry import ledger
+
+    ledger.configure(None)
+    with pytest.raises(lifecycle.ResumeError, match="no checkpoint "
+                                                    "directory"):
+        _run_bisect(resume="last")

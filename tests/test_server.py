@@ -363,6 +363,9 @@ def test_admission_error_body_lists_every_defect(server_url):
 
 
 def test_request_timeout_504():
+    """Past the deadline the handler answers 504 E_DEADLINE and cancels
+    the worker's token (the glacial handler here ignores it — the
+    cooperative-stop regression lives in test_lifecycle.py)."""
     srv = SimulationServer(request_timeout_s=0.05)
 
     def glacial(body):
@@ -380,7 +383,7 @@ def test_request_timeout_504():
         with pytest.raises(urllib.error.HTTPError) as ei:
             _post(url + "/api/deploy-apps", {"apps": []})
         assert ei.value.code == 504
-        assert _read_error(ei)["code"] == "E_TIMEOUT"
+        assert _read_error(ei)["code"] == "E_DEADLINE"
     finally:
         httpd.shutdown()
 

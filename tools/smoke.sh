@@ -108,6 +108,15 @@ fi
 rm -rf "$SMOKE_LEDGER"
 
 echo
+echo "== serving lifecycle (SIGTERM drain: readyz flip, 503s, in-flight finishes) =="
+make lifecycle-smoke
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: lifecycle-smoke exited $rc" >&2
+  exit "$rc"
+fi
+
+echo
 echo "== simon-tpu explain on the example cluster =="
 env JAX_PLATFORMS=cpu python -m open_simulator_tpu.cli explain \
   -f examples/config.yaml --top-k 2
