@@ -2,7 +2,8 @@
 # graftlint + the tier-1 verify command from ROADMAP.md plus one chaos
 # scenario end to end (tools/smoke.sh).
 
-.PHONY: test lint smoke bench bench-smoke bench-regress lifecycle-smoke
+.PHONY: test lint smoke bench bench-smoke bench-regress lifecycle-smoke \
+	multichip-smoke
 
 test:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -34,6 +35,16 @@ print('bench-smoke OK:', d['metric'], d['value'], d['unit'])"
 # with 503, finish the held request, and write the final ledger record
 lifecycle-smoke:
 	env JAX_PLATFORMS=cpu python tools/lifecycle_smoke.py
+
+# the 8-device gate (ROADMAP item 1): batched_schedule over a
+# (scenario x node) mesh of 8 virtual CPU devices must produce
+# BIT-IDENTICAL node assignments (ledger digest equality) to the
+# single-device run — incl. the wave-scheduled pools workload. The
+# MULTICHIP_r01-r05 rot (five rounds of a silently recorded crash)
+# cannot recur while this is in smoke.
+multichip-smoke:
+	env XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	  JAX_PLATFORMS=cpu python tools/multichip_smoke.py
 
 # regression gate over the run ledger (SIMON_LEDGER_DIR or
 # BENCH_LEDGER_DIR=... make bench-regress): the newest bench record per

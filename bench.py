@@ -24,12 +24,14 @@ import sys
 import time
 
 
-def build(n_nodes: int, n_pods: int, max_new: int, rich: bool = False):
+def build(n_nodes: int, n_pods: int, max_new: int, rich: bool = False,
+          pools: int = 0, bound: float = 0.0):
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import __graft_entry__ as ge
 
     return ge._synthetic_snapshot(
-        n_nodes=n_nodes, n_pods=n_pods, max_new=max_new, rich=rich)
+        n_nodes=n_nodes, n_pods=n_pods, max_new=max_new, rich=rich,
+        pools=pools, bound=bound)
 
 
 BENCH_SECONDS = "simon_bench_seconds"
@@ -49,10 +51,17 @@ def shape_label(nodes: int, pods: int, scenarios: int, rich: bool = False) -> st
 
 
 def run_batched(snapshot, n_scenarios: int, fail_reasons: bool = False,
-                shape: str = "", preset: str = "") -> float:
+                shape: str = "", preset: str = ""):
     """Time the capacity-sweep product path: what-if lanes run with
     fail_reasons off (the applier re-runs only the decoded lane with
     reasons on — not part of the per-lane sweep cost; parallel/sweep.py).
+
+    Returns (best_seconds, wave_stats): the wave scheduler's plan for
+    the shape (engine/waves.py; SIMON_WAVES=0 forces the pure scan) is
+    part of the measured program, and its n_waves / max_wave_width /
+    wave_fraction land in the JSON line and the per-shape ledger record
+    so `make bench-regress` history shows whether a regression is
+    engine-side or partition-side.
 
     The measured best lands in the simon_bench_seconds{shape} gauge and
     is read BACK from the registry by main() — the BENCH json line and a
@@ -65,6 +74,7 @@ def run_batched(snapshot, n_scenarios: int, fail_reasons: bool = False,
     import numpy as np
 
     from open_simulator_tpu.engine.scheduler import device_arrays, make_config, schedule_pods
+    from open_simulator_tpu.engine.waves import waves_for
     from open_simulator_tpu.parallel.sweep import active_masks_for_counts
     from open_simulator_tpu.telemetry import ledger
 
@@ -74,8 +84,13 @@ def run_batched(snapshot, n_scenarios: int, fail_reasons: bool = False,
         max_new = snapshot.n_nodes - snapshot.n_real_nodes
         counts = [min(i % (max_new + 1), max_new) for i in range(n_scenarios)]
         masks = jnp.asarray(active_masks_for_counts(snapshot, counts))
+        wave_plan = waves_for(snapshot.arrays, cfg)
+        wave_stats = (wave_plan.stats() if wave_plan is not None
+                      else {"n_waves": 0, "max_wave_width": 0,
+                            "wave_fraction": 0.0, "n_segments": 1})
 
-        fn = jax.jit(jax.vmap(lambda a: schedule_pods(arrs, a, cfg)))
+        fn = jax.jit(jax.vmap(
+            lambda a: schedule_pods(arrs, a, cfg, waves=wave_plan)))
         out = fn(masks)  # compile + warm
         jax.block_until_ready(out.node)
 
@@ -96,10 +111,15 @@ def run_batched(snapshot, n_scenarios: int, fail_reasons: bool = False,
         lcap.tag("shape", label)
         lcap.tag("lanes", n_scenarios)
         lcap.tag("seconds", round(best, 6))
+        # wave-partition provenance per shape: a bench regression with
+        # unchanged wave stats is engine-side; with changed stats it is
+        # partition-side (the plan moved)
+        for wk, wv in wave_stats.items():
+            lcap.tag(wk, wv)
         # higher-is-better throughput: the number bench_regress.py compares
         # against the trailing median of this shape's prior records
         lcap.tag("value", round(snapshot.n_pods * n_scenarios / best, 3))
-    return best
+    return best, wave_stats
 
 
 def cpu_baseline_rate(n_nodes: int, rich: bool = False):
@@ -173,6 +193,11 @@ PRESETS = {
     "northstar-rich": dict(nodes=5120, pods=51200, scenarios=64, max_new=64, rich=True),
     "gated": dict(nodes=1024, pods=2048, scenarios=256, max_new=64),
     "default": dict(nodes=1024, pods=2048, scenarios=256, max_new=64, rich=True),
+    # multi-tenant pools: per-pool nodeSelectors make consecutive pods'
+    # footprints disjoint — the workload shape the wave scheduler
+    # (engine/waves.py) batches end to end (wave_fraction 1.0); compare
+    # its scenarios/s against `sweep`-class shapes to see the wave win
+    "pools": dict(nodes=1024, pods=10240, scenarios=64, max_new=0, pools=32),
 }
 
 
@@ -213,13 +238,17 @@ def main():
             setattr(args, k, preset[k])
     rich = preset.get("rich", False)
 
-    snapshot = build(args.nodes, args.pods, args.max_new, rich=rich)
+    snapshot = build(args.nodes, args.pods, args.max_new, rich=rich,
+                     pools=preset.get("pools", 0), bound=preset.get("bound", 0.0))
     label = shape_label(args.nodes, args.pods, args.scenarios, rich)
+    if preset.get("pools"):
+        label += f"_pools{preset['pools']}"
     # run_batched sets simon_bench_seconds{shape=label} to the same value
     # it returns, so the JSON below and a /metrics scrape of this process
     # report one source of truth
-    dt = run_batched(snapshot, args.scenarios, fail_reasons=args.fail_reasons,
-                     shape=label, preset=args.preset)
+    dt, wave_stats = run_batched(snapshot, args.scenarios,
+                                 fail_reasons=args.fail_reasons,
+                                 shape=label, preset=args.preset)
     pods_per_sec = args.pods * args.scenarios / dt
     scenarios_per_sec = args.scenarios / dt
 
@@ -242,6 +271,12 @@ def main():
         "baseline": "xla_cpu_single_lane_same_engine",
         "scenarios_per_sec": round(scenarios_per_sec, 2),
         "preset": args.preset,
+        # wave-scheduling partition stats for the timed shape
+        # (engine/waves.py): 0/0/0.0 = pure scan (nothing provably
+        # independent); a regression with unchanged stats is engine-side
+        "n_waves": wave_stats["n_waves"],
+        "max_wave_width": wave_stats["max_wave_width"],
+        "wave_fraction": wave_stats["wave_fraction"],
     }
     if baseline_error:
         # vs_baseline 0.0 with this key present means the baseline CRASHED
@@ -257,9 +292,9 @@ def main():
         ns = PRESETS["northstar"]
         ns_snap = build(ns["nodes"], ns["pods"], ns["max_new"])
         ns_label = shape_label(ns["nodes"], ns["pods"], ns["scenarios"])
-        ns_dt = run_batched(ns_snap, ns["scenarios"],
-                            fail_reasons=args.fail_reasons, shape=ns_label,
-                            preset="northstar")
+        ns_dt, _ = run_batched(ns_snap, ns["scenarios"],
+                               fail_reasons=args.fail_reasons, shape=ns_label,
+                               preset="northstar")
         out["northstar_scenarios_per_sec_per_chip"] = round(ns["scenarios"] / ns_dt, 1)
         out["northstar_shape"] = f"{ns['nodes']}n_x{ns['pods']}p_x{ns['scenarios']}s"
         # wide = the SAME snapshot at more lanes (assert the preset table
@@ -268,9 +303,9 @@ def main():
         assert all(wide[k] == ns[k] for k in ("nodes", "pods", "max_new")), (
             "northstar-wide must differ from northstar only in lane count")
         wide_label = shape_label(wide["nodes"], wide["pods"], wide["scenarios"])
-        wide_dt = run_batched(ns_snap, wide["scenarios"],
-                              fail_reasons=args.fail_reasons, shape=wide_label,
-                              preset="northstar-wide")
+        wide_dt, _ = run_batched(ns_snap, wide["scenarios"],
+                                 fail_reasons=args.fail_reasons,
+                                 shape=wide_label, preset="northstar-wide")
         out["northstar_wide_scenarios_per_sec_per_chip"] = round(
             wide["scenarios"] / wide_dt, 1)
         out["northstar_wide_lanes"] = wide["scenarios"]
@@ -281,11 +316,25 @@ def main():
             "northstar-rich must differ from northstar only in workload")
         nr_snap = build(nr["nodes"], nr["pods"], nr["max_new"], rich=True)
         nr_label = shape_label(nr["nodes"], nr["pods"], nr["scenarios"], rich=True)
-        nr_dt = run_batched(nr_snap, nr["scenarios"],
-                            fail_reasons=args.fail_reasons, shape=nr_label,
-                            preset="northstar-rich")
+        nr_dt, _ = run_batched(nr_snap, nr["scenarios"],
+                               fail_reasons=args.fail_reasons, shape=nr_label,
+                               preset="northstar-rich")
         out["northstar_rich_scenarios_per_sec_per_chip"] = round(
             nr["scenarios"] / nr_dt, 2)
+        # the wave-showcase shape: multi-tenant pools whose disjoint
+        # footprints the wave scheduler batches (wave_fraction 1.0) —
+        # NEW in round 7, recorded alongside the north-star series
+        pl = PRESETS["pools"]
+        pl_snap = build(pl["nodes"], pl["pods"], pl["max_new"],
+                        pools=pl["pools"])
+        pl_label = (shape_label(pl["nodes"], pl["pods"], pl["scenarios"])
+                    + f"_pools{pl['pools']}")
+        pl_dt, pl_stats = run_batched(pl_snap, pl["scenarios"],
+                                      fail_reasons=args.fail_reasons,
+                                      shape=pl_label, preset="pools")
+        out["pools_scenarios_per_sec_per_chip"] = round(
+            pl["scenarios"] / pl_dt, 2)
+        out["pools_wave_stats"] = pl_stats
     print(json.dumps(out))
 
 

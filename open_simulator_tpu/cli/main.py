@@ -76,6 +76,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "fingerprint is verified, and the final result is "
                          "identical to an uninterrupted run (bisect mode "
                          "only)")
+    ap.add_argument("--no-waves", action="store_true",
+                    help="disable wave scheduling (engine/waves.py): run "
+                         "the pure sequential scan; equivalent to "
+                         "SIMON_WAVES=0 (results are bit-identical either "
+                         "way — this is a perf/debug switch)")
 
     ex = sub.add_parser(
         "explain",
@@ -102,6 +107,9 @@ def build_parser() -> argparse.ArgumentParser:
     ex.add_argument("--json", action="store_true",
                     help="emit the report as JSON")
     ex.add_argument("--output-file", default="")
+    ex.add_argument("--no-waves", action="store_true",
+                    help="disable wave scheduling for this run "
+                         "(SIMON_WAVES=0 equivalent)")
     ex.add_argument("--trace-out", default="",
                     help="write a Chrome-trace JSON timeline of this run's "
                          "phases (open in chrome://tracing or Perfetto)")
@@ -124,6 +132,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--explain-topk", type=int, default=3,
                     help="candidate nodes recorded per pod during serving "
                          "simulations for GET /api/explain (0 disables)")
+    sp.add_argument("--no-waves", action="store_true",
+                    help="disable wave scheduling for all serving "
+                         "simulations (SIMON_WAVES=0 equivalent)")
     sp.add_argument(
         "--compile-cache-dir", default="",
         help="opt-in jax persistent compilation cache directory: a "
@@ -162,6 +173,9 @@ def build_parser() -> argparse.ArgumentParser:
     ch.add_argument("--drain-node", action=_FaultAction, fault_kind="drain_node",
                     dest="events", metavar="NAME",
                     help="drain this node (repeatable)")
+    ch.add_argument("--no-waves", action="store_true",
+                    help="disable wave scheduling for the chaos re-scans "
+                         "(SIMON_WAVES=0 equivalent)")
     ch.add_argument("--zone-key", default="topology.kubernetes.io/zone",
                     help="node label key that defines zones")
     ch.add_argument("--json", action="store_true", help="emit the report as JSON")
@@ -311,6 +325,14 @@ def main(argv=None) -> int:
     _init_logging()
     parser = build_parser()
     args = parser.parse_args(argv)
+
+    if getattr(args, "no_waves", False):
+        # one lever end to end: make_config folds SIMON_WAVES into
+        # EngineConfig.wave_scheduling, so every entry point this process
+        # runs (apply, server routes, chaos, explain) sees the switch
+        from open_simulator_tpu.engine.waves import WAVES_ENV
+
+        os.environ[WAVES_ENV] = "0"
 
     if getattr(args, "ledger_dir", ""):
         # flight recorder: stdlib-only configuration, safe before jax loads

@@ -79,6 +79,12 @@ class SimulateResult:
     # keys of pods deleted as preemption victims (structured marker —
     # explain must not infer this from the reason string's wording)
     preempted_pod_keys: List[str] = field(default_factory=list)
+    # wave-scheduling decode (engine/waves.py): per-pod wave id in
+    # sequence order and whether the pod was placed through a batched
+    # wave or the fallback scan; None when the run had no wave plan
+    # (waves off, preemption columns, or nothing provably independent)
+    wave_id: Optional[np.ndarray] = field(default=None, repr=False)
+    wave_batched: Optional[np.ndarray] = field(default=None, repr=False)
 
     def placements(self) -> Dict[str, str]:
         return {sp.pod.key: sp.node_name for sp in self.scheduled_pods}
@@ -319,6 +325,12 @@ def simulate(
             # ONE shape to XLA, so consecutive simulate() calls on slightly
             # different clusters reuse the compiled scan (exec_cache.py)
             arrs, _, n_pods = exec_cache.bucketed_device_arrays(snapshot.arrays)
+        # wave plan: provably carry-independent pod runs execute batched
+        # (engine/waves.py); None leaves the compiled scan untouched
+        from open_simulator_tpu.engine.waves import waves_for
+
+        wave_plan = waves_for(snapshot.arrays, cfg,
+                              n_pods_total=int(arrs.req.shape[0]))
         lcap.set_config(cfg, snapshot=snapshot, arrs=arrs)
         active_np = np.asarray(snapshot.arrays.active)
         preempted_by: Optional[Dict[int, int]] = None
@@ -332,21 +344,27 @@ def simulate(
 
                 def schedule_fn(disabled, nominated):
                     # victim/nomination columns are built against the real
-                    # pod axis; pad to the bucket, slice the outputs back
+                    # pod axis; pad to the bucket, slice the outputs back.
+                    # Waves only on the column-free first pass: passing the
+                    # (ignored) plan alongside preemption columns would key
+                    # a second executable for the identical program.
                     return exec_cache.unpad_output(
                         schedule_pods(
                             arrs, arrs.active, cfg,
                             disabled=exec_cache.pad_vector(
                                 disabled, arrs.req.shape[0], False),
                             nominated=exec_cache.pad_vector(
-                                nominated, arrs.req.shape[0], -1)),
+                                nominated, arrs.req.shape[0], -1),
+                            waves=(wave_plan if disabled is None
+                                   and nominated is None else None)),
                         n_pods)
 
                 out, pre = run_with_preemption(snapshot, active_np, schedule_fn, pdbs)
                 preempted_by = pre.preempted_by
             else:
                 out = exec_cache.unpad_output(
-                    schedule_pods(arrs, arrs.active, cfg), n_pods)
+                    schedule_pods(arrs, arrs.active, cfg, waves=wave_plan),
+                    n_pods)
             node_assign = np.asarray(out.node)  # blocks on device completion
             fail_counts = np.asarray(out.fail_counts)
         gpu_pick = np.asarray(out.gpu_pick) if cfg.enable_gpu else None
@@ -359,6 +377,12 @@ def simulate(
                 extra_op_names=list(cfg.extension_op_names),
                 **explain_decode_kwargs(cfg, out),
             )
+            if wave_plan is not None and not preempted_by:
+                # per-pod wave decode for the explain surface (preempted
+                # reruns fall back to the scan, so no plan applies there)
+                wid, wbat = wave_plan.pod_waves()
+                result.wave_id = wid[:n_pods]
+                result.wave_batched = wbat[:n_pods]
         lcap.set_result(result)
     _record_simulation(telemetry, result)
     return result

@@ -331,7 +331,7 @@ def _zeros_carry_batch(arrs, cfg, lanes: int):
 
 
 def run_batched_cached(arrs, masks, cfg, carry=None,
-                       fn_name: str = "batched_schedule"):
+                       fn_name: str = "batched_schedule", waves=None):
     """Run the vmapped scan over scenario lanes through the AOT cache.
 
     `masks` is the [S, N] per-lane active matrix. `carry` is an optional
@@ -339,7 +339,10 @@ def run_batched_cached(arrs, masks, cfg, carry=None,
     reset to the init values on device and reused for this round's carry
     — after the call the passed-in state is DEAD. With carry=None a fresh
     zeros batch is allocated (and still donated, so the executable is the
-    same either way)."""
+    same either way). `waves` is an optional static WavePlan
+    (engine/waves.py): it joins the cache key — wave count/width are part
+    of the compiled program — so same-plan reruns stay zero-recompile
+    and a plan change never aliases a stale executable."""
     import jax
     import jax.numpy as jnp
 
@@ -350,7 +353,7 @@ def run_batched_cached(arrs, masks, cfg, carry=None,
     if carry is None:
         carry = _zeros_carry_batch(arrs, cfg, lanes)
     key = (fn_name, cfg, _shape_sig(arrs), (lanes,) + tuple(masks.shape[1:]),
-           str(masks.dtype),
+           str(masks.dtype), waves,
            tuple(str(d) for d in jax.devices()))
 
     def build():
@@ -358,7 +361,7 @@ def run_batched_cached(arrs, masks, cfg, carry=None,
             def lane(mask_row, carry_row):
                 return schedule_pods(a, mask_row, cfg,
                                      state=_fresh_lane_state(carry_row, a),
-                                     state_is_fresh=True)
+                                     state_is_fresh=True, waves=waves)
 
             return jax.vmap(lane)(m, c)
 

@@ -151,6 +151,15 @@ class EngineConfig(NamedTuple):
     # or a re-run CLI skips cold compiles. Not read inside the trace —
     # it configures the jax runtime, once, on the host.
     compile_cache_dir: str = ""
+    # Wave scheduling (engine/waves.py): entry points partition the pod
+    # sequence into carry-independent waves and hand schedule_pods a
+    # static WavePlan; provably-independent runs execute as one batched
+    # filter+score + one carry merge instead of one scan step per pod.
+    # Results are bit-identical to scan order (the planner only batches
+    # what it can prove). Default on; SIMON_WAVES=0 is the process-wide
+    # escape hatch (make_config folds it in here so the ledger
+    # fingerprint records which mode ran).
+    wave_scheduling: bool = True
 
     @property
     def enable_spread(self) -> bool:
@@ -366,6 +375,205 @@ def _apply_prefix_chunk(arrs: SnapshotArrays, cfg: EngineConfig,
     return SimState(headroom, gc, term, pref, ports, state.gpu_used,
                     state.vg_used, state.sdev_taken, dom, state.pv_taken,
                     vol_cnt, state.svol_on_node)
+
+
+# ---- wave execution -----------------------------------------------------
+# engine/waves.py proves which contiguous pod runs are carry-independent;
+# the helpers below execute its plan: a batched filter+score (the vmapped
+# _step, whose unused per-pod carry outputs XLA dead-codes away) plus ONE
+# vectorized carry merge per wave. Exactness mirrors apply_forced_prefix:
+# count carries add 0/1 increments, requests are integer-valued in their
+# encoded units, and matmuls run at Precision.HIGHEST, so the segment-sum
+# is bit-identical to the sequential adds.
+
+_WAVE_CHUNK = 512  # bounds the [chunk, N] filter+score tensors per wave
+
+
+def _scan_xs(step, state, xs, unroll):
+    """lax.scan over an opaque xs dict (segment slices built by the wave
+    runner; the GL1 xs-leaf contract is enforced at the schedule_pods
+    site where the dict is constructed)."""
+    return jax.lax.scan(step, state, xs, unroll=unroll)
+
+
+def _dense_slot_rows(idx: jnp.ndarray, width: int) -> jnp.ndarray:
+    """[c, K] slot indices (-1 padded) -> [c, width] f32 0/1 rows (each
+    column is set at most once per pod, so the sum is exact)."""
+    c = idx.shape[0]
+    out = jnp.zeros((c, width), jnp.float32)
+    for m in range(idx.shape[1]):
+        col = idx[:, m]
+        out = out + (jax.nn.one_hot(jnp.maximum(col, 0), width,
+                                    dtype=jnp.float32)
+                     * (col >= 0).astype(jnp.float32)[:, None])
+    return out
+
+
+def _wave_merge(arrs: SnapshotArrays, cfg: EngineConfig, state: SimState,
+                x: Dict[str, jnp.ndarray], nodes: jnp.ndarray,
+                gpu_pick) -> SimState:
+    """Fold one wave's carry contributions into the state with batched
+    scatters — exactly what the wave's scan steps would write, in one
+    shot. `nodes` may hold negatives (unbound / sentinel pods): their
+    one-hot rows are zero, so they contribute nothing, matching the
+    masked bind. Pods with open-local storage / WaitForFirstConsumer /
+    shared-volume claims are never admitted to merged waves (their picks
+    are order-dependent state the merge does not carry) — the planner
+    guarantees their absence."""
+    f32 = jnp.float32
+    hp = jax.lax.Precision.HIGHEST
+    idx = nodes.astype(jnp.int32)                          # [c]
+    safe = jnp.maximum(idx, 0)
+    boundf = (idx >= 0).astype(f32)                        # [c]
+    oh = jax.nn.one_hot(idx, arrs.alloc.shape[0], dtype=f32)  # [c, N]
+    headroom = state.headroom - jnp.matmul(oh.T, x["req"], precision=hp)
+    if cfg.needs_group_count or cfg.maintain_dom_count:
+        s_n = state.group_count.shape[1]
+        match = (_dense_slot_rows(x["match_gid"], s_n) if cfg.slot_paint
+                 else x["match_groups"].astype(f32))       # [c, S]
+    gc = state.group_count
+    if cfg.needs_group_count:
+        gc = (gc + jnp.matmul(oh.T, match, precision=hp).astype(gc.dtype))
+    dom = state.dom_count
+    if cfg.maintain_dom_count:
+        topo_sel = (jnp.take(arrs.topo_onehot, safe, axis=1)
+                    * boundf[None, :, None])               # [K1, c, D]
+        dom = dom + jnp.einsum("akd,ks->ads", topo_sel, match, precision=hp)
+    ports = state.ports_used
+    if cfg.enable_ports:
+        ports = ports | (
+            jnp.matmul(oh.T, x["ports"].astype(f32), precision=hp) > 0)
+    vol_cnt = state.vol_cnt
+    if cfg.enable_vol_limits:
+        # static demand only: shared-volume pods (dynamic dedup demand)
+        # are excluded from merged waves by the planner
+        vol_cnt = vol_cnt + jnp.matmul(oh.T, x["vol_limit_req"], precision=hp)
+    term = state.term_block
+    pref = state.pref_paint
+    if cfg.enable_anti_affinity or cfg.enable_pref:
+        # sd_all[key][pod, node]: nodes sharing pod i's bound node's
+        # domain (zero rows for unbound pods)
+        k1 = arrs.topo_onehot.shape[0]
+        sd_all = [oh]  # hostname
+        for kk in range(k1):
+            sd_all.append(jnp.matmul(
+                jnp.take(arrs.topo_onehot[kk], safe, axis=0)
+                * boundf[:, None],
+                arrs.topo_onehot[kk].T, precision=hp))     # [c, N]
+    if cfg.enable_anti_affinity:
+        t_n = state.term_block.shape[1]
+        own = (_dense_slot_rows(x["own_tid"], t_n) if cfg.slot_paint
+               else x["own_terms"].astype(f32))            # [c, T]
+        paint = jnp.zeros((state.headroom.shape[0], t_n), f32)
+        for kk in range(len(sd_all)):                      # K is tiny
+            mask_t = (arrs.term_key == kk).astype(f32)     # [T]
+            paint = paint + jnp.matmul(
+                sd_all[kk].T, own * mask_t[None, :], precision=hp)
+        term = term + paint.astype(term.dtype)
+    if cfg.enable_pref:
+        t2_n = state.pref_paint.shape[1]
+        for a in range(x["pref_group"].shape[1]):          # Ap is tiny
+            w = (x["pref_weight"][:, a]
+                 * x["pref_valid"][:, a].astype(f32))      # [c]
+            key_a = x["pref_key"][:, a]                    # [c]
+            sd_a = jnp.zeros_like(sd_all[0])               # [c, N]
+            for kk in range(len(sd_all)):
+                sd_a = sd_a + sd_all[kk] * (key_a == kk).astype(f32)[:, None]
+            col = jax.nn.one_hot(x["pref_tid"][:, a], t2_n, dtype=f32)
+            pref = pref + jnp.matmul(
+                sd_a.T, col * w[:, None], precision=hp)
+    gpu_used = state.gpu_used
+    if cfg.enable_gpu and gpu_pick is not None:
+        gpu_used = gpu_used + jnp.matmul(
+            oh.T, gpu_pick.astype(f32) * x["gpu_mem"][:, None], precision=hp)
+    return SimState(headroom, gc, term, pref, ports, gpu_used,
+                    state.vg_used, state.sdev_taken, dom, state.pv_taken,
+                    vol_cnt, state.svol_on_node)
+
+
+def _const_outputs(arrs: SnapshotArrays, cfg: EngineConfig,
+                   x: Dict[str, jnp.ndarray], c: int):
+    """The predetermined per-pod outputs of a forced/sentinel segment —
+    exactly what the scan emits for these pods (forced-bind fast path /
+    bind-nothing sentinel), in the full output contract's shapes. The
+    planner only emits merged forced segments when failure accounting,
+    explain recording, and GPU/storage/volume picks are all off for the
+    members, so every diagnostic column is its neutral constant (the
+    same convention the forced-prefix hoist established)."""
+    forced = x["forced_node"].astype(jnp.int32)
+    nodes = jnp.where(forced >= 0, forced, -1)
+    fail_w = cfg.n_ops if cfg.fail_reasons else 0
+    g_w = arrs.gpu_slot.shape[1] if cfg.enable_gpu else 0
+    v_w = arrs.wfc_ccid.shape[1] if cfg.enable_pv_match else 0
+    k_top = min(cfg.explain_topk, arrs.alloc.shape[0]) if cfg.explain_topk else 0
+    c_parts = len(score_part_names(cfg)) if cfg.explain_topk else 0
+    return (nodes,
+            jnp.zeros((c, fail_w) if fail_w else (c, 0), jnp.int32),
+            jnp.zeros((c,), jnp.int32),
+            jnp.zeros((c, g_w), jnp.int32),
+            jnp.full((c, v_w), -1, jnp.int32),
+            jnp.full((c, k_top), -1, jnp.int32),
+            jnp.zeros((c, k_top), jnp.float32),
+            jnp.zeros((c, c_parts, k_top), jnp.float32))
+
+
+def _grid_step(arrs, active, cfg, hoisted, inv_alloc, gcr_seg, state, xw):
+    """One macro-step of a GRID segment: batched filter+score for the
+    whole wave against the wave-start carry, then one merged bind."""
+    step = functools.partial(_step, arrs, active, cfg, hoisted, inv_alloc,
+                             gcr_seg)
+    ys = jax.vmap(lambda xx: step(state, xx)[1])(xw)
+    new_state = _wave_merge(arrs, cfg, state, xw, ys[0],
+                            ys[3] if cfg.enable_gpu else None)
+    return new_state, ys
+
+
+def _run_wave_plan(arrs, active, cfg, hoisted, inv_alloc, gcr_seg, state,
+                   xs, waves, k):
+    """Execute a WavePlan: scan segments ride the unchanged sequential
+    step; batched segments evaluate their pods against the wave-start
+    state (provably equal to scan order) and merge their claims once."""
+    from open_simulator_tpu.engine import waves as wave_mod
+
+    step = functools.partial(_step, arrs, active, cfg, hoisted, inv_alloc,
+                             gcr_seg)
+    outs = []
+    for lo, hi, kind, w in waves.segments:
+        a0, a1 = lo - k, hi - k
+        xseg = {name: v[a0:a1] for name, v in xs.items()}
+        c = a1 - a0
+        if kind == wave_mod.SCAN:
+            state, ys = _scan_xs(step, state, xseg, cfg.scan_unroll)
+            outs.append(ys)
+        elif kind == wave_mod.SENTINEL:
+            outs.append(_const_outputs(arrs, cfg, xseg, c))
+        elif kind == wave_mod.FORCED:
+            outs.append(_const_outputs(arrs, cfg, xseg, c))
+            for s0 in range(0, c, _PREFIX_CHUNK):
+                sub = {name: v[s0:min(s0 + _PREFIX_CHUNK, c)]
+                       for name, v in xseg.items()}
+                state = _wave_merge(arrs, cfg, state, sub,
+                                    sub["forced_node"], None)
+        elif kind == wave_mod.GRID:
+            gstep = functools.partial(_grid_step, arrs, active, cfg,
+                                      hoisted, inv_alloc, gcr_seg)
+            xg = {name: v.reshape((c // w, w) + v.shape[1:])
+                  for name, v in xseg.items()}
+            state, ysg = _scan_xs(gstep, state, xg, 1)
+            outs.append(jax.tree_util.tree_map(
+                lambda t: t.reshape((c,) + t.shape[2:]), ysg))
+        else:  # BATCH: one wave, chunked to bound the [chunk, N] tensors
+            for s0 in range(0, c, _WAVE_CHUNK):
+                sub = {name: v[s0:min(s0 + _WAVE_CHUNK, c)]
+                       for name, v in xseg.items()}
+                frozen = state
+                ys = jax.vmap(lambda xx: step(frozen, xx)[1])(sub)
+                state = _wave_merge(arrs, cfg, state, sub, ys[0],
+                                    ys[3] if cfg.enable_gpu else None)
+                outs.append(ys)
+    merged = jax.tree_util.tree_map(
+        lambda *ts: jnp.concatenate(ts, axis=0), *outs)
+    return state, merged
 
 
 def _pod_xs(arrs: SnapshotArrays) -> Dict[str, jnp.ndarray]:
@@ -953,7 +1161,14 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         topk_score, topk_node = jax.lax.top_k(masked_score, k_top)
         topk_node = topk_node.astype(jnp.int32)
         if part_rows:
-            topk_parts = jnp.take(jnp.stack(part_rows), topk_node, axis=1)
+            # filler slots (fewer feasible nodes than k) must not leak
+            # state-dependent part values gathered at infeasible nodes:
+            # decode drops them anyway, and wave-batched steps evaluate
+            # against the wave-start carry — zeroing keeps the recorded
+            # tensors bit-identical between the scan and wave engines
+            topk_parts = jnp.where(
+                (topk_score > neg_inf)[None, :],
+                jnp.take(jnp.stack(part_rows), topk_node, axis=1), 0.0)
         else:
             topk_parts = jnp.zeros((0, k_top), f32)
     else:
@@ -1141,7 +1356,8 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
                        topk_node, topk_score, topk_parts)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "state_is_fresh"),
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "state_is_fresh", "waves"),
                    donate_argnames=("state",))
 def schedule_pods(
     arrs: SnapshotArrays,
@@ -1151,6 +1367,7 @@ def schedule_pods(
     disabled: jnp.ndarray | None = None,
     nominated: jnp.ndarray | None = None,
     state_is_fresh: bool = False,
+    waves=None,
 ) -> ScheduleOutput:
     """Scan the pod sequence, return assignments + reason counts + final state.
 
@@ -1163,16 +1380,34 @@ def schedule_pods(
     transient device copy is consumed). `state_is_fresh=True` declares a
     caller-built pristine init state (the exec-cache donation path), which
     keeps the forced-bind prefix hoisting that a resumed state must skip.
+
+    `waves` is an optional static engine.waves.WavePlan (computed by
+    waves_for over THIS arrs + cfg): provably carry-independent pod runs
+    execute as batched waves, bit-identical to scan order. The plan is
+    dropped (full scan) whenever its exactness preconditions fail:
+    preemption columns present, extension ops registered, or a resumed
+    (non-fresh) state whose prefix bookkeeping the plan cannot see.
     """
     n_pods = arrs.req.shape[0]
+    if waves is not None and (
+            disabled is not None or nominated is not None or cfg.extensions
+            or (state is not None and not state_is_fresh)
+            or waves.n_pods != n_pods or not waves.segments):
+        waves = None
     # forced-bind prefix hoisting: only from a fresh state with no
     # preemption columns (victim/nomination indices cover the full
     # sequence; resumed states already contain their prefix — a donated
-    # state flagged fresh is an init state and hoists like None)
-    k = min(cfg.forced_prefix, n_pods)
-    if k and ((state is not None and not state_is_fresh)
-              or disabled is not None or nominated is not None):
-        k = 0
+    # state flagged fresh is an init state and hoists like None). With a
+    # wave plan the plan's own `start` governs: zero when the plan's
+    # forced segments subsume the hoist, the hoist prefix when failure
+    # accounting needs its zero-diagnostics convention preserved.
+    if waves is not None:
+        k = min(waves.start, n_pods)
+    else:
+        k = min(cfg.forced_prefix, n_pods)
+        if k and ((state is not None and not state_is_fresh)
+                  or disabled is not None or nominated is not None):
+            k = 0
     if state is None:
         state = init_state(arrs, cfg)
     if k:
@@ -1227,10 +1462,16 @@ def schedule_pods(
              jnp.asarray(scan_arrs.spread_key, jnp.int32)], axis=1)
     step = functools.partial(_step, scan_arrs, active, cfg, hoisted, inv_alloc,
                              gcr_seg)
-    final_state, (nodes, fail_counts, feasible, gpu_pick, vol_pick,
-                  topk_node, topk_score, topk_parts) = jax.lax.scan(
-        step, state, xs, unroll=cfg.scan_unroll
-    )
+    if waves is None:
+        final_state, (nodes, fail_counts, feasible, gpu_pick, vol_pick,
+                      topk_node, topk_score, topk_parts) = jax.lax.scan(
+            step, state, xs, unroll=cfg.scan_unroll
+        )
+    else:
+        final_state, (nodes, fail_counts, feasible, gpu_pick, vol_pick,
+                      topk_node, topk_score, topk_parts) = _run_wave_plan(
+            scan_arrs, active, cfg, hoisted, inv_alloc, gcr_seg, state, xs,
+            waves, k)
     if k:
         # prepend the prefix's (predetermined) outputs
         nodes = jnp.concatenate([arrs.forced_node[:k].astype(jnp.int32), nodes])
@@ -1311,10 +1552,15 @@ def make_config(snapshot: ClusterSnapshot, **overrides) -> EngineConfig:
     enable_storage = bool(
         np.any(snapshot.arrays.vg_cap > 0) or np.any(snapshot.arrays.sdev_cap > 0)
     )
+    from open_simulator_tpu.engine.waves import waves_enabled
+
     a = snapshot.arrays
     kw: Dict[str, Any] = dict(
         n_resources=len(res), cpu_mem_idx=cpu_mem, enable_gpu=enable_gpu,
         enable_storage=enable_storage,
+        # SIMON_WAVES=0 escape hatch folded into the config so the run
+        # fingerprint records which engine mode answered
+        wave_scheduling=waves_enabled(),
         compact_carry=max_per_node < 255,
         # feature gates: compile out ops whose inputs are empty across the
         # whole pod sequence (results identical; see EngineConfig docs)

@@ -47,7 +47,15 @@ def _with_run_record(fn):
     (or POST /api/capacity, which names the surface via
     ledger.surface_override) writes one "sweep" RunRecord with the config
     fingerprint and the plan digest; under an already-active capture (the
-    applier's) this is a silent no-op — one record per run."""
+    applier's) this is a silent no-op — one record per run.
+
+    Disabled path contract (tested by test_waves.py): when no ledger is
+    configured (SIMON_LEDGER_DIR unset, no --ledger-dir), the wrapper
+    costs exactly `run_capture`'s enabled-check — one dict lookup plus an
+    env read — and NO fingerprint or digest hashing happens: the
+    `cap.recording` guard below keeps `set_config`/`set_plan` (which
+    hash the whole snapshot and every lane's assignments) off the
+    disabled and nested paths entirely."""
 
     @functools.wraps(fn)
     def wrapper(snapshot, cfg, *args, **kwargs):
@@ -55,8 +63,9 @@ def _with_run_record(fn):
 
         with ledger.run_capture("sweep") as cap:
             plan = fn(snapshot, cfg, *args, **kwargs)
-            cap.set_config(cfg, snapshot=snapshot)
-            cap.set_plan(plan)
+            if cap.recording:
+                cap.set_config(cfg, snapshot=snapshot)
+                cap.set_plan(plan)
             return plan
 
     return wrapper
@@ -127,6 +136,7 @@ def batched_schedule(
     cfg: EngineConfig,
     mesh: Optional[Mesh] = None,
     carry: Optional[object] = None,
+    waves=None,
 ) -> ScheduleOutput:
     """vmap the scan over scenario lanes; shard lanes over the mesh.
 
@@ -142,12 +152,19 @@ def batched_schedule(
     `carry` is an optional DONATED state batch (a previous round's
     `out.state`, dead after this call) whose buffers back this run's
     carry — only the AOT path supports it.
+
+    `waves` is an optional static engine.waves.WavePlan for THIS arrs +
+    cfg (lane activation does not enter the plan — footprints are
+    computed activation-agnostic, so one plan serves every lane). Both
+    the AOT path (plan in the cache key) and the mesh-sharded path
+    (plan closed over the jitted lane fn) honor it.
     """
     if mesh is None or mesh.empty:
-        return run_batched_cached(arrs, active_batch, cfg, carry=carry)
+        return run_batched_cached(arrs, active_batch, cfg, carry=carry,
+                                  waves=waves)
     if carry is not None:
         raise ValueError("carry donation requires mesh=None (the AOT path)")
-    fn = jax.vmap(lambda a: schedule_pods(arrs, a, cfg))
+    fn = jax.vmap(lambda a: schedule_pods(arrs, a, cfg, waves=waves))
     lane = NamedSharding(mesh, P("scenario"))
     fn = jax.jit(
         fn,
@@ -307,10 +324,15 @@ def capacity_sweep(
     masks = _padded_lane_masks(
         active_masks_for_counts(snapshot, counts), arrs.alloc.shape[0])
     sweep_cfg = cfg if fail_reasons else cfg._replace(fail_reasons=False)
+    from open_simulator_tpu.engine.waves import waves_for
+
+    wave_plan = waves_for(snapshot.arrays, sweep_cfg,
+                          n_pods_total=int(arrs.req.shape[0]))
     with span("sweep", lanes=len(counts)):
         nodes, fail, headroom, vg_used_arr, gpu, vol, trial_errors, _ = (
             _execute_sweep(arrs, masks, sweep_cfg, mesh, fail_reasons,
-                           retries, backoff_s, isolate_trials, n_pods=n_pods))
+                           retries, backoff_s, isolate_trials, n_pods=n_pods,
+                           waves=wave_plan))
     alloc = np.asarray(arrs.alloc)             # [N, R]
     cpu_i = snapshot.resources.index("cpu")
     mem_i = snapshot.resources.index("memory")
@@ -460,6 +482,10 @@ def capacity_bisect(
     has_storage = bool(np.any(vg_cap > 0))
     sweep_cfg = cfg._replace(fail_reasons=False)
     lanes = max(1, min(lanes, max_new + 1))
+    from open_simulator_tpu.engine.waves import waves_for
+
+    wave_plan = waves_for(snapshot.arrays, sweep_cfg,
+                          n_pods_total=int(arrs.req.shape[0]))
 
     # ---- checkpoint journal (create fresh, or load + verify on resume);
     # the fingerprint hashes every snapshot content field, so it is only
@@ -514,7 +540,7 @@ def capacity_bisect(
                 arrs, masks, sweep_cfg, mesh, False, retries, backoff_s,
                 isolate_trials, n_pods=n_pods,
                 carry=carry_holder["carry"] if mesh is None else None,
-                return_state=mesh is None)
+                return_state=mesh is None, waves=wave_plan)
         carry_holder["carry"] = state
         fresh: Dict[int, dict] = {}
         for i, c in enumerate(cs):
@@ -586,7 +612,7 @@ def _record_lane_error(trial_errors: Dict[int, str], si: int, msg: str) -> None:
 
 def _execute_sweep(arrs, masks, sweep_cfg, mesh, fail_reasons,
                    retries, backoff_s, isolate_trials, n_pods=None,
-                   carry=None, return_state=False):
+                   carry=None, return_state=False, waves=None):
     """Run the batched sweep with retry; fall back to isolated per-lane
     runs when the batch keeps failing. Returns host numpy
     (nodes, fail, headroom, vg_used, gpu_pick, vol_pick, trial_errors,
@@ -636,6 +662,8 @@ def _execute_sweep(arrs, masks, sweep_cfg, mesh, fail_reasons,
         c = carry_once.pop("carry", None)
         if c is not None:
             kw["carry"] = c
+        if waves is not None:
+            kw["waves"] = waves
         return batched_schedule(arrs, jnp.asarray(masks), sweep_cfg,
                                 mesh=mesh, **kw)
 
@@ -669,7 +697,9 @@ def _execute_sweep(arrs, masks, sweep_cfg, mesh, fail_reasons,
             t0 = _time.perf_counter()
             out_i = run_with_retries(
                 lambda: batched_schedule(arrs, jnp.asarray(masks[si:si + 1]),
-                                         sweep_cfg, mesh=None),
+                                         sweep_cfg, mesh=None,
+                                         **({"waves": waves}
+                                            if waves is not None else {})),
                 retries=retries, backoff_s=backoff_s)
             nodes_i, fail_i, hr_i, vg_i, gpu_i, vol_i = host(out_i)
             trial_seconds.labels(mode="isolated").observe(

@@ -254,10 +254,18 @@ def _run_chaos_inner(
                             "pods evicted by fault events",
                             labelnames=("outcome",))
 
+    # wave plan for the BASELINE scan only: event re-scans rewrite the
+    # forced column (un-pinning pods on dead nodes), which invalidates
+    # the plan — and a fresh plan per event would trace a fresh
+    # executable per event, defeating the shared-bucket compile
+    from open_simulator_tpu.engine.waves import waves_for
+
+    wave_plan = waves_for(snapshot.arrays, cfg, n_pods_total=n_pods_pad)
+
     with span("chaos.baseline"):
         out0 = schedule_pods(
             arrs, jnp.asarray(exec_cache.pad_vector(active, n_nodes_pad, False)),
-            cfg)
+            cfg, waves=wave_plan)
         assign = np.asarray(out0.node)[:n_pods_real]
     report = DisruptionReport(
         total_pods=snapshot.n_pods,

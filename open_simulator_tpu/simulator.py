@@ -129,6 +129,13 @@ class Simulator:
             # by a few rows, which used to recompile the whole scan; inside
             # one bucket every incremental re-run reuses the executable
             arrs, _, n_pods = exec_cache.bucketed_device_arrays(snapshot.arrays)
+        from open_simulator_tpu.engine.waves import waves_for
+
+        # session re-runs under preemption always pass the carried
+        # victim/nomination columns, which preclude waves — don't even
+        # run the analysis there
+        wave_plan = (None if self.preemption else waves_for(
+            snapshot.arrays, cfg, n_pods_total=int(arrs.req.shape[0])))
         lcap.set_config(cfg, snapshot=snapshot, arrs=arrs)
         active_np = np.asarray(snapshot.arrays.active)
         preempted_by = None
@@ -141,13 +148,17 @@ class Simulator:
                 ]
 
                 def schedule_fn(disabled, nominated):
+                    # session re-runs always pass the carried columns,
+                    # so waves never apply on this branch (wave_plan is
+                    # None here by the guard above) — pass None literally
                     return exec_cache.unpad_output(
                         schedule_pods(
                             arrs, arrs.active, cfg,
                             disabled=exec_cache.pad_vector(
                                 disabled, arrs.req.shape[0], False),
                             nominated=exec_cache.pad_vector(
-                                nominated, arrs.req.shape[0], -1)),
+                                nominated, arrs.req.shape[0], -1),
+                            waves=None),
                         n_pods)
 
                 out, pre = run_with_preemption(
@@ -163,7 +174,8 @@ class Simulator:
                 self._pre_assign = np.asarray(out.node).astype(np.int32)
             else:
                 out = exec_cache.unpad_output(
-                    schedule_pods(arrs, arrs.active, cfg), n_pods)
+                    schedule_pods(arrs, arrs.active, cfg, waves=wave_plan),
+                    n_pods)
             node_assign = np.asarray(out.node)  # blocks on device completion
         with span("decode"):
             result = decode_result(
@@ -177,6 +189,13 @@ class Simulator:
                 extra_op_names=list(cfg.extension_op_names),
                 **explain_decode_kwargs(cfg, out),
             )
+            if wave_plan is not None and not self.preemption:
+                # session re-runs under preemption always carry the
+                # victim/nomination columns (has_init), so the plan never
+                # applied — only the preemption-free path reports waves
+                wid, wbat = wave_plan.pod_waves()
+                result.wave_id = wid[:n_pods]
+                result.wave_batched = wbat[:n_pods]
         lcap.set_result(result)  # the FULL (untrimmed) session result
         self._last = result
         if select_app is None:
@@ -200,4 +219,6 @@ class Simulator:
             topk_parts=result.topk_parts,
             score_part_names=result.score_part_names,
             preempted_pod_keys=result.preempted_pod_keys,
+            wave_id=result.wave_id,
+            wave_batched=result.wave_batched,
         )

@@ -91,6 +91,13 @@ def explain_result(result, top_k: Optional[int] = None,
             continue
         entry: Dict[str, Any] = {"pod": key,
                                  "forced": bool(forced[i] >= 0)}
+        if result.wave_id is not None and i < len(result.wave_id):
+            # wave-scheduling provenance (engine/waves.py): which
+            # placement wave the pod rode and whether it took the
+            # batched filter+score path or the fallback scan
+            entry["wave"] = int(result.wave_id[i])
+            entry["wave_path"] = ("batched" if bool(result.wave_batched[i])
+                                  else "scan")
         if key in node_by_key:
             entry["status"] = "scheduled"
             entry["node"] = node_by_key[key]
@@ -107,7 +114,7 @@ def explain_result(result, top_k: Optional[int] = None,
             entry["candidates"] = _candidates(result, i, part_names, top_k)
         entries.append(entry)
 
-    return {
+    report: Dict[str, Any] = {
         "n_active_nodes": int(result.n_active_nodes),
         "summary": {
             "scheduled": len(result.scheduled_pods),
@@ -116,6 +123,18 @@ def explain_result(result, top_k: Optional[int] = None,
         "score_parts": part_names,
         "pods": entries,
     }
+    if result.wave_id is not None:
+        wb = np.asarray(result.wave_batched)
+        wid = np.asarray(result.wave_id)
+        report["waves"] = {
+            # batched placement units only — the same semantic as
+            # bench.py's n_waves (fallback-scan pods are degenerate
+            # one-pod waves and are reported as scan_pods instead)
+            "n_waves": int(np.unique(wid[wb]).size),
+            "batched_pods": int(wb.sum()),
+            "scan_pods": int((~wb).sum()),
+        }
+    return report
 
 
 def _candidates(result, i: int, part_names: List[str],
@@ -155,9 +174,21 @@ def format_explain(report: Dict[str, Any]) -> str:
         f"explain: {s['scheduled']} scheduled, {s['unscheduled']} unscheduled "
         f"across {report['n_active_nodes']} active node(s)"
     ]
+    wv = report.get("waves")
+    if wv:
+        lines.append(
+            f"  waves: {wv['n_waves']} wave(s); {wv['batched_pods']} pod(s) "
+            f"batched, {wv['scan_pods']} on the fallback scan")
+
+    def _wave_suffix(e) -> str:
+        if "wave" not in e:
+            return ""
+        return f" [wave {e['wave']}, {e['wave_path']}]"
+
     for e in report["pods"]:
         if e["status"] == "scheduled":
             suffix = " (pinned via spec.nodeName)" if e.get("forced") else ""
+            suffix += _wave_suffix(e)
             lines.append(f"  {e['pod']}: scheduled on {e['node']}{suffix}")
             for c in e.get("candidates") or []:
                 parts = c.get("parts") or {}
@@ -168,7 +199,8 @@ def format_explain(report: Dict[str, Any]) -> str:
         elif e["status"] == "preempted":
             lines.append(f"  {e['pod']}: preempted — {e.get('reason', '')}")
         else:
-            lines.append(f"  {e['pod']}: UNSCHEDULABLE — {e.get('reason', '')}")
+            lines.append(f"  {e['pod']}: UNSCHEDULABLE — "
+                         f"{e.get('reason', '')}{_wave_suffix(e)}")
             ffo = e.get("first_failing_op")
             if ffo:
                 lines.append(f"      first failing op: {ffo}")

@@ -16,15 +16,26 @@ import numpy as np
 
 
 def synthetic_snapshot(n_nodes: int = 64, n_pods: int = 256, max_new: int = 0,
-                       rich: bool = False):
+                       rich: bool = False, pools: int = 0,
+                       bound: float = 0.0):
+    """pools > 0 labels nodes into `pools` tenant pools and gives every
+    pod a matching nodeSelector (+ per-pool app groups) — the
+    multi-tenant shape whose disjoint footprints the wave scheduler
+    (engine/waves.py) batches. bound > 0 pre-binds that fraction of pods
+    via spec.nodeName, interleaved through the sequence — the
+    cluster-dump replay shape. Both default off and leave the rich /
+    non-rich workloads byte-identical to the tracked bench series."""
     from open_simulator_tpu.encode.snapshot import EncodeOptions, encode_cluster
     from open_simulator_tpu.k8s.objects import Node, Pod
 
     rng = np.random.RandomState(0)
+    app_mod = pools if pools > 0 else 8
 
     def mk_node(name, i=0):
         labels = {"topology.kubernetes.io/zone": f"z{rng.randint(4)}"}
         spec = {}
+        if pools > 0:
+            labels["pool"] = f"p{i % pools}"
         if rich:
             if i % 2 == 0:
                 labels["disk"] = "ssd"
@@ -43,12 +54,12 @@ def synthetic_snapshot(n_nodes: int = 64, n_pods: int = 256, max_new: int = 0,
         })
 
     def mk_pod(i):
-        labels = {"app": f"a{i % 8}"}
+        labels = {"app": f"a{i % app_mod}"}
         spread = [{
             "maxSkew": 5,
             "topologyKey": "topology.kubernetes.io/zone",
             "whenUnsatisfiable": "ScheduleAnyway",
-            "labelSelector": {"matchLabels": {"app": f"a{i % 8}"}},
+            "labelSelector": {"matchLabels": {"app": f"a{i % app_mod}"}},
         }]
         spec = {
             "containers": [{
@@ -60,6 +71,12 @@ def synthetic_snapshot(n_nodes: int = 64, n_pods: int = 256, max_new: int = 0,
             }],
             "topologySpreadConstraints": spread,
         }
+        if pools > 0:
+            spec["nodeSelector"] = {"pool": f"p{i % pools}"}
+        if bound > 0.0 and (i * 7919) % 100 < int(bound * 100):
+            # deterministic interleave of already-bound pods (a recorded
+            # cluster dump replays placed pods mid-sequence)
+            spec["nodeName"] = f"n{(i * 31) % n_nodes}"
         if rich:
             labels["anti"] = f"g{i % 97}"
             if i % 17 == 0:
@@ -74,20 +91,20 @@ def synthetic_snapshot(n_nodes: int = 64, n_pods: int = 256, max_new: int = 0,
                     "maxSkew": 3,
                     "topologyKey": "topology.kubernetes.io/zone",
                     "whenUnsatisfiable": "DoNotSchedule",
-                    "labelSelector": {"matchLabels": {"app": f"a{i % 8}"}},
+                    "labelSelector": {"matchLabels": {"app": f"a{i % app_mod}"}},
                 })
             if i % 19 == 0:
                 spread.append({
                     "maxSkew": 4,
                     "topologyKey": "kubernetes.io/hostname",
                     "whenUnsatisfiable": "ScheduleAnyway",
-                    "labelSelector": {"matchLabels": {"app": f"a{i % 8}"}},
+                    "labelSelector": {"matchLabels": {"app": f"a{i % app_mod}"}},
                 })
             affinity = {}
             if i % 13 == 0:
                 affinity["podAffinity"] = {
                     "requiredDuringSchedulingIgnoredDuringExecution": [{
-                        "labelSelector": {"matchLabels": {"app": f"a{i % 8}"}},
+                        "labelSelector": {"matchLabels": {"app": f"a{i % app_mod}"}},
                         "topologyKey": "topology.kubernetes.io/zone",
                     }],
                 }
@@ -103,7 +120,7 @@ def synthetic_snapshot(n_nodes: int = 64, n_pods: int = 256, max_new: int = 0,
                     "preferredDuringSchedulingIgnoredDuringExecution"] = [{
                         "weight": 10,
                         "podAffinityTerm": {
-                            "labelSelector": {"matchLabels": {"app": f"a{(i + 1) % 8}"}},
+                            "labelSelector": {"matchLabels": {"app": f"a{(i + 1) % app_mod}"}},
                             "topologyKey": "topology.kubernetes.io/zone",
                         },
                     }]

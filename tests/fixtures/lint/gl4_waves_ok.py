@@ -1,0 +1,51 @@
+"""GL4 fixture (clean): the SAFE pattern for the host-side wave
+partitioner next to jit scope (companion to gl4_execcache_ok.py).
+
+The wave scheduler (engine/waves.py) runs its whole conflict analysis on
+HOST numpy BEFORE the jit boundary: footprints, channel sets, and the
+greedy wave accumulation are Python/numpy control flow over encoded host
+arrays, and the resulting plan enters the traced engine only as a STATIC
+argument (tuples of Python ints — segment bounds and kinds). Inside the
+trace, Python loops iterate over those static segment tuples (gate
+selection, not a host sync), and the traced math per segment stays pure
+jnp. This file must produce ZERO findings; the negative example
+(branching on a traced value inside jit) lives in gl4_trace.py.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def plan_waves(req_host, footprint_host):
+    # HOST analysis on HOST numpy (the encode output, pre-transfer):
+    # greedy contiguous partition into runs whose footprints are disjoint
+    segments = []
+    start = 0
+    written = np.zeros(footprint_host.shape[1], dtype=bool)
+    for i in range(req_host.shape[0]):
+        if bool(np.any(footprint_host[i] & written)):  # host bool: safe
+            segments.append((start, i))
+            start = i
+            written[:] = False
+        written |= footprint_host[i]
+    segments.append((start, req_host.shape[0]))
+    return tuple(segments)  # static plan: Python ints only
+
+
+def run_planned(req_host, footprint_host, alloc):
+    segments = plan_waves(np.asarray(req_host), np.asarray(footprint_host))
+
+    @jax.jit
+    def exec_plan(req, headroom):
+        # Python loop over STATIC segment bounds (host ints baked into
+        # the trace — segment selection, not a traced-value branch)
+        for lo, hi in segments:
+            if hi - lo > 1:  # static width: batch the independent run
+                headroom = headroom - jnp.sum(req[lo:hi], axis=0)
+            else:
+                headroom = headroom - req[lo]
+        return headroom
+
+    return exec_plan(jnp.asarray(req_host), jnp.asarray(alloc))

@@ -28,8 +28,19 @@ def test_preset_invariants():
 def test_run_batched_tiny():
     """The exact code path the driver times, at toy scale (CPU here)."""
     snap = bench.build(8, 16, 4, rich=True)
-    dt = bench.run_batched(snap, 4)
+    dt, wave_stats = bench.run_batched(snap, 4)
     assert dt > 0
+    assert {"n_waves", "max_wave_width", "wave_fraction"} <= set(wave_stats)
+
+
+def test_run_batched_pools_waves():
+    """The wave-showcase preset path: the pools workload must actually
+    partition into batched waves and still time out a positive best."""
+    snap = bench.build(8, 32, 0, pools=8)
+    dt, wave_stats = bench.run_batched(snap, 4, shape="tiny_pools")
+    assert dt > 0
+    assert wave_stats["wave_fraction"] == 1.0
+    assert wave_stats["max_wave_width"] == 8
 
 
 def test_bench_demo_emits_valid_json_line(monkeypatch, capsys):

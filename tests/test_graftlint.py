@@ -139,6 +139,15 @@ def test_gl4_execcache_safe_pattern_is_clean():
     assert lint_fixture("gl4_execcache_ok.py") == []
 
 
+def test_gl4_waves_safe_pattern_is_clean():
+    """The host-side wave partitioner next to jit scope — numpy conflict
+    analysis BEFORE the trace, the plan entering jit only as static
+    Python-int segment tuples, static-bound Python loops inside — the
+    pattern engine/waves.py + scheduler._run_wave_plan follow, must not
+    trip GL4 (or any rule)."""
+    assert lint_fixture("gl4_waves_ok.py") == []
+
+
 def test_gl4_ledger_safe_pattern_is_clean():
     """Host-side run-ledger writes next to jit scope — fingerprints from
     static shape metadata, digests over np.asarray'd outputs, JSON file

@@ -1,0 +1,100 @@
+"""Multi-chip digest-equality gate (`make multichip-smoke`).
+
+Runs `batched_schedule` over an 8-virtual-CPU-device ("scenario" x
+"node") mesh and asserts the node assignments — and their ledger result
+digest — are IDENTICAL to the single-device run of the same workload.
+The MULTICHIP_r01–r05 records all silently carried the same pre-PR-1
+scan-arity crash because nothing gated the sharded path between rounds;
+this tool is that gate, fast enough for tools/smoke.sh.
+
+Three workloads, chosen to exercise the paths that can rot
+independently:
+
+* the easy preset (most feature gates off — the fit fast path),
+* the all-ops rich preset (every gate on: slot paint, affinity,
+  anti-affinity, spread, ports),
+* a multi-tenant pools preset, where the wave scheduler
+  (engine/waves.py) batches the whole sequence — so the gate covers
+  GSPMD-sharded wave execution, not just the sequential scan.
+
+Exit 0 = all digests equal; any mismatch or crash exits nonzero.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_DEVICES = 8
+
+
+def main() -> int:
+    import __graft_entry__ as ge
+
+    devices = ge._virtual_cpu_devices(N_DEVICES)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from open_simulator_tpu.engine.scheduler import (
+        device_arrays,
+        make_config,
+    )
+    from open_simulator_tpu.engine.waves import waves_for
+    from open_simulator_tpu.parallel.sweep import (
+        active_masks_for_counts,
+        batched_schedule,
+        make_mesh,
+        shard_arrays,
+    )
+    from open_simulator_tpu.telemetry.ledger import array_result_digest
+
+    mesh = make_mesh(n_scenario=N_DEVICES // 2, n_node=2, devices=devices)
+    failures = 0
+    for name, kw in (
+        ("easy", {}),
+        ("rich", {"rich": True}),
+        ("pools", {"pools": 8}),
+    ):
+        max_new = 0 if kw.get("pools") else 8
+        snap = ge._synthetic_snapshot(n_nodes=8, n_pods=64, max_new=max_new,
+                                      **kw)
+        cfg = make_config(snap)._replace(fail_reasons=False)
+        plan = waves_for(snap.arrays, cfg)
+        counts = [min(c, max_new) for c in range(N_DEVICES)]
+        masks = jnp.asarray(active_masks_for_counts(snap, counts))
+
+        arrs_single = device_arrays(snap)
+        out_single = batched_schedule(arrs_single, masks, cfg, mesh=None,
+                                      waves=plan)
+        nodes_single = np.asarray(out_single.node)
+
+        arrs_mesh = shard_arrays(device_arrays(snap), mesh)
+        out_mesh = batched_schedule(arrs_mesh, masks, cfg, mesh=mesh,
+                                    waves=plan)
+        nodes_mesh = np.asarray(out_mesh.node)
+
+        d_single = array_result_digest(nodes_single)
+        d_mesh = array_result_digest(nodes_mesh)
+        same = d_single["digest"] == d_mesh["digest"]
+        wave_note = (f", waves={plan.stats()['n_waves']}"
+                     if plan is not None else ", waves=off")
+        print(f"multichip {name}: mesh={mesh.shape} lanes={len(counts)} "
+              f"digest single={d_single['digest']} mesh={d_mesh['digest']} "
+              f"equal={same}{wave_note}")
+        if not same:
+            diff = np.nonzero(nodes_single != nodes_mesh)
+            print(f"  MISMATCH at (lane, pod) = "
+                  f"{list(zip(*[d[:5] for d in diff]))}", file=sys.stderr)
+            failures += 1
+    if failures:
+        print(f"multichip-smoke FAILED: {failures} workload(s) diverged",
+              file=sys.stderr)
+        return 1
+    print("multichip-smoke OK: 8-device mesh digests equal single-device")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

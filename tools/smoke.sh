@@ -108,6 +108,15 @@ fi
 rm -rf "$SMOKE_LEDGER"
 
 echo
+echo "== multichip digest gate (8 fake devices vs single-device) =="
+make multichip-smoke
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: multichip-smoke exited $rc" >&2
+  exit "$rc"
+fi
+
+echo
 echo "== serving lifecycle (SIGTERM drain: readyz flip, 503s, in-flight finishes) =="
 make lifecycle-smoke
 rc=$?
