@@ -3,7 +3,7 @@
 # scenario end to end (tools/smoke.sh).
 
 .PHONY: test lint smoke bench bench-smoke bench-regress lifecycle-smoke \
-	multichip-smoke
+	multichip-smoke campaign-smoke
 
 test:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -45,6 +45,14 @@ lifecycle-smoke:
 multichip-smoke:
 	env XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	  JAX_PLATFORMS=cpu python tools/multichip_smoke.py
+
+# fleet fault-isolation gate: a 3-cluster fixture fleet (one malformed)
+# must complete with exactly 1 quarantined cluster, audits passing on
+# the good ones; a child process SIGKILLed after cluster 1 must resume
+# via the campaign journal to a BIT-IDENTICAL fleet report digest, with
+# the quarantined cluster reported once (not re-run, not lost)
+campaign-smoke:
+	env JAX_PLATFORMS=cpu python tools/campaign_smoke.py
 
 # regression gate over the run ledger (SIMON_LEDGER_DIR or
 # BENCH_LEDGER_DIR=... make bench-regress): the newest bench record per

@@ -198,7 +198,51 @@ PRESETS = {
     # (engine/waves.py) batches end to end (wave_fraction 1.0); compare
     # its scenarios/s against `sweep`-class shapes to see the wave win
     "pools": dict(nodes=1024, pods=10240, scenarios=64, max_new=0, pools=32),
+    # fleet campaign throughput (campaign/): a synthetic fleet of
+    # recorded dumps streamed through the per-cluster fault boundary —
+    # clusters/sec + quarantine count, gated by bench-regress like every
+    # other shape (the fleet path is covered from day one)
+    "campaign": dict(clusters=12, nodes=16, pods=64),
 }
+
+
+def run_campaign_bench(n_clusters: int, nodes: int, pods: int):
+    """Time the fleet path: write a synthetic fleet once, stream it
+    through the campaign runner (fault boundary + audit + report, no
+    checkpointing — disk must not be part of the measured loop), and
+    report clusters/sec. One warm-up pass compiles the shape buckets;
+    the timed pass measures the compile-once-run-many fleet rate."""
+    import shutil
+    import tempfile
+
+    from open_simulator_tpu.campaign import (
+        CampaignOptions,
+        run_campaign,
+        write_synthetic_fleet,
+    )
+    from open_simulator_tpu.telemetry import ledger
+
+    root = tempfile.mkdtemp(prefix="simbenchfleet-")
+    try:
+        write_synthetic_fleet(root, n_clusters=n_clusters, nodes=nodes,
+                              pods=pods)
+        opts = CampaignOptions(fleet=root, checkpoint=False, audit=True)
+        with ledger.run_capture("bench") as lcap:
+            run_campaign(opts)  # warm-up: compiles the fleet's buckets
+            t0 = time.perf_counter()
+            report = run_campaign(opts)
+            dt = time.perf_counter() - t0
+            label = f"campaign{n_clusters}c_{nodes}n_x{pods}p"
+            _bench_gauge().labels(shape=label).set(dt)
+            lcap.tag("preset", "campaign")
+            lcap.tag("shape", label)
+            lcap.tag("seconds", round(dt, 6))
+            lcap.tag("value", round(n_clusters / dt, 3))
+            lcap.tag("quarantined", report["totals"]["quarantined"])
+            lcap.tag("report_digest", report["digest"])
+        return dt, report, label
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
 
 
 def main():
@@ -233,6 +277,25 @@ def main():
 
         ledger.configure(args.ledger_dir)
     preset = PRESETS[args.preset]
+    if args.preset == "campaign":
+        # fleet-path bench: clusters/sec through the campaign runner's
+        # fault boundary (quarantine count rides along so a regression
+        # in EITHER speed or isolation shows in the tracked line)
+        dt, report, label = run_campaign_bench(
+            preset["clusters"], args.nodes or preset["nodes"],
+            args.pods or preset["pods"])
+        print(json.dumps({
+            "metric": f"clusters_per_sec@{label}",
+            "value": round(preset["clusters"] / dt, 3),
+            "unit": "clusters/s",
+            "vs_baseline": 0.0,
+            "baseline": "none_fleet_path",
+            "preset": "campaign",
+            "quarantined": report["totals"]["quarantined"],
+            "completed": report["totals"]["completed"],
+            "report_digest": report["digest"],
+        }))
+        return
     for k in ("nodes", "pods", "scenarios", "max_new"):
         if getattr(args, k) is None:
             setattr(args, k, preset[k])

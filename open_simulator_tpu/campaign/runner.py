@@ -1,0 +1,530 @@
+"""The fleet campaign runner (ARCHITECTURE.md §13).
+
+Streams a fleet of recorded cluster dumps through the bucketed engine
+with training-pipeline-grade fault tolerance. The defining property: **no
+single cluster can take down, corrupt, or silently skew a campaign.**
+
+Per-cluster fault boundary
+    Each cluster loads, admits, simulates, and audits inside one error
+    boundary. Failures map to the structured taxonomy (``E_SOURCE`` for
+    unparseable dumps, ``E_AUDIT`` for invariant violations, admission
+    codes for bad specs, ``E_INTERNAL`` for anything else) and land in a
+    **quarantine record** with the error and retry history; the campaign
+    continues. Transient device failures (OSError / RuntimeError, the
+    XlaRuntimeError base) retry with the full-jitter backoff schedule
+    from ``resilience/retry.py`` — a fleet of workers must not retry in
+    lockstep.
+
+Checkpoint / resume
+    One fsynced journal line per settled cluster (completed OR
+    quarantined), fingerprint = source digest + EngineConfig hash,
+    following the §11 SweepJournal schema. ``campaign run --resume
+    <id|last>`` after a SIGKILL verifies the fleet digest, replays the
+    settled clusters from the journal (quarantined clusters are reported
+    once — not re-run, not lost) and continues from the first unsettled
+    one; the fleet report digest is bit-identical to an uninterrupted
+    run because the report is always built from the journal-schema rows.
+
+Audit gate
+    ``campaign/audit.py`` re-proves every result against the engine's
+    own contracts; a violation quarantines the cluster with ``E_AUDIT``
+    rather than polluting fleet aggregates.
+
+Shared executables
+    Every simulate routes through the bucketed exec cache (§9), so a
+    heterogeneous fleet whose clusters land in a handful of shape
+    buckets reuses a handful of compiled executables — the report's
+    ``buckets`` map is the witness.
+
+Cancellation
+    An armed ``lifecycle`` cancel scope (REST deadline, drain) is
+    observed at every cluster boundary with partial results.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+import uuid
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from open_simulator_tpu.campaign.audit import AuditError, audit_result
+from open_simulator_tpu.campaign.fleet import (
+    ClusterEntry,
+    discover_fleet,
+    fleet_digest,
+)
+from open_simulator_tpu.campaign.report import build_report
+from open_simulator_tpu.errors import SimulationError
+from open_simulator_tpu.resilience import lifecycle
+from open_simulator_tpu.resilience.retry import run_with_retries
+
+_log = logging.getLogger(__name__)
+
+CAMPAIGN_JOURNAL_SUFFIX = ".campaign.jsonl"
+# transient-by-construction failure classes around device execution; the
+# structured SimulationError taxonomy is deterministic and never retried
+# (jax surfaces device faults as RuntimeError/XlaRuntimeError)
+TRANSIENT_ERRORS = (OSError, RuntimeError)
+
+
+@dataclass
+class CampaignOptions:
+    """One campaign's knobs (CLI flags / REST body fields map 1:1)."""
+
+    fleet: str = ""                  # dir or manifest (or pass entries=)
+    apps_dir: str = ""               # optional scenario apps, deployed to
+    #                                  EVERY cluster (manifest directory)
+    scenario: str = "replay"         # scenario-set name on records
+    max_clusters: int = 0            # 0 = the whole fleet
+    retries: int = 2                 # transient retries per cluster
+    backoff_s: float = 0.05
+    max_backoff_s: float = 2.0
+    resume: str = ""                 # campaign-id prefix or "last"
+    checkpoint: Optional[bool] = None  # None = auto (on when a dir exists)
+    audit: bool = True               # post-hoc invariant audit per cluster
+    config_overrides: Dict[str, Any] = dc_field(default_factory=dict)
+
+
+# ---- journal -------------------------------------------------------------
+
+
+class CampaignJournal:
+    """Append-only per-campaign settlement log, §11 SweepJournal-shaped:
+
+      {"kind": "header", "campaign_id", "ts", "fleet_digest", "scenario",
+       "n_clusters", "surface"}
+      {"kind": "cluster", "cluster", "fingerprint": {"source", "engine"},
+       "row": {...report row...}}
+      {"kind": "quarantine", "cluster", "row": {...quarantine record...}}
+      {"kind": "done", "digest", "completed", "quarantined"}
+
+    Lines are appended only when a cluster is SETTLED (hosted outputs or
+    a final quarantine verdict in hand) and fsynced, so a SIGKILL
+    resumes from the last settled cluster. Unwritable-dir degrade
+    matches SweepJournal: one warning, checkpointing off, run continues.
+    """
+
+    def __init__(self, path: str, header: Dict[str, Any],
+                 records: Optional[List[Dict[str, Any]]] = None,
+                 done: Optional[Dict[str, Any]] = None):
+        self.path = path
+        self.header = header
+        self.records = records or []
+        self.done = done
+        self.broken = False
+
+    @property
+    def campaign_id(self) -> str:
+        return self.header["campaign_id"]
+
+    @classmethod
+    def create(cls, root: str, fleet_dig: str, scenario: str,
+               n_clusters: int, surface: str = "campaign"
+               ) -> "CampaignJournal":
+        os.makedirs(root, exist_ok=True)
+        campaign_id = uuid.uuid4().hex[:12]
+        header = {"kind": "header", "campaign_id": campaign_id,
+                  "ts": round(time.time(), 6), "fleet_digest": fleet_dig,
+                  "scenario": scenario, "n_clusters": int(n_clusters),
+                  "surface": surface}
+        journal = cls(
+            os.path.join(root, campaign_id + CAMPAIGN_JOURNAL_SUFFIX),
+            header)
+        journal._append(header)
+        return journal
+
+    @classmethod
+    def load(cls, root: str, token: str) -> "CampaignJournal":
+        """Resolve ``token`` (unique campaign-id prefix or ``last``) and
+        parse; torn trailing lines (crash mid-append) are dropped."""
+        if not root or not os.path.isdir(root):
+            raise lifecycle.ResumeError(
+                f"no checkpoint directory at {root!r}", ref="resume",
+                hint="run with --ledger-dir (checkpoints live in "
+                     "<ledger>/checkpoints) or set SIMON_CHECKPOINT_DIR")
+        names = sorted(n for n in os.listdir(root)
+                       if n.endswith(CAMPAIGN_JOURNAL_SUFFIX))
+        if not names:
+            raise lifecycle.ResumeError(
+                f"no campaign checkpoints under {root}", ref="resume")
+        if token in ("last", "latest"):
+            pick = max(names, key=lambda n: os.path.getmtime(
+                os.path.join(root, n)))
+        else:
+            hits = [n for n in names if n.startswith(token)]
+            if not hits:
+                raise lifecycle.ResumeError(
+                    f"no campaign checkpoint matches {token!r}",
+                    ref="resume",
+                    hint=f"known: {[n.split('.')[0] for n in names]}")
+            if len(hits) > 1:
+                raise lifecycle.ResumeError(
+                    f"campaign id prefix {token!r} is ambiguous: "
+                    f"{[n.split('.')[0] for n in hits]}", ref="resume")
+            pick = hits[0]
+        path = os.path.join(root, pick)
+        header, records, done = None, [], None
+        with open(path, "r", encoding="utf-8") as f:
+            for ln in f:
+                try:
+                    rec = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue  # torn line from the crash
+                kind = rec.get("kind")
+                if kind == "header":
+                    header = rec
+                elif kind in ("cluster", "quarantine"):
+                    records.append(rec)
+                elif kind == "done":
+                    done = rec
+        if header is None:
+            raise lifecycle.ResumeError(
+                f"checkpoint {pick} has no header line", ref="resume")
+        return cls(path, header, records, done)
+
+    def verify(self, fleet_dig: str, scenario: str) -> None:
+        """Resume contract: same fleet (names + source digests + engine
+        overrides) and scenario, or the replayed rows answer a different
+        question."""
+        if self.header.get("fleet_digest") != fleet_dig:
+            raise lifecycle.ResumeError(
+                "fleet drifted since the checkpoint (a dump changed, was "
+                "added, or removed, or the engine overrides differ): "
+                "settled clusters answer a different question",
+                ref=f"campaign/{self.campaign_id}", field="fleet_digest",
+                hint="re-run without --resume, or restore the original "
+                     "fleet and options")
+        if self.header.get("scenario") != scenario:
+            raise lifecycle.ResumeError(
+                f"scenario drifted since the checkpoint "
+                f"({self.header.get('scenario')!r} -> {scenario!r})",
+                ref=f"campaign/{self.campaign_id}", field="scenario")
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        if self.broken:
+            return
+        line = json.dumps(rec, sort_keys=True) + "\n"
+        try:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(line)
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as e:
+            self.broken = True
+            _log.warning(
+                "campaign journal %s is unwritable (%s); checkpointing "
+                "disabled for the rest of this campaign — it cannot be "
+                "resumed past the last settled line", self.path, e)
+
+    def append_cluster(self, name: str, fingerprint: Dict[str, str],
+                       row: Dict[str, Any]) -> None:
+        rec = {"kind": "cluster", "cluster": name,
+               "fingerprint": fingerprint, "row": row}
+        self._append(rec)
+        self.records.append(rec)
+
+    def append_quarantine(self, name: str, row: Dict[str, Any]) -> None:
+        rec = {"kind": "quarantine", "cluster": name, "row": row}
+        self._append(rec)
+        self.records.append(rec)
+
+    def finish(self, digest: str, completed: int, quarantined: int) -> None:
+        rec = {"kind": "done", "digest": digest,
+               "completed": int(completed), "quarantined": int(quarantined)}
+        self._append(rec)
+        self.done = rec
+
+    def settled(self) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]]]:
+        """(completed rows, quarantine rows) recorded so far."""
+        rows = [r["row"] for r in self.records if r["kind"] == "cluster"]
+        quars = [r["row"] for r in self.records
+                 if r["kind"] == "quarantine"]
+        return rows, quars
+
+
+def resolve_campaign(token: str) -> CampaignJournal:
+    """Load a campaign journal by id prefix / ``last`` (the ``campaign
+    report`` surface)."""
+    return CampaignJournal.load(lifecycle.checkpoint_dir() or "", token)
+
+
+# ---- per-cluster work ----------------------------------------------------
+
+
+def _campaign_metrics():
+    from open_simulator_tpu import telemetry
+
+    return (
+        telemetry.counter(
+            "simon_campaign_clusters_total",
+            "fleet-campaign cluster outcomes",
+            labelnames=("outcome",)),  # completed | quarantined | replayed
+        telemetry.counter(
+            "simon_campaign_retries_total",
+            "transient per-cluster retries inside campaigns"),
+    )
+
+
+def load_and_admit(path_or_entry) -> Any:
+    """The campaign's load+admission boundary, exposed standalone (the
+    fuzz suite drives it): resolve the source, parse the dump, run the
+    admission validators — every failure is a structured
+    ``SimulationError`` (``E_SOURCE`` for parse/loader trouble, the
+    admission taxonomy for bad specs), never a raw traceback."""
+    from open_simulator_tpu.resilience.admission import admit
+
+    entry = (path_or_entry if isinstance(path_or_entry, ClusterEntry)
+             else ClusterEntry(name=str(path_or_entry),
+                               path=str(path_or_entry), digest=""))
+    cluster = entry.load()  # ClusterSourceError boundary lives in the source
+    try:
+        admit(cluster)
+    except SimulationError:
+        raise
+    except Exception as e:  # noqa: BLE001 — a validator crash on a spec
+        # shape it never anticipated is still a structured verdict
+        raise SimulationError(
+            f"admission crashed on {entry.name}: {type(e).__name__}: {e}",
+            code="E_INTERNAL", ref=f"source/{entry.path or entry.name}",
+            hint="file the dump as a repro for the admission validators",
+        ) from e
+    return cluster
+
+
+def _scenario_apps(opts: CampaignOptions) -> List[Any]:
+    if not opts.apps_dir:
+        return []
+    from open_simulator_tpu.core import AppResource
+    from open_simulator_tpu.k8s.loader import load_resources_from_directory
+
+    return [AppResource(name=opts.scenario,
+                        resources=load_resources_from_directory(
+                            opts.apps_dir))]
+
+
+def _top_rejects(result) -> List[List[Any]]:
+    """Per-cluster explain aggregate: total per-op elimination counts over
+    the unscheduled pods, top-N by count (deterministic tiebreak)."""
+    if result.fail_counts is None or not result.unscheduled_pods:
+        return []
+    snap = result.snapshot
+    unsched = {id(u.pod) for u in result.unscheduled_pods}
+    idx = [i for i, p in enumerate(snap.pods) if id(p) in unsched]
+    counts = np.asarray(result.fail_counts)[idx].sum(axis=0)
+    pairs = [[result.op_names[i], int(c)] for i, c in enumerate(counts)
+             if int(c) > 0 and i < len(result.op_names)]
+    pairs.sort(key=lambda kv: (-kv[1], kv[0]))
+    from open_simulator_tpu.campaign.report import TOP_OPS
+
+    return pairs[:TOP_OPS]
+
+
+def _run_one(entry: ClusterEntry, apps, opts: CampaignOptions,
+             campaign_id: str) -> Tuple[str, Dict[str, Any],
+                                        Dict[str, str]]:
+    """Load/simulate/audit ONE cluster inside the fault boundary.
+
+    Returns ("cluster", row, fingerprint) on success or
+    ("quarantine", quarantine_row, {}) on a final failure — this function
+    never raises for per-cluster trouble (cancellation excepted: a
+    CancelledError must stop the campaign, not quarantine a cluster)."""
+    from open_simulator_tpu.engine.exec_cache import bucket_shape
+    from open_simulator_tpu.engine.scheduler import make_config
+    from open_simulator_tpu.telemetry import ledger
+
+    clusters_total, retries_total = _campaign_metrics()
+    attempts = {"n": 0}
+
+    def attempt() -> Tuple[Dict[str, Any], Dict[str, str]]:
+        attempts["n"] += 1
+        if attempts["n"] > 1:
+            retries_total.inc()
+        from open_simulator_tpu.core import simulate
+
+        cluster = load_and_admit(entry)
+        # one ledger RunRecord per (cluster, scenario-set), tagged with
+        # the campaign id: `simon-tpu runs list --campaign <id>` reads
+        # the fleet's history back out of the flight recorder
+        with ledger.run_capture(
+                "campaign",
+                tags={"campaign": campaign_id, "cluster": entry.name,
+                      "scenario": opts.scenario}) as cap:
+            result = simulate(cluster, apps,
+                              config_overrides=dict(opts.config_overrides))
+            cfg = make_config(result.snapshot, **{
+                k: v for k, v in opts.config_overrides.items()
+                if not k.startswith("_")})
+            if cap.recording:
+                cap.set_config(cfg, snapshot=result.snapshot)
+                cap.set_result(result)
+        audit = audit_result(result)
+        if opts.audit and not audit.ok:
+            raise AuditError(audit, ref=f"cluster/{entry.name}")
+        snap = result.snapshot
+        n, p = bucket_shape(snap.n_nodes, snap.n_pods)
+        row = {
+            "cluster": entry.name,
+            "source": entry.digest,
+            "n_nodes": int(snap.n_real_nodes),
+            "n_pods": int(snap.n_pods),
+            "placed": len(result.scheduled_pods),
+            "unplaced": len(result.unscheduled_pods),
+            "cpu_pct": float(audit.cpu_pct),
+            "mem_pct": float(audit.mem_pct),
+            "bucket": [int(n), int(p)],
+            "top_rejects": _top_rejects(result),
+            "audit_ok": bool(audit.ok),
+        }
+        fingerprint = {"source": entry.digest,
+                       "engine": ledger.engine_config_hash(cfg)}
+        return row, fingerprint
+
+    try:
+        row, fingerprint = run_with_retries(
+            attempt, retries=opts.retries, backoff_s=opts.backoff_s,
+            max_backoff_s=opts.max_backoff_s, jitter=True,
+            retry_on=TRANSIENT_ERRORS)
+        clusters_total.labels(outcome="completed").inc()
+        return "cluster", row, fingerprint
+    except lifecycle.CancelledError:
+        raise  # a deadline is the campaign's story, not this cluster's
+    except SimulationError as e:
+        err = e.to_dict()
+    except Exception as e:  # noqa: BLE001 — the boundary's last line of
+        # defense: an unexpected crash quarantines the cluster (with the
+        # E_INTERNAL marker that says "this is our bug"), never the fleet
+        err = {"code": "E_INTERNAL", "ref": f"cluster/{entry.name}",
+               "field": "", "hint": "file the dump as a repro",
+               "message": f"{type(e).__name__}: {e}"}
+    clusters_total.labels(outcome="quarantined").inc()
+    _log.warning("campaign %s: cluster %s quarantined [%s] after %d "
+                 "attempt(s): %s", campaign_id, entry.name,
+                 err.get("code"), attempts["n"], err.get("message"))
+    return "quarantine", {
+        "cluster": entry.name,
+        "source": entry.digest,
+        "error": err,
+        "attempts": int(attempts["n"]),
+        "transient_retries": max(0, int(attempts["n"]) - 1),
+    }, {}
+
+
+# ---- campaign ------------------------------------------------------------
+
+
+def run_campaign(opts: CampaignOptions,
+                 entries: Optional[List[ClusterEntry]] = None
+                 ) -> Dict[str, Any]:
+    """Run (or resume) a fleet campaign; returns the fleet report dict."""
+    from open_simulator_tpu.telemetry import ledger
+
+    t0 = time.perf_counter()
+    entries = list(entries) if entries is not None else discover_fleet(
+        opts.fleet)
+    if opts.max_clusters > 0:
+        entries = entries[:opts.max_clusters]
+    apps = _scenario_apps(opts)
+    fdig = fleet_digest(entries, opts.scenario, opts.config_overrides)
+
+    # ---- journal: resume (verify + replay) or create fresh -------------
+    root = lifecycle.checkpoint_dir()
+    journal: Optional[CampaignJournal] = None
+    resumed = 0
+    if opts.resume:
+        journal = CampaignJournal.load(root or "", opts.resume)
+        journal.verify(fdig, opts.scenario)
+        resumed = len(journal.records)
+        _log.info("resumed campaign %s: %d settled cluster(s) replayed",
+                  journal.campaign_id, resumed)
+        if resumed:
+            _campaign_metrics()[0].labels(outcome="replayed").inc(resumed)
+    elif opts.checkpoint or (opts.checkpoint is None and root):
+        if not root:
+            raise ValueError(
+                "checkpoint=True needs a checkpoint directory: set "
+                "SIMON_CHECKPOINT_DIR or configure a ledger dir")
+        try:
+            journal = CampaignJournal.create(root, fdig, opts.scenario,
+                                             len(entries))
+        except OSError as e:
+            _log.warning("checkpoint dir %s is unwritable (%s); campaign "
+                         "checkpointing disabled for this run", root, e)
+            journal = None
+
+    campaign_id = (journal.campaign_id if journal is not None
+                   else uuid.uuid4().hex[:12])
+    rows, quars = (journal.settled() if journal is not None else ([], []))
+    settled = {r["cluster"] for r in rows} | {q["cluster"] for q in quars}
+
+    def _partial() -> Dict[str, Any]:
+        return {"campaign_id": campaign_id,
+                "clusters_settled": len(rows) + len(quars),
+                "clusters_total": len(entries),
+                "quarantined": sorted(q["cluster"] for q in quars)}
+
+    for entry in entries:
+        if entry.name in settled:
+            continue  # replayed from the journal: never re-run
+        # deadline/drain boundary: a cancelled campaign stops BETWEEN
+        # clusters with its journal intact (resume picks it back up)
+        lifecycle.check_current("campaign cluster boundary",
+                                partial=_partial)
+        kind, row, fingerprint = _run_one(entry, apps, opts, campaign_id)
+        if kind == "cluster":
+            rows.append(row)
+            if journal is not None:
+                journal.append_cluster(entry.name, fingerprint, row)
+        else:
+            quars.append(row)
+            if journal is not None:
+                journal.append_quarantine(entry.name, row)
+
+    report = build_report(campaign_id, rows, quars,
+                          wall_s=time.perf_counter() - t0,
+                          resumed_clusters=resumed)
+    if journal is not None and journal.done is None:
+        journal.finish(report["digest"], len(rows), len(quars))
+    # one campaign-summary line in the run ledger (beside the per-cluster
+    # records): how the fleet run went, surviving process exit
+    ledger.append_event(
+        "campaign",
+        tags={"campaign": campaign_id, "scenario": opts.scenario,
+              "clusters": report["totals"]["clusters"],
+              "completed": report["totals"]["completed"],
+              "quarantined": report["totals"]["quarantined"],
+              "digest": report["digest"],
+              "clusters_per_sec": report.get("clusters_per_sec")},
+        wall_s=report.get("wall_s", 0.0))
+    return report
+
+
+def report_from_journal(journal: CampaignJournal) -> Dict[str, Any]:
+    """Rebuild the fleet report from a journal (``campaign report``);
+    works on unfinished journals too — the crash-inspection view."""
+    rows, quars = journal.settled()
+    return build_report(journal.campaign_id, rows, quars)
+
+
+def run_audit(cluster_path: str,
+              config_overrides: Optional[Dict[str, Any]] = None
+              ) -> Tuple[Any, Dict[str, Any]]:
+    """Standalone audit surface: one cluster end to end, returns
+    (AuditReport, row-ish summary)."""
+    from open_simulator_tpu.core import simulate
+
+    entry = ClusterEntry(
+        name=os.path.splitext(os.path.basename(cluster_path))[0],
+        path=cluster_path, digest="")
+    cluster = load_and_admit(entry)
+    result = simulate(cluster, [],
+                      config_overrides=dict(config_overrides or {}))
+    rep = audit_result(result)
+    return rep, {"cluster": entry.name,
+                 "placed": len(result.scheduled_pods),
+                 "unplaced": len(result.unscheduled_pods)}

@@ -205,7 +205,11 @@ def build_parser() -> argparse.ArgumentParser:
     rn_ls = rn_sub.add_parser("list", help="summarize recorded runs")
     rn_ls.add_argument("--surface", default="",
                        help="only this surface (apply/chaos/bench/sweep/"
-                            "simulate/server:<route>)")
+                            "simulate/campaign/server:<route>)")
+    rn_ls.add_argument("--campaign", default="", metavar="ID",
+                       help="only records tagged with this campaign id "
+                            "(prefix match) — the per-cluster RunRecords "
+                            "a fleet campaign wrote")
     rn_ls.add_argument("-n", "--limit", type=int, default=0,
                        help="newest N records only")
     rn_ls.add_argument("--json", action="store_true",
@@ -221,6 +225,84 @@ def build_parser() -> argparse.ArgumentParser:
                        help="run id prefix, or last / prev")
     rn_df.add_argument("--json", action="store_true",
                        help="emit the structured diff as JSON")
+
+    cp = sub.add_parser(
+        "campaign",
+        help="fault-isolated fleet campaigns over recorded cluster dumps",
+        description="Stream a fleet (directory or manifest of recorded "
+                    "API dumps) through the bucketed engine with "
+                    "per-cluster fault isolation: a malformed dump, a "
+                    "crashed encode, or an audit violation quarantines "
+                    "THAT cluster with a structured record while the "
+                    "campaign continues. One fsynced journal line per "
+                    "settled cluster makes `run --resume <id|last>` "
+                    "after a SIGKILL produce a fleet report digest "
+                    "bit-identical to an uninterrupted run. "
+                    "ARCHITECTURE.md §13.")
+    cp_sub = cp.add_subparsers(dest="campaign_command")
+    cp_run = cp_sub.add_parser(
+        "run", help="run (or resume) a campaign over a fleet of dumps")
+    cp_run.add_argument("--fleet", required=True, metavar="DIR|MANIFEST",
+                        help="directory of recorded dumps (*.json/*.yaml, "
+                             "subdirs = manifest dirs) or a manifest file "
+                             "listing cluster paths")
+    cp_run.add_argument("--apps", default="", metavar="DIR",
+                        help="optional scenario apps (manifest dir) "
+                             "deployed to EVERY cluster")
+    cp_run.add_argument("--scenario", default="replay",
+                        help="scenario-set name stamped on journal and "
+                             "ledger records (default: replay)")
+    cp_run.add_argument("--max-clusters", type=int, default=0,
+                        help="only the first N clusters (0 = whole fleet)")
+    cp_run.add_argument("--retries", type=int, default=2,
+                        help="transient-failure retries per cluster "
+                             "(full-jitter backoff)")
+    cp_run.add_argument("--resume", default="", metavar="CAMPAIGN_ID",
+                        help="resume a checkpointed campaign after a "
+                             "crash: campaign-id prefix (or 'last'); "
+                             "settled clusters replay from the journal "
+                             "(quarantined ones are reported once, not "
+                             "re-run) and the report digest matches an "
+                             "uninterrupted run")
+    cp_run.add_argument("--no-audit", action="store_true",
+                        help="skip the per-cluster placement invariant "
+                             "audit (campaign/audit.py) — not recommended")
+    cp_run.add_argument("--ledger-dir", default="",
+                        help="run-ledger directory: one RunRecord per "
+                             "(cluster, scenario) + a campaign summary "
+                             "(also honors SIMON_LEDGER_DIR); checkpoints "
+                             "live in <ledger>/checkpoints")
+    cp_run.add_argument("--compile-cache-dir", default="",
+                        help="opt-in jax persistent compilation cache: "
+                             "repeat campaigns skip cold XLA compiles")
+    cp_run.add_argument("--no-waves", action="store_true",
+                        help="disable wave scheduling for every cluster "
+                             "(SIMON_WAVES=0 equivalent)")
+    cp_run.add_argument("--json", action="store_true",
+                        help="emit the fleet report as JSON")
+    cp_run.add_argument("--output-file", default="")
+    cp_rep = cp_sub.add_parser(
+        "report", help="rebuild a fleet report from a campaign journal")
+    cp_rep.add_argument("campaign", metavar="CAMPAIGN", nargs="?",
+                        default="last",
+                        help="campaign-id prefix or 'last' (default)")
+    cp_rep.add_argument("--ledger-dir", default="",
+                        help="ledger dir whose checkpoints/ holds the "
+                             "journal (also honors SIMON_LEDGER_DIR / "
+                             "SIMON_CHECKPOINT_DIR)")
+    cp_rep.add_argument("--json", action="store_true",
+                        help="emit the report as JSON")
+    cp_rep.add_argument("--output-file", default="")
+    cp_aud = cp_sub.add_parser(
+        "audit",
+        help="standalone placement invariant audit of one cluster")
+    cp_aud.add_argument("cluster", metavar="DUMP|DIR",
+                        help="recorded API dump file or manifest dir")
+    cp_aud.add_argument("--json", action="store_true",
+                        help="emit the audit report as JSON")
+    cp_aud.add_argument("--no-waves", action="store_true",
+                        help="disable wave scheduling for the audited run")
+    cp_aud.add_argument("--output-file", default="")
 
     mg = sub.add_parser("migrate", help="plan a defragmentation migration of placed pods")
     mg.add_argument("--cluster-config", required=True, help="cluster YAML dir (with placed pods)")
@@ -302,7 +384,14 @@ def _runs_main(args) -> int:
     try:
         if args.runs_command == "list":
             recs = led.records(surface=args.surface or None,
-                               limit=args.limit or None)
+                               limit=None if args.campaign
+                               else (args.limit or None))
+            if args.campaign:
+                recs = [r for r in recs
+                        if str((r.get("tags") or {}).get("campaign", ""))
+                        .startswith(args.campaign)]
+                if args.limit:
+                    recs = recs[-args.limit:]
             if args.json:
                 print(_json.dumps([ledger.run_summary(r) for r in recs],
                                   indent=2))
@@ -317,6 +406,83 @@ def _runs_main(args) -> int:
         print(_json.dumps(d, indent=2) if args.json else ledger.format_diff(d))
         return 0
     except ledger.LedgerError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+def _emit(text: str, output_file: str) -> None:
+    if output_file:
+        with open(output_file, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+    else:
+        print(text)
+
+
+def _campaign_main(args) -> int:
+    """simon-tpu campaign {run, report, audit}: the fleet surface."""
+    import json as _json
+
+    from open_simulator_tpu.errors import SimulationError as _SimErr
+
+    if not args.campaign_command:
+        print("error: pick a subcommand: campaign {run, report, audit}",
+              file=sys.stderr)
+        return 2
+    try:
+        if args.campaign_command == "run":
+            from open_simulator_tpu.campaign import (
+                CampaignOptions,
+                format_report,
+                run_campaign,
+            )
+
+            if args.compile_cache_dir:
+                from open_simulator_tpu.engine.exec_cache import (
+                    enable_persistent_cache,
+                )
+
+                enable_persistent_cache(args.compile_cache_dir)
+            report = run_campaign(CampaignOptions(
+                fleet=args.fleet,
+                apps_dir=args.apps,
+                scenario=args.scenario,
+                max_clusters=args.max_clusters,
+                retries=args.retries,
+                resume=args.resume,
+                audit=not args.no_audit,
+            ))
+            _emit(_json.dumps(report, indent=2) if args.json
+                  else format_report(report), args.output_file)
+            # a poisoned cluster must not fail the fleet: exit 0 as long
+            # as SOMETHING completed; 1 only when every cluster failed
+            return 0 if report["totals"]["completed"] > 0 else 1
+        if args.campaign_command == "report":
+            from open_simulator_tpu.campaign import (
+                format_report,
+                report_from_journal,
+                resolve_campaign,
+            )
+
+            journal = resolve_campaign(args.campaign)
+            report = report_from_journal(journal)
+            if journal.done is None:
+                report["unfinished"] = True
+            _emit(_json.dumps(report, indent=2) if args.json
+                  else format_report(report)
+                  + ("\n(journal has no done marker — the campaign is "
+                     "unfinished; resume it with campaign run --resume "
+                     f"{journal.campaign_id})" if journal.done is None
+                     else ""), args.output_file)
+            return 0
+        # audit
+        from open_simulator_tpu.campaign import format_audit, run_audit
+
+        rep, info = run_audit(args.cluster)
+        _emit(_json.dumps({**info, **rep.to_dict()}, indent=2)
+              if args.json else format_audit(rep, name=info["cluster"]),
+              args.output_file)
+        return 0 if rep.ok else 1
+    except (_SimErr, OSError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
 
@@ -346,6 +512,9 @@ def main(argv=None) -> int:
 
     if args.command == "runs":
         return _runs_main(args)
+
+    if args.command == "campaign":
+        return _campaign_main(args)
 
     if args.command == "lint":
         # analysis/ is pure-AST stdlib: linting never imports jax or the

@@ -37,6 +37,22 @@ Codes (the taxonomy table lives in ARCHITECTURE.md "Resilience layer"):
                        sweep-parameter drift since the journal was cut
   E_BUSY               server is draining; not accepting new work
   E_BAD_REQUEST        unparsable request body
+  E_SOURCE             unreadable/unparseable recorded cluster dump (empty
+                       file, truncated JSON/YAML, non-mapping documents,
+                       loader crash on a mangled object) — raised by
+                       k8s/cluster_source.py with the file path and first
+                       bad line so a fleet campaign can quarantine the
+                       cluster instead of dying on a parser traceback
+  E_AUDIT              the placement invariant auditor (campaign/audit.py)
+                       found a result that violates the engine's own
+                       contracts (bound pod on a missing/inactive node,
+                       per-node consumption above allocatable, forced bind
+                       not honored) — engine corruption, never a workload
+                       property; campaigns quarantine the cluster rather
+                       than pollute fleet aggregates
+  E_INTERNAL           unexpected non-taxonomy failure inside a campaign's
+                       per-cluster fault boundary (a bug): recorded in the
+                       quarantine record so the fleet continues
 """
 
 from __future__ import annotations

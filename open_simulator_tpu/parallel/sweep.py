@@ -509,8 +509,17 @@ def capacity_bisect(
                 "checkpoint=True needs a checkpoint directory: set "
                 "SIMON_CHECKPOINT_DIR or configure a ledger dir")
         fp = ledger.config_fingerprint(cfg, snapshot=snapshot, arrs=arrs)
-        journal = lifecycle.SweepJournal.create(
-            root, fp, max_new, lanes, tuple(thresholds))
+        try:
+            journal = lifecycle.SweepJournal.create(
+                root, fp, max_new, lanes, tuple(thresholds))
+        except OSError as e:
+            # readonly/full checkpoint dir: the sweep must still run —
+            # degrade to no-checkpoint with one warning (the same
+            # contract the run ledger follows on an unwritable dir)
+            _log.warning(
+                "checkpoint dir %s is unwritable (%s); sweep "
+                "checkpointing disabled for this run", root, e)
+            journal = None
 
     def _partial() -> Dict[str, Any]:
         sat = sorted(c for c, r in records.items() if r["stats"].satisfied)

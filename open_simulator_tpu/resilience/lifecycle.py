@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import logging
 import math
 import os
 import threading
@@ -54,6 +55,8 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from open_simulator_tpu.errors import SimulationError
+
+_log = logging.getLogger(__name__)
 
 CHECKPOINT_DIR_ENV = "SIMON_CHECKPOINT_DIR"
 SWEEP_JOURNAL_SUFFIX = ".sweep.jsonl"
@@ -476,6 +479,10 @@ class SweepJournal:
         self.header = header
         self.rounds = rounds or []
         self.done = done
+        # unwritable-journal latch: a full disk mid-sweep degrades
+        # checkpointing to disabled-with-one-warning (the sweep itself
+        # must finish; only crash recovery is lost)
+        self.broken = False
 
     @property
     def sweep_id(self) -> str:
@@ -632,11 +639,22 @@ class SweepJournal:
     # -- writing ---------------------------------------------------------
 
     def _append(self, rec: Dict[str, Any]) -> None:
+        if self.broken:
+            return
         line = json.dumps(rec, sort_keys=True, default=_json_default) + "\n"
-        with open(self.path, "a", encoding="utf-8") as f:
-            f.write(line)
-            f.flush()
-            os.fsync(f.fileno())
+        try:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(line)
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as e:
+            # disk full / dir went readonly mid-run: the run continues,
+            # checkpointing stops — warn ONCE, never crash the sweep
+            self.broken = True
+            _log.warning(
+                "checkpoint journal %s is unwritable (%s); checkpointing "
+                "disabled for the rest of this run — it cannot be resumed "
+                "past the last complete line", self.path, e)
 
     def append_round(self, counts: List[int],
                      lanes: Dict[int, Dict[str, Any]]) -> None:

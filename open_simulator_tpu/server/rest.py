@@ -40,6 +40,14 @@ Endpoint parity with the reference (pkg/server/server.go:148-314):
                              {"cluster": ..., "apps": [...], "plan":
                               {"events": [{"kind": "kill_node", "target": "n0"}],
                                "zone_key": "topology.kubernetes.io/zone"}}
+  POST /api/campaign      -> fault-isolated fleet campaign over recorded
+                             dumps on the server's filesystem
+                             ({"fleet": "<dir|manifest>"} or
+                              {"clusters": ["/a.json", ...]}, optional
+                              "resume"/"max_clusters"/"scenario");
+                             runs through the admission queue with
+                             cancellation observed at cluster boundaries,
+                             returns the fleet report (campaign/)
 
 Survivable serving (resilience/lifecycle.py, ARCHITECTURE.md §11):
 
@@ -126,7 +134,7 @@ _KNOWN_PATHS = frozenset({
     "/healthz", "/readyz", "/test", "/metrics", "/debug/stats",
     "/debug/profile",
     "/api/explain", "/api/deploy-apps", "/api/scale-apps", "/api/chaos",
-    "/api/capacity", "/api/runs", "/api/trace",
+    "/api/capacity", "/api/campaign", "/api/runs", "/api/trace",
 })
 
 
@@ -414,6 +422,68 @@ class SimulationServer:
             "sweep_id": plan.sweep_id,
             "resumed_rounds": plan.resumed_rounds,
         }
+
+    def campaign(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Fleet campaign as a service (POST /api/campaign).
+
+        Body: {"fleet": "<dir|manifest on the server's fs>"} OR
+              {"clusters": ["/abs/dump.json", ...]},
+              optional "resume": "<campaign id|last>",
+              "max_clusters": N, "scenario": "name", "retries": N,
+              "audit": true, "deadline_s": 30.
+
+        The request runs on the single-flight admission queue like every
+        POST; the campaign observes the deadline/drain CancelToken at
+        every CLUSTER boundary, so a 504 carries which clusters settled
+        and the journal supports `resume` afterwards."""
+        from open_simulator_tpu.campaign import (
+            CampaignOptions,
+            discover_fleet,
+            entries_for_paths,
+            run_campaign,
+        )
+
+        self._stats["requests"] += 1
+        fleet = body.get("fleet") or ""
+        clusters = body.get("clusters")
+        if not fleet and not clusters:
+            raise SimulationError(
+                "a campaign needs a fleet: a directory/manifest path or "
+                "an explicit cluster list",
+                code="E_BAD_REQUEST", ref="request", field="fleet",
+                hint='include {"fleet": "/dumps"} or '
+                     '{"clusters": ["/a.json", ...]}')
+        if clusters is not None and not isinstance(clusters, list):
+            raise SimulationError(
+                f"clusters must be a list of paths, got "
+                f"{type(clusters).__name__}",
+                code="E_BAD_REQUEST", ref="request", field="clusters")
+
+        def req_int(field: str, default: int) -> int:
+            # the campaign knobs get the same structured treatment as
+            # deadline_s: a malformed value is the CLIENT's error (400
+            # E_BAD_REQUEST with the field named), never a 500
+            raw = body.get(field, default)
+            try:
+                return max(0, int(raw))
+            except (TypeError, ValueError):
+                raise SimulationError(
+                    f"{field} must be a non-negative integer, got {raw!r}",
+                    code="E_BAD_REQUEST", ref="request", field=field,
+                    hint=f'e.g. {{"{field}": {default}}}') from None
+
+        entries = (entries_for_paths(clusters) if clusters
+                   else discover_fleet(fleet))
+        report = run_campaign(CampaignOptions(
+            fleet=fleet,
+            scenario=str(body.get("scenario") or "replay"),
+            max_clusters=req_int("max_clusters", 0),
+            retries=req_int("retries", 2),
+            resume=str(body.get("resume") or ""),
+            audit=bool(body.get("audit", True)),
+        ), entries=entries)
+        self._stats["simulations"] += report["totals"]["completed"]
+        return report
 
     def chaos(self, body: Dict[str, Any]) -> Dict[str, Any]:
         """Fault-injection re-simulation (resilience/chaos.py)."""
@@ -766,6 +836,7 @@ def _make_handler(server: SimulationServer):
             routes = {"/api/deploy-apps": server.deploy_apps,
                       "/api/scale-apps": server.scale_apps,
                       "/api/capacity": server.capacity,
+                      "/api/campaign": server.campaign,
                       "/api/chaos": server.chaos}
             handler_fn = routes.get(self.path)
             if handler_fn is None:

@@ -75,7 +75,7 @@ PHASE_ORDER = ("admit", "expand", "encode", "transfer", "schedule",
 _WORKLOAD_CONTENT_FIELDS = ("alloc", "req", "forced_node", "active",
                             "class_id", "gpu_cnt", "spread_valid")
 
-_state: Dict[str, Optional[str]] = {"dir": None}
+_state: Dict[str, Optional[str]] = {"dir": None, "broken": None}
 _tls = threading.local()
 _io_lock = threading.Lock()
 
@@ -89,21 +89,40 @@ class LedgerError(ValueError):
 
 def configure(path: Optional[str]) -> None:
     """Set the process-wide ledger directory (the --ledger-dir flag).
-    Empty/None falls back to the SIMON_LEDGER_DIR environment knob."""
+    Empty/None falls back to the SIMON_LEDGER_DIR environment knob.
+    Reconfiguring clears the unwritable-dir latch (an explicit new
+    configuration is a request to try again)."""
     _state["dir"] = path or None
+    _state["broken"] = None
 
 
 def ledger_dir() -> Optional[str]:
     return _state["dir"] or os.environ.get(LEDGER_DIR_ENV) or None
 
 
+def mark_unwritable(root: str, err: Exception) -> None:
+    """Degrade-to-disabled: an unwritable/readonly ledger dir (full disk,
+    bad mount) must cost ONE warning, not a crash — and not a warning per
+    run for the rest of a fleet campaign. Latched per-directory; cleared
+    by configure()."""
+    if _state["broken"] != root:
+        _state["broken"] = root
+        _log.warning(
+            "ledger dir %s is unwritable (%s); run recording disabled "
+            "for this process (reconfigure --ledger-dir to retry)",
+            root, err)
+
+
 def enabled() -> bool:
-    return ledger_dir() is not None
+    d = ledger_dir()
+    return d is not None and d != _state["broken"]
 
 
 def default_ledger() -> Optional["Ledger"]:
     d = ledger_dir()
-    return Ledger(d) if d else None
+    if d is None or d == _state["broken"]:
+        return None
+    return Ledger(d)
 
 
 # ---- fingerprints and digests -------------------------------------------
@@ -357,6 +376,9 @@ def append_event(surface: str, tags: Optional[Dict[str, Any]] = None,
     }
     try:
         led.append(rec)
+    except OSError as e:
+        mark_unwritable(led.root, e)  # one warning, then disabled
+        return None
     except Exception as e:  # noqa: BLE001 — lifecycle records are best-effort
         _log.warning("ledger append failed (%s): %s", led.path, e)
         return None
@@ -397,7 +419,11 @@ def run_capture(surface: str,
         _tls.active = False
     try:
         led.append(cap.finish())
-    except Exception as e:  # noqa: BLE001 — disk full, a non-JSON tag, ...:
+    except OSError as e:
+        # unwritable dir / full disk: one warning, then recording goes
+        # dark for this process instead of warning on every later run
+        mark_unwritable(led.root, e)
+    except Exception as e:  # noqa: BLE001 — a non-JSON tag, ...:
         # the flight recorder must never take the plane down
         _log.warning("ledger append failed (%s): %s", led.path, e)
 

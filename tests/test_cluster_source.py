@@ -3,6 +3,7 @@ matching CreateClusterResourceFromClient's snapshot semantics
 (pkg/simulator/simulator.go:514-612).
 """
 
+import json
 import os
 
 import pytest
@@ -101,3 +102,72 @@ def test_server_kubeconfig_dump(tmp_path):
     srv = SimulationServer(kubeconfig=FIXTURE)
     res = srv.base_cluster()
     assert {n.name for n in res.nodes} == {"live-a", "live-b"}
+
+
+# ---- E_SOURCE hardening (ISSUE 8 satellite): empty/truncated/non-mapping
+# dumps must raise structured errors with the path and first bad line,
+# never a raw parser traceback -------------------------------------------
+
+
+def test_empty_dump_is_structured(tmp_path):
+    p = tmp_path / "empty.json"
+    p.write_text("")
+    with pytest.raises(ClusterSourceError, match="file is empty") as ei:
+        ApiDumpSource(str(p)).load()
+    assert ei.value.code == "E_SOURCE"
+    assert str(p) in ei.value.message
+
+
+def test_truncated_json_dump_names_the_line(tmp_path):
+    p = tmp_path / "torn.json"
+    p.write_text('{"kind": "List",\n "items": [{"kind": "Node", ')
+    with pytest.raises(ClusterSourceError, match="truncated or invalid "
+                                                 "JSON") as ei:
+        ApiDumpSource(str(p)).load()
+    assert ei.value.code == "E_SOURCE"
+    assert ei.value.field.startswith("line ")
+
+
+def test_truncated_yaml_dump_names_the_line(tmp_path):
+    p = tmp_path / "torn.yaml"
+    p.write_text("kind: Node\nmetadata:\n  name: n0\n  labels: {a: [\n")
+    with pytest.raises(ClusterSourceError, match="invalid YAML at line") as ei:
+        ApiDumpSource(str(p)).load()
+    assert ei.value.code == "E_SOURCE"
+
+
+def test_non_mapping_dump_is_structured(tmp_path):
+    p = tmp_path / "scalar.json"
+    p.write_text("[1, 2, 3]")
+    with pytest.raises(ClusterSourceError, match="expected"):
+        ApiDumpSource(str(p)).load()
+    p2 = tmp_path / "scalar.yaml"
+    p2.write_text("- just\n- a\n- list\n")
+    with pytest.raises(ClusterSourceError, match="expected mappings"):
+        ApiDumpSource(str(p2)).load()
+
+
+def test_mangled_object_in_dump_is_structured(tmp_path):
+    """A loader crash deep inside from_dict (string metadata) surfaces as
+    E_SOURCE, not an AttributeError traceback."""
+    p = tmp_path / "mangled.json"
+    p.write_text(json.dumps({"kind": "List", "items": [
+        {"kind": "Node", "metadata": {"name": "n0"},
+         "status": {"allocatable": {"cpu": "4"}}},
+        {"kind": "Pod", "metadata": "oops",
+         "status": {"phase": "Running"}},
+    ]}))
+    with pytest.raises(ClusterSourceError) as ei:
+        ApiDumpSource(str(p)).load()
+    assert ei.value.code == "E_SOURCE"
+
+
+def test_cluster_source_error_is_simulation_error():
+    """The campaign quarantine boundary depends on the taxonomy."""
+    from open_simulator_tpu.errors import SimulationError
+
+    assert issubclass(ClusterSourceError, SimulationError)
+    assert issubclass(ClusterSourceError, ValueError)  # legacy call sites
+    e = ClusterSourceError("x")
+    assert e.code == "E_SOURCE"
+    assert e.to_dict()["code"] == "E_SOURCE"

@@ -548,3 +548,31 @@ spec:
     })
     assert resp["volume_bindings"] == {"default/data": "pv-a"}
     assert not resp["unscheduled_pods"]
+
+
+def test_campaign_endpoint(server_url, tmp_path):
+    """POST /api/campaign end to end through the admission queue: the
+    fleet report comes back with the malformed cluster quarantined."""
+    from open_simulator_tpu.campaign import write_synthetic_fleet
+
+    fleet = tmp_path / "fleet"
+    write_synthetic_fleet(str(fleet), n_clusters=2, nodes=3, pods=6,
+                          malformed=1)
+    resp = _post(server_url + "/api/campaign", {"fleet": str(fleet)})
+    # cluster-00 of the synthetic fleet: 3 nodes, 6 pods, all placeable
+    assert resp["totals"] == {"clusters": 2, "completed": 1,
+                              "quarantined": 1, "placed": 6, "unplaced": 0}
+    assert resp["quarantined"][0]["error"]["code"] == "E_SOURCE"
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server_url + "/api/campaign", {})
+    body = _read_error(ei)
+    assert ei.value.code == 400 and body["code"] == "E_BAD_REQUEST"
+
+    # malformed knobs are the client's error: structured 400, never 500
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server_url + "/api/campaign",
+              {"fleet": str(fleet), "max_clusters": None})
+    body = _read_error(ei)
+    assert ei.value.code == 400 and body["code"] == "E_BAD_REQUEST"
+    assert body["field"] == "max_clusters"

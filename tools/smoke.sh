@@ -117,6 +117,15 @@ if [ "$rc" -ne 0 ]; then
 fi
 
 echo
+echo "== fleet campaign (quarantine isolation + SIGKILL resume digest) =="
+make campaign-smoke
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: campaign-smoke exited $rc" >&2
+  exit "$rc"
+fi
+
+echo
 echo "== serving lifecycle (SIGTERM drain: readyz flip, 503s, in-flight finishes) =="
 make lifecycle-smoke
 rc=$?
