@@ -3,7 +3,7 @@
 # scenario end to end (tools/smoke.sh).
 
 .PHONY: test lint smoke bench bench-smoke bench-regress lifecycle-smoke \
-	multichip-smoke campaign-smoke
+	multichip-smoke campaign-smoke replay-smoke
 
 test:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -53,6 +53,13 @@ multichip-smoke:
 # the quarantined cluster reported once (not re-run, not lost)
 campaign-smoke:
 	env JAX_PLATFORMS=cpu python tools/campaign_smoke.py
+
+# time-axis gate (replay/): a synthetic arrival trace with one mid-trace
+# kill_node must converge under the autoscaler; a child SIGKILLed after
+# step 3 must resume via the replay journal to a BIT-IDENTICAL trajectory
+# digest; and the frontier CLI must return a non-trivial Pareto set
+replay-smoke:
+	env JAX_PLATFORMS=cpu python tools/replay_smoke.py
 
 # regression gate over the run ledger (SIMON_LEDGER_DIR or
 # BENCH_LEDGER_DIR=... make bench-regress): the newest bench record per

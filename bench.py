@@ -203,6 +203,12 @@ PRESETS = {
     # clusters/sec + quarantine count, gated by bench-regress like every
     # other shape (the fleet path is covered from day one)
     "campaign": dict(clusters=12, nodes=16, pods=64),
+    # trace-replay throughput (replay/): a synthetic day-in-the-cluster
+    # (arrival waves, departures, one mid-trace fault, autoscaler loop)
+    # through the step engine — steps/sec + events/sec, gated by
+    # bench-regress like every other shape (the time axis is covered
+    # from day one)
+    "replay": dict(nodes=16, batches=10, batch_pods=24),
 }
 
 
@@ -243,6 +249,52 @@ def run_campaign_bench(n_clusters: int, nodes: int, pods: int):
         return dt, report, label
     finally:
         shutil.rmtree(root, ignore_errors=True)
+
+
+def run_replay_bench(n_nodes: int, n_batches: int, batch_pods: int):
+    """Time the replay path: a deterministic synthetic trace (arrivals,
+    departures, one kill_node, autoscaler) through the step engine.
+    One warm-up trajectory compiles the step executables; the timed
+    trajectory measures the compile-once-run-many step rate. No
+    checkpointing — disk must not be part of the measured loop."""
+    from open_simulator_tpu.replay import (
+        AutoscalerPolicy,
+        ReplayOptions,
+        ReplayTrace,
+        run_replay,
+        synthetic_replay_cluster,
+        synthetic_trace_dict,
+    )
+    from open_simulator_tpu.telemetry import ledger
+
+    trace_dict = synthetic_trace_dict(n_batches=n_batches,
+                                      batch_pods=batch_pods,
+                                      max_new_nodes=max(4, n_nodes // 2))
+
+    def one_run():
+        return run_replay(
+            synthetic_replay_cluster(n_nodes=n_nodes,
+                                     n_initial_pods=n_nodes),
+            ReplayTrace.from_dict(trace_dict),
+            ReplayOptions(controllers=[AutoscalerPolicy(scale_step=2)],
+                          checkpoint=False))
+
+    with ledger.run_capture("bench") as lcap:
+        one_run()  # warm-up: compiles the trajectory's executables
+        t0 = time.perf_counter()
+        report = one_run()
+        dt = time.perf_counter() - t0
+        steps = report["totals"]["steps"]
+        events = report["totals"]["events"]
+        label = f"replay{steps}st_{n_nodes}n_x{batch_pods}bp"
+        _bench_gauge().labels(shape=label).set(dt)
+        lcap.tag("preset", "replay")
+        lcap.tag("shape", label)
+        lcap.tag("seconds", round(dt, 6))
+        lcap.tag("value", round(steps / dt, 3))
+        lcap.tag("events_per_sec", round(events / dt, 3))
+        lcap.tag("report_digest", report["digest"])
+    return dt, report, label
 
 
 def main():
@@ -293,6 +345,28 @@ def main():
             "preset": "campaign",
             "quarantined": report["totals"]["quarantined"],
             "completed": report["totals"]["completed"],
+            "report_digest": report["digest"],
+        }))
+        return
+    if args.preset == "replay":
+        # time-axis bench: steps/sec + events/sec through the replay
+        # step engine (one executable per trajectory after warm-up);
+        # the digest rides along so a regression in EITHER speed or
+        # determinism shows in the tracked line
+        dt, report, label = run_replay_bench(
+            args.nodes or preset["nodes"], preset["batches"],
+            args.pods or preset["batch_pods"])
+        steps = report["totals"]["steps"]
+        print(json.dumps({
+            "metric": f"replay_steps_per_sec@{label}",
+            "value": round(steps / dt, 3),
+            "unit": "steps/s",
+            "vs_baseline": 0.0,
+            "baseline": "none_replay_path",
+            "preset": "replay",
+            "events_per_sec": round(report["totals"]["events"] / dt, 3),
+            "steps": steps,
+            "pending_final": report["totals"]["pending"],
             "report_digest": report["digest"],
         }))
         return

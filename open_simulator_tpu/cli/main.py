@@ -304,6 +304,72 @@ def build_parser() -> argparse.ArgumentParser:
                         help="disable wave scheduling for the audited run")
     cp_aud.add_argument("--output-file", default="")
 
+    rp = sub.add_parser(
+        "replay",
+        help="time-stepped trace replay: arrivals, departures, chaos, "
+             "autoscaler loops, cost frontiers",
+        description="Execute a ReplayTrace (ordered timed events: pod-"
+                    "batch arrivals, departures, node add/remove, the "
+                    "chaos fault kinds) as a closed loop over the "
+                    "bucketed scan — one encode for the whole "
+                    "trajectory, pods pinned where they landed, pending "
+                    "pods retried every step. --controller registers "
+                    "autoscaler / descheduler loops that run between "
+                    "events until convergence. With a checkpoint "
+                    "directory (a ledger dir or SIMON_CHECKPOINT_DIR) "
+                    "every settled step is journaled and --resume "
+                    "continues a killed trajectory to a bit-identical "
+                    "digest. --frontier switches to the cost-frontier "
+                    "question: sweep heterogeneous node-spec mixes over "
+                    "the trace's full workload and report the (cost, "
+                    "utilization, disruption) Pareto set. "
+                    "ARCHITECTURE.md section 14.")
+    rp.add_argument("--cluster-config", required=True,
+                    help="cluster YAML dir (the t=0 state)")
+    rp.add_argument("--trace", required=True, metavar="FILE",
+                    help="trace file (YAML or JSON): {events: [{t, kind, "
+                         "...}], max_new_nodes, node_template, zone_key}")
+    rp.add_argument("--controller", action="append", default=[],
+                    metavar="NAME[:k=v,...]",
+                    help="register a step controller, repeatable — "
+                         "autoscaler[:scale_step=N,idle_steps=N,"
+                         "up_cooldown=N,down_cooldown=N,max_nodes=N] or "
+                         "descheduler[:period=N]")
+    rp.add_argument("--frontier", default="", metavar="SPECS",
+                    help="node-spec mix file ({specs: [{name, cost, "
+                         "max_count, spec_yaml}], max_total}): report "
+                         "the Pareto set over every mix instead of "
+                         "replaying the timeline")
+    rp.add_argument("--lane-width", type=int, default=8,
+                    help="frontier mixes swept per device round")
+    rp.add_argument("--max-mixes", type=int, default=2048,
+                    help="frontier mix-grid guardrail")
+    rp.add_argument("--resume", default="", metavar="REPLAY_ID",
+                    help="resume a checkpointed replay after a crash: "
+                         "replay-id prefix (or 'last'); settled steps "
+                         "replay from the journal and the trajectory "
+                         "digest is identical to an uninterrupted run")
+    rp.add_argument("--no-fast-path", action="store_true",
+                    help="disable the carry-threaded arrival fast path "
+                         "(results are bit-identical either way — this "
+                         "is a perf/debug switch)")
+    rp.add_argument("--compile-cache-dir", default="",
+                    help="opt-in jax persistent compilation cache")
+    rp.add_argument("--ledger-dir", default="",
+                    help="run-ledger directory: one RunRecord per "
+                         "executed step + a trajectory summary (also "
+                         "honors SIMON_LEDGER_DIR); checkpoints live in "
+                         "<ledger>/checkpoints")
+    rp.add_argument("--no-waves", action="store_true",
+                    help="disable wave scheduling (SIMON_WAVES=0 "
+                         "equivalent)")
+    rp.add_argument("--json", action="store_true",
+                    help="emit the report as JSON")
+    rp.add_argument("--output-file", default="")
+    rp.add_argument("--trace-out", default="",
+                    help="write a Chrome-trace JSON timeline of the "
+                         "replay's phases")
+
     mg = sub.add_parser("migrate", help="plan a defragmentation migration of placed pods")
     mg.add_argument("--cluster-config", required=True, help="cluster YAML dir (with placed pods)")
     mg.add_argument("--output-file", default="")
@@ -487,6 +553,81 @@ def _campaign_main(args) -> int:
         return 1
 
 
+def _load_trace_file(path: str) -> dict:
+    """Parse a trace/specs file (YAML or JSON — yaml is a superset).
+    Malformed YAML is the user's input error: a structured E_SPEC (the
+    `error:` exit path), never a parser traceback."""
+    import yaml as _yaml
+
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = _yaml.safe_load(f)
+    except _yaml.YAMLError as e:
+        raise SimulationError(
+            f"{path} is not valid YAML/JSON: {e}",
+            code="E_SPEC", ref="replay_trace", field="trace") from None
+    if not isinstance(doc, dict):
+        raise SimulationError(
+            f"{path} must hold a mapping, got {type(doc).__name__}",
+            code="E_SPEC", ref="replay_trace", field="trace")
+    return doc
+
+
+def _replay_main(args) -> int:
+    """simon-tpu replay: trace replay or the cost-frontier question."""
+    import json as _json
+
+    from open_simulator_tpu.k8s.loader import load_resources_from_directory
+
+    if args.compile_cache_dir:
+        from open_simulator_tpu.engine.exec_cache import (
+            enable_persistent_cache,
+        )
+
+        enable_persistent_cache(args.compile_cache_dir)
+    try:
+        with _trace_capture(args.trace_out):
+            from open_simulator_tpu.replay import (
+                ReplayOptions,
+                ReplayTrace,
+                capacity_frontier,
+                controller_from_arg,
+                format_frontier,
+                format_report,
+                parse_specs,
+                run_replay,
+            )
+
+            cluster = load_resources_from_directory(args.cluster_config)
+            trace = ReplayTrace.from_dict(_load_trace_file(args.trace))
+            trace.validate()
+            if args.frontier:
+                # the static mix question over the trace's FULL workload
+                # (every arrival batch as an app): which node mixes sit
+                # on the (cost, utilization, disruption) frontier?
+                from open_simulator_tpu.replay.engine import arrival_apps
+
+                spec_doc = _load_trace_file(args.frontier)
+                result = capacity_frontier(
+                    cluster, arrival_apps(trace),
+                    parse_specs(spec_doc.get("specs")),
+                    max_total=spec_doc.get("max_total"),
+                    lane_width=args.lane_width, max_mixes=args.max_mixes)
+                _emit(_json.dumps(result, indent=2) if args.json
+                      else format_frontier(result), args.output_file)
+                return 0
+            controllers = [controller_from_arg(a) for a in args.controller]
+            report = run_replay(cluster, trace, ReplayOptions(
+                controllers=controllers, resume=args.resume,
+                fast_path=not args.no_fast_path))
+            _emit(_json.dumps(report, indent=2) if args.json
+                  else format_report(report), args.output_file)
+            return 0
+    except (SimulationError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
 def main(argv=None) -> int:
     _init_logging()
     parser = build_parser()
@@ -515,6 +656,9 @@ def main(argv=None) -> int:
 
     if args.command == "campaign":
         return _campaign_main(args)
+
+    if args.command == "replay":
+        return _replay_main(args)
 
     if args.command == "lint":
         # analysis/ is pure-AST stdlib: linting never imports jax or the

@@ -2,8 +2,8 @@
 
 (Renamed from engine/profile.py in the telemetry PR: this module parses
 scheduler *configuration*, not profiles/timelines — the old name collided
-with the jax.profiler / telemetry work. engine/profile.py remains as a
-deprecation re-export.)
+with the jax.profiler / telemetry work. The engine/profile.py deprecation
+re-export was retired in the replay PR; import from here.)
 
 The reference accepts a scheduler config file via --default-scheduler-config
 and merges it over the v1beta2 defaults (GetAndSetSchedulerConfig,

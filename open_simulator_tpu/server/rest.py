@@ -48,6 +48,14 @@ Endpoint parity with the reference (pkg/server/server.go:148-314):
                              runs through the admission queue with
                              cancellation observed at cluster boundaries,
                              returns the fleet report (campaign/)
+  POST /api/replay        -> time-stepped trace replay (replay/):
+                             {"trace": {"events": [...]}, "controllers":
+                              [...], "resume"?, "frontier"?} — the
+                             closed loop over the bucketed scan with
+                             cancellation observed at STEP boundaries
+                             (partial trajectories on deadline) and
+                             journal resume; "frontier" switches to the
+                             heterogeneous node-mix Pareto question
 
 Survivable serving (resilience/lifecycle.py, ARCHITECTURE.md §11):
 
@@ -134,7 +142,8 @@ _KNOWN_PATHS = frozenset({
     "/healthz", "/readyz", "/test", "/metrics", "/debug/stats",
     "/debug/profile",
     "/api/explain", "/api/deploy-apps", "/api/scale-apps", "/api/chaos",
-    "/api/capacity", "/api/campaign", "/api/runs", "/api/trace",
+    "/api/capacity", "/api/campaign", "/api/replay", "/api/runs",
+    "/api/trace",
 })
 
 
@@ -483,6 +492,95 @@ class SimulationServer:
             audit=bool(body.get("audit", True)),
         ), entries=entries)
         self._stats["simulations"] += report["totals"]["completed"]
+        return report
+
+    def replay(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Trace replay as a service (POST /api/replay).
+
+        Body: {"cluster": {...}?, "trace": {"events": [...],
+               "max_new_nodes": N?, "node_template": "<Node yaml>"?,
+               "zone_key": ...?},
+               "controllers": [{"kind": "autoscaler", ...}]?,
+               "frontier": {"specs": [...], "max_total": N?,
+                            "lane_width": N?, "max_mixes": N?}?,
+               "resume": "<replay id|last>"?, "deadline_s": 30?}
+
+        Runs on the single-flight admission queue like every POST; the
+        replay observes the deadline/drain CancelToken at every STEP
+        boundary, so a 504 carries how many steps settled and the
+        journal supports `resume` afterwards. Malformed traces
+        (missing/bogus event fields, non-monotone timestamps) return
+        structured 400s, never 500s. With "frontier", the request
+        becomes the static mix question over the trace's full workload
+        and returns the (cost, utilization, disruption) Pareto set."""
+        from open_simulator_tpu.replay import (
+            ReplayOptions,
+            ReplayTrace,
+            capacity_frontier,
+            controller_from_dict,
+            parse_specs,
+            run_replay,
+        )
+        from open_simulator_tpu.replay.engine import arrival_apps
+
+        self._stats["requests"] += 1
+        cluster = self.base_cluster(body.get("cluster"))
+        raw_trace = body.get("trace")
+        if raw_trace is None:
+            raise SimulationError(
+                "replay needs a trace", code="E_BAD_REQUEST",
+                ref="request", field="trace",
+                hint='include {"trace": {"events": [{"t": 0, "kind": '
+                     '"arrive", "app": {...}}]}}')
+        trace = ReplayTrace.from_dict(raw_trace)
+        trace.validate()
+        frontier = body.get("frontier")
+        if frontier is not None:
+            if not isinstance(frontier, dict):
+                raise SimulationError(
+                    f"frontier must be an object, got "
+                    f"{type(frontier).__name__}", code="E_BAD_REQUEST",
+                    ref="request", field="frontier",
+                    hint='{"frontier": {"specs": [...]}}')
+
+            def fr_int(field: str, default: int) -> int:
+                raw = frontier.get(field, default)
+                try:
+                    return max(1, int(raw))
+                except (TypeError, ValueError):
+                    raise SimulationError(
+                        f"frontier.{field} must be an integer, got "
+                        f"{raw!r}", code="E_BAD_REQUEST", ref="request",
+                        field=f"frontier.{field}") from None
+
+            raw_total = frontier.get("max_total")
+            try:
+                max_total = None if raw_total is None else int(raw_total)
+            except (TypeError, ValueError):
+                raise SimulationError(
+                    f"frontier.max_total must be an integer, got "
+                    f"{raw_total!r}", code="E_BAD_REQUEST", ref="request",
+                    field="frontier.max_total") from None
+            result = capacity_frontier(
+                cluster, arrival_apps(trace),
+                parse_specs(frontier.get("specs")),
+                max_total=max_total,
+                lane_width=fr_int("lane_width", 8),
+                max_mixes=fr_int("max_mixes", 2048))
+            self._stats["simulations"] += 1
+            return result
+        raw_ctrl = body.get("controllers") or []
+        if not isinstance(raw_ctrl, list):
+            raise SimulationError(
+                f"controllers must be a list, got "
+                f"{type(raw_ctrl).__name__}", code="E_BAD_REQUEST",
+                ref="request", field="controllers",
+                hint='[{"kind": "autoscaler", "scale_step": 2}]')
+        controllers = [controller_from_dict(c) for c in raw_ctrl]
+        report = run_replay(cluster, trace, ReplayOptions(
+            controllers=controllers,
+            resume=str(body.get("resume") or "")))
+        self._stats["simulations"] += report["totals"]["steps"]
         return report
 
     def chaos(self, body: Dict[str, Any]) -> Dict[str, Any]:
@@ -837,6 +935,7 @@ def _make_handler(server: SimulationServer):
                       "/api/scale-apps": server.scale_apps,
                       "/api/capacity": server.capacity,
                       "/api/campaign": server.campaign,
+                      "/api/replay": server.replay,
                       "/api/chaos": server.chaos}
             handler_fn = routes.get(self.path)
             if handler_fn is None:

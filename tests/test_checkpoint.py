@@ -186,3 +186,80 @@ def test_pre_round4_checkpoint_loads_and_resumes(tmp_path):
         state=SimState(*[np.asarray(v) for v in state]),
     )
     np.testing.assert_array_equal(np.asarray(full.node)[k:], np.asarray(resumed.node))
+
+
+# ---- slice_pods edge cases under bucketing (the replay hot path) ---------
+
+
+def test_slice_pods_full_range_is_noop():
+    """slice_pods(0, P) must return arrays equal to the originals (every
+    pod-axis field identical, node-axis fields untouched)."""
+    import dataclasses
+
+    snap = ge._synthetic_snapshot(n_nodes=8, n_pods=48)
+    arrs = snap.arrays
+    full = slice_pods(arrs, 0, snap.n_pods)
+    for f in dataclasses.fields(arrs):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(full, f.name)),
+            np.asarray(getattr(arrs, f.name)), err_msg=f.name)
+
+
+def test_slice_pods_empty_slice_schedules_nothing():
+    """A zero-length slice (start == stop) is a well-formed program: the
+    scan runs zero steps, outputs are empty on the pod axis, and the
+    carry passes through unchanged (replay's empty-arrival-batch case)."""
+    snap = ge._synthetic_snapshot(n_nodes=8, n_pods=48)
+    cfg = make_config(snap)
+    arrs = device_arrays(snap)
+    k = 20
+    first = schedule_pods(slice_pods(arrs, 0, k), arrs.active, cfg)
+    empty = slice_pods(arrs, k, k)
+    assert empty.req.shape[0] == 0
+    out = schedule_pods(empty, arrs.active, cfg,
+                        state=SimState(*[np.asarray(v)
+                                         for v in first.state]))
+    assert np.asarray(out.node).shape[0] == 0
+    for a, b in zip(first.state, out.state):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4)
+
+
+def test_slice_pods_across_bucket_pad_boundary():
+    """Slicing a BUCKET-PADDED master across the real/pad boundary: the
+    pad rows are bind-nothing sentinels, so scanning [k, P_pad) equals
+    scanning [k, P) — the replay fast path slices padded masters and
+    must never let a pad row contribute carry or a placement."""
+    from open_simulator_tpu.engine.exec_cache import (
+        bucket_shape,
+        pad_snapshot_arrays,
+    )
+
+    snap = ge._synthetic_snapshot(n_nodes=8, n_pods=48)
+    cfg = make_config(snap)._replace(forced_prefix=0)
+    n_pods = snap.n_pods
+    nb, pb = bucket_shape(snap.n_nodes, n_pods)
+    assert pb > n_pods, "pick a pod count off the bucket boundary"
+    padded = pad_snapshot_arrays(snap.arrays, nb, pb)
+    active = np.zeros(nb, dtype=bool)
+    active[: snap.n_nodes] = np.asarray(snap.arrays.active)
+
+    full = schedule_pods(device_arrays(snap), snap.arrays.active, cfg)
+
+    k = 20
+    first = schedule_pods(slice_pods(padded, 0, k), active, cfg)
+    # the tail slice CROSSES the real/pad boundary: [k, pb)
+    rest = schedule_pods(
+        slice_pods(padded, k, pb), active, cfg,
+        state=SimState(*[np.asarray(v) for v in first.state]))
+    nodes = np.concatenate([np.asarray(first.node),
+                            np.asarray(rest.node)])
+    # real pods match the unpadded full run; pad rows bound nothing
+    np.testing.assert_array_equal(nodes[:n_pods],
+                                  np.asarray(full.node))
+    assert np.all(nodes[n_pods:] < 0)
+    # the final carry's real-node rows match the unpadded run's
+    for name in ("headroom", "group_count"):
+        a = np.asarray(getattr(rest.state, name))[: snap.n_nodes]
+        b = np.asarray(getattr(full.state, name))
+        np.testing.assert_allclose(a, b, atol=1e-4, err_msg=name)

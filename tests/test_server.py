@@ -576,3 +576,134 @@ def test_campaign_endpoint(server_url, tmp_path):
     body = _read_error(ei)
     assert ei.value.code == 400 and body["code"] == "E_BAD_REQUEST"
     assert body["field"] == "max_clusters"
+
+
+# ---- POST /api/replay ----------------------------------------------------
+
+REPLAY_APP_YAML = APP_YAML.replace("newapp", "wave0")
+
+
+def _replay_trace(events=None):
+    return {
+        "events": events if events is not None else [
+            {"t": 0, "kind": "arrive",
+             "app": {"name": "wave0", "yaml": REPLAY_APP_YAML}},
+            {"t": 1, "kind": "kill_node", "target": "s0"},
+            {"t": 2, "kind": "depart", "app": "wave0"},
+        ],
+    }
+
+
+def test_replay_endpoint(server_url):
+    """POST /api/replay end to end through the admission queue: the
+    trajectory report comes back with one row per step, and identical
+    requests return identical digests (determinism over HTTP)."""
+    body = {"cluster": {"yaml": CLUSTER_YAML}, "trace": _replay_trace()}
+    out = _post(server_url + "/api/replay", body)
+    assert out["totals"]["steps"] == 4        # baseline + 3 events
+    assert [s["event"]["kind"] for s in out["steps"]] == [
+        "baseline", "arrive", "kill_node", "depart"]
+    kill = out["steps"][2]
+    assert kill["active_nodes"] == 1 and kill["evicted"]
+    assert out["digest"] == _post(server_url + "/api/replay",
+                                  body)["digest"]
+
+
+def test_replay_endpoint_with_controllers(server_url):
+    big = REPLAY_APP_YAML.replace("replicas: 3", "replicas: 12")
+    out = _post(server_url + "/api/replay", {
+        "cluster": {"yaml": CLUSTER_YAML},
+        "trace": {
+            "events": [{"t": 0, "kind": "arrive",
+                        "app": {"name": "wave0", "yaml": big}}],
+            "max_new_nodes": 4,
+            "node_template": NODE_SPEC_YAML,
+        },
+        "controllers": [{"kind": "autoscaler", "scale_step": 2}],
+    })
+    # 12x2cpu + existing 2x1cpu > 16: the autoscaler must scale to place
+    assert out["totals"]["pending"] == 0
+    assert out["totals"]["scale_ups"] > 0
+
+
+def test_replay_endpoint_validation_400s(server_url):
+    """Malformed/missing event fields and non-monotone timestamps are
+    the CLIENT's error: structured 400 with the field named, never a
+    500 (the int(None) lesson applied to the trace surface)."""
+    cases = [
+        # no trace at all
+        ({"cluster": {"yaml": CLUSTER_YAML}}, "trace"),
+        # empty events
+        ({"cluster": {"yaml": CLUSTER_YAML},
+          "trace": {"events": []}}, "events"),
+        # unknown kind
+        ({"cluster": {"yaml": CLUSTER_YAML},
+          "trace": _replay_trace([{"t": 0, "kind": "meteor",
+                                   "target": "s0"}])},
+         "events[0].kind"),
+        # missing arrive manifest
+        ({"cluster": {"yaml": CLUSTER_YAML},
+          "trace": _replay_trace([{"t": 0, "kind": "arrive",
+                                   "app": {"name": "a"}}])},
+         "events[0].app.yaml"),
+        # app where an object belongs (the AttributeError-500 shape)
+        ({"cluster": {"yaml": CLUSTER_YAML},
+          "trace": _replay_trace([{"t": 0, "kind": "arrive",
+                                   "app": "x"}])},
+         "events[0].app"),
+        # non-monotone timestamps
+        ({"cluster": {"yaml": CLUSTER_YAML},
+          "trace": _replay_trace([
+              {"t": 5, "kind": "arrive",
+               "app": {"name": "a", "yaml": REPLAY_APP_YAML}},
+              {"t": 1, "kind": "kill_node", "target": "s0"}])},
+         "events[1].t"),
+        # non-numeric timestamp
+        ({"cluster": {"yaml": CLUSTER_YAML},
+          "trace": _replay_trace([{"t": "noon", "kind": "kill_node",
+                                   "target": "s0"}])},
+         "events[0].t"),
+        # unknown controller kind
+        ({"cluster": {"yaml": CLUSTER_YAML}, "trace": _replay_trace(),
+          "controllers": [{"kind": "skynet"}]}, "controllers[].kind"),
+    ]
+    for body, field in cases:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server_url + "/api/replay", body)
+        err = _read_error(ei)
+        assert ei.value.code == 400, (body, err)
+        assert err["code"] in ("E_SPEC", "E_BAD_REQUEST"), err
+        assert err["field"] == field, (err, field)
+
+
+def test_replay_endpoint_frontier(server_url):
+    big = REPLAY_APP_YAML.replace("replicas: 3", "replicas: 10")
+    body = {
+        "cluster": {"yaml": CLUSTER_YAML},
+        "trace": {"events": [{"t": 0, "kind": "arrive",
+                              "app": {"name": "wave0", "yaml": big}}]},
+        "frontier": {
+            "specs": [
+                {"name": "small", "cost": 1.0, "max_count": 2,
+                 "spec_yaml": NODE_SPEC_YAML},
+                {"name": "big", "cost": 2.5, "max_count": 1,
+                 "spec_yaml": NODE_SPEC_YAML.replace('"8"', '"32"')},
+            ],
+        },
+    }
+    out = _post(server_url + "/api/replay", body)
+    assert out["n_mixes"] == 6
+    assert out["pareto"], out
+    assert {tuple(p["counts"]) for p in out["pareto"]} <= {
+        tuple(p["counts"]) for p in out["points"]}
+    # bogus frontier knobs are structured 400s
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server_url + "/api/replay",
+              {**body, "frontier": {"specs": [{"name": "x"}]}})
+    assert ei.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server_url + "/api/replay",
+              {**body, "frontier": {**body["frontier"],
+                                    "max_total": "lots"}})
+    err = _read_error(ei)
+    assert ei.value.code == 400 and err["field"] == "frontier.max_total"

@@ -221,21 +221,21 @@ def test_profile_to_noop_without_dir():
 # ---- engine/sched_config rename ------------------------------------------
 
 
-def test_engine_profile_deprecation_shim():
-    import warnings
+def test_engine_profile_shim_is_retired():
+    """The engine/profile.py deprecation shim (left by the PR-3 rename
+    to sched_config.py) is RETIRED: the module must no longer import,
+    and the real module keeps exporting the public names. This test
+    pins the retirement so the shim cannot quietly come back."""
+    import importlib
+
+    import pytest
 
     from open_simulator_tpu.engine import sched_config
 
-    with warnings.catch_warnings(record=True) as w:
-        warnings.simplefilter("always")
-        import importlib
-
-        import open_simulator_tpu.engine.profile as legacy
-
-        importlib.reload(legacy)
-    assert any(issubclass(x.category, DeprecationWarning) for x in w)
-    assert legacy.weight_overrides_from_file is sched_config.weight_overrides_from_file
-    assert legacy.SchedulerConfigError is sched_config.SchedulerConfigError
+    with pytest.raises(ModuleNotFoundError):
+        importlib.import_module("open_simulator_tpu.engine.profile")
+    assert callable(sched_config.weight_overrides_from_file)
+    assert issubclass(sched_config.SchedulerConfigError, Exception)
 
 
 # ---- stack instrumentation ----------------------------------------------

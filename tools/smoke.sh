@@ -126,6 +126,15 @@ if [ "$rc" -ne 0 ]; then
 fi
 
 echo
+echo "== trace replay (chaos mid-trace, autoscaler converges, SIGKILL resume, frontier) =="
+make replay-smoke
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: replay-smoke exited $rc" >&2
+  exit "$rc"
+fi
+
+echo
 echo "== serving lifecycle (SIGTERM drain: readyz flip, 503s, in-flight finishes) =="
 make lifecycle-smoke
 rc=$?
