@@ -3,7 +3,7 @@
 # scenario end to end (tools/smoke.sh).
 
 .PHONY: test lint smoke bench bench-smoke bench-regress lifecycle-smoke \
-	multichip-smoke campaign-smoke replay-smoke
+	multichip-smoke campaign-smoke replay-smoke session-smoke
 
 test:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -60,6 +60,14 @@ campaign-smoke:
 # digest; and the frontier CLI must return a non-trivial Pareto set
 replay-smoke:
 	env JAX_PLATFORMS=cpu python tools/replay_smoke.py
+
+# digital-twin gate (replay/session.py): a journaled session on a real
+# server survives SIGKILL — the restarted server serves it with a
+# BIT-IDENTICAL trajectory digest (also vs an uninterrupted reference
+# run) — and a chaos fork completes / a poisoned fork quarantines while
+# the mainline keeps settling events
+session-smoke:
+	env JAX_PLATFORMS=cpu python tools/session_smoke.py
 
 # regression gate over the run ledger (SIMON_LEDGER_DIR or
 # BENCH_LEDGER_DIR=... make bench-regress): the newest bench record per

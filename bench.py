@@ -209,6 +209,13 @@ PRESETS = {
     # bench-regress like every other shape (the time axis is covered
     # from day one)
     "replay": dict(nodes=16, batches=10, batch_pods=24),
+    # digital-twin session throughput (replay/session.py): a fixed pool
+    # of resident sessions fed timed events round-robin, one settle per
+    # event — events/sec at a fixed session-reuse ratio (every session
+    # encodes once, then settles `batches x events` steps against the
+    # shared bucketed executable), gated by bench-regress like every
+    # other shape
+    "session": dict(sessions=4, nodes=16, batches=6, batch_pods=16),
 }
 
 
@@ -297,6 +304,58 @@ def run_replay_bench(n_nodes: int, n_batches: int, batch_pods: int):
     return dt, report, label
 
 
+def run_session_bench(n_sessions: int, n_nodes: int, n_batches: int,
+                      batch_pods: int):
+    """Time the digital-twin path: ``n_sessions`` resident sessions
+    (created once — the reuse: no re-encode inside the measured loop)
+    fed the same synthetic event sequence round-robin, ONE event per
+    apply, every settle through the controller loop. Reported as
+    events/sec at a fixed session-reuse ratio (events settled per
+    create). No journaling — disk must not be part of the measured
+    loop."""
+    from open_simulator_tpu.replay import (
+        ReplaySession,
+        SessionSpec,
+        synthetic_replay_cluster,
+        synthetic_trace_dict,
+    )
+    from open_simulator_tpu.telemetry import ledger
+
+    td = synthetic_trace_dict(n_batches=n_batches, batch_pods=batch_pods,
+                              max_new_nodes=max(4, n_nodes // 2))
+    spec = SessionSpec(max_new_nodes=td["max_new_nodes"],
+                       node_template=td["node_template"])
+
+    def mk():
+        return ReplaySession.create(
+            synthetic_replay_cluster(n_nodes=n_nodes,
+                                     n_initial_pods=n_nodes),
+            spec, controllers=[{"kind": "autoscaler", "scale_step": 2}],
+            checkpoint=False)
+
+    with ledger.run_capture("bench") as lcap:
+        warm = mk()
+        warm.apply_events(td["events"])  # warm-up: compiles the shape
+        sessions = [mk() for _ in range(n_sessions)]
+        t0 = time.perf_counter()
+        for ev in td["events"]:
+            for s in sessions:
+                s.apply_events([ev])
+        dt = time.perf_counter() - t0
+        n_events = len(td["events"]) * n_sessions
+        label = f"session{n_sessions}s_{n_nodes}n_x{batch_pods}bp"
+        _bench_gauge().labels(shape=label).set(dt)
+        lcap.tag("preset", "session")
+        lcap.tag("shape", label)
+        lcap.tag("seconds", round(dt, 6))
+        lcap.tag("value", round(n_events / dt, 3))
+        lcap.tag("reuse_ratio", len(td["events"]))
+        lcap.tag("trajectory_digest", sessions[0].digest)
+    assert all(s.digest == sessions[0].digest for s in sessions), (
+        "identical sessions fed identical events diverged")
+    return dt, n_events, sessions[0].digest, label
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--preset", choices=sorted(PRESETS), default="default")
@@ -368,6 +427,27 @@ def main():
             "steps": steps,
             "pending_final": report["totals"]["pending"],
             "report_digest": report["digest"],
+        }))
+        return
+    if args.preset == "session":
+        # digital-twin bench: events/sec across a resident session pool
+        # at a fixed session-reuse ratio; the shared trajectory digest
+        # rides along so a regression in EITHER speed or determinism
+        # shows in the tracked line
+        dt, n_events, digest, label = run_session_bench(
+            preset["sessions"], args.nodes or preset["nodes"],
+            preset["batches"], args.pods or preset["batch_pods"])
+        print(json.dumps({
+            "metric": f"session_events_per_sec@{label}",
+            "value": round(n_events / dt, 3),
+            "unit": "events/s",
+            "vs_baseline": 0.0,
+            "baseline": "none_session_path",
+            "preset": "session",
+            "sessions": preset["sessions"],
+            "events": n_events,
+            "reuse_ratio": n_events // preset["sessions"],
+            "trajectory_digest": digest,
         }))
         return
     for k in ("nodes", "pods", "scenarios", "max_new"):

@@ -265,6 +265,73 @@ def audit_result(result) -> AuditReport:
     )
 
 
+def audit_assignment(snap, assign: np.ndarray, active: np.ndarray,
+                     present: Optional[np.ndarray] = None) -> AuditReport:
+    """Audit a raw assignment vector against an encoded snapshot — the
+    trajectory-level variant of ``audit_result`` the digital-twin session
+    engine runs on what-if forks (replay/session.py): ``assign[p]`` is a
+    node index (< 0 = unbound), ``active`` the node liveness mask, and
+    ``present`` masks pods that are live on the trajectory (departed /
+    not-yet-arrived pods are exempt). Checks the same invariant families
+    that matter for a trajectory: every bound pod's node exists and is
+    active, and per-node consumption never exceeds allocatable over every
+    encoded resource column (float64 accumulation). A violating fork is
+    quarantined with ``E_AUDIT`` instead of being reported as a valid
+    what-if answer."""
+    arrs = snap.arrays
+    n_nodes, n_pods = snap.n_nodes, snap.n_pods
+    assign = np.asarray(assign, dtype=np.int64)[:n_pods]
+    active = np.asarray(active, dtype=bool)[:n_nodes]
+    live = (np.ones(n_pods, dtype=bool) if present is None
+            else np.asarray(present, dtype=bool)[:n_pods])
+    violations: List[AuditViolation] = []
+    count = [0]
+
+    bound = live & (assign >= 0)
+    over_idx = bound & (assign >= n_nodes)
+    for pi in np.nonzero(over_idx)[0]:
+        _add(violations, count, "unknown_node", f"pod/{snap.pods[pi].key}",
+             f"bound to node index {int(assign[pi])} but the snapshot "
+             f"has {n_nodes} node(s)")
+    bound = bound & ~over_idx
+    dead = bound & ~active[np.maximum(np.minimum(assign, n_nodes - 1), 0)]
+    for pi in np.nonzero(dead)[0]:
+        _add(violations, count, "inactive_node", f"pod/{snap.pods[pi].key}",
+             f"bound to inactive node "
+             f"{snap.node_names[int(assign[pi])]!r}")
+
+    alloc = np.asarray(arrs.alloc, dtype=np.float64)[:n_nodes]
+    req = np.asarray(arrs.req, dtype=np.float64)[:n_pods]
+    usage = np.zeros_like(alloc)
+    if bound.any():
+        np.add.at(usage, assign[bound], req[bound])
+    limit = alloc * (1.0 + _RTOL) + _ATOL
+    for ni, ri in zip(*np.nonzero(usage > limit)):
+        _add(violations, count, "overcommit",
+             f"node/{snap.node_names[ni]}",
+             f"{snap.resources[ri]} consumption {usage[ni, ri]:g} exceeds "
+             f"allocatable {alloc[ni, ri]:g}")
+
+    def occupancy(res_name: str) -> float:
+        if res_name not in snap.resources:
+            return 0.0
+        ri = snap.resources.index(res_name)
+        tot = float(alloc[active, ri].sum())
+        return 100.0 * float(usage[active, ri].sum()) / tot if tot else 0.0
+
+    return AuditReport(
+        violations=violations,
+        n_violations=count[0],
+        n_pods=int(live.sum()),
+        n_bound=int(bound.sum()),
+        n_active_nodes=int(active.sum()),
+        checks=["binding", "capacity"],
+        cpu_pct=occupancy("cpu"),
+        mem_pct=occupancy("memory"),
+        node_usage=usage,
+    )
+
+
 def format_audit(report: AuditReport, name: str = "") -> str:
     head = f"audit {name}: " if name else "audit: "
     lines = [head + ("PASS" if report.ok

@@ -128,6 +128,9 @@ class CampaignJournal:
                n_clusters: int, surface: str = "campaign"
                ) -> "CampaignJournal":
         os.makedirs(root, exist_ok=True)
+        # shared keep-N-completed policy (resilience/lifecycle.py):
+        # finished campaign journals are bounded, unfinished ones stay
+        lifecycle.prune_journals(root, CAMPAIGN_JOURNAL_SUFFIX)
         campaign_id = uuid.uuid4().hex[:12]
         header = {"kind": "header", "campaign_id": campaign_id,
                   "ts": round(time.time(), 6), "fleet_digest": fleet_dig,

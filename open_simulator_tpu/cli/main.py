@@ -156,6 +156,12 @@ def build_parser() -> argparse.ArgumentParser:
              "server flips /readyz to 503, finishes in-flight work up to "
              "this long (then cancels it cooperatively), writes a final "
              "ledger record, and exits")
+    sp.add_argument(
+        "--max-sessions", type=int, default=8,
+        help="resident digital-twin sessions held in device memory: past "
+             "this the least-recently-touched session drops its device "
+             "state (it stays open in its journal and rehydrates "
+             "transparently on the next touch)")
 
     ch = sub.add_parser(
         "chaos",
@@ -369,6 +375,75 @@ def build_parser() -> argparse.ArgumentParser:
     rp.add_argument("--trace-out", default="",
                     help="write a Chrome-trace JSON timeline of the "
                          "replay's phases")
+
+    sn = sub.add_parser(
+        "session",
+        help="operate digital-twin sessions on a running server: create, "
+             "feed events, interrogate, fork what-ifs, close",
+        description="Client for the server's resident digital-twin "
+                    "sessions (replay/session.py, ARCHITECTURE.md "
+                    "section 15): a session is a journaled live "
+                    "trajectory the server keeps between requests — "
+                    "`create` encodes a cluster once and settles the "
+                    "baseline, `events` appends timed events (one "
+                    "fsynced journal line per settled step; a SIGKILL'd "
+                    "server resumes every open session bit-identically "
+                    "on restart), `status`/`list` interrogate between "
+                    "events, `fork` runs what-if branches (chaos plans, "
+                    "arrival bursts, controller variants) that are "
+                    "quarantined with a structured record if they "
+                    "raise, time out, or fail the placement audit — "
+                    "the mainline is never disturbed — and `close` "
+                    "retires the session. All subcommands talk HTTP to "
+                    "--server.")
+    sn.add_argument("--server", default="http://127.0.0.1:8899",
+                    help="base URL of a running simon-tpu server")
+    sn_sub = sn.add_subparsers(dest="session_command")
+    sn_cr = sn_sub.add_parser(
+        "create", help="create a session (settles the baseline step)")
+    sn_cr.add_argument("--name", default="", help="human-readable label")
+    sn_cr.add_argument("--cluster-yaml", default="", metavar="FILE",
+                       help="multi-doc k8s YAML sent inline as the t=0 "
+                            "cluster (default: the server's own "
+                            "--cluster-config snapshot)")
+    sn_cr.add_argument("--max-new-nodes", type=int, default=0,
+                       help="template-cloned node slots the session may "
+                            "scale into")
+    sn_cr.add_argument("--node-template", default="", metavar="FILE",
+                       help="Node spec YAML the new slots are cloned from")
+    sn_cr.add_argument("--controller", action="append", default=[],
+                       metavar="NAME[:k=v,...]",
+                       help="register a step controller (repeatable), "
+                            "same forms as simon-tpu replay")
+    sn_ls = sn_sub.add_parser("list", help="list open sessions")
+    sn_ls.add_argument("--json", action="store_true")
+    sn_st = sn_sub.add_parser(
+        "status", help="interrogate one session between events")
+    sn_st.add_argument("session", metavar="SESSION_ID")
+    sn_st.add_argument("--placements", action="store_true",
+                       help="include the full node -> pod-keys map")
+    sn_ev = sn_sub.add_parser(
+        "events", help="append + settle timed events from a file")
+    sn_ev.add_argument("session", metavar="SESSION_ID")
+    sn_ev.add_argument("--events", required=True, metavar="FILE",
+                       help="YAML/JSON file holding {events: [{t, kind, "
+                            "...}]} (the ReplayTrace event vocabulary)")
+    sn_fk = sn_sub.add_parser(
+        "fork", help="run a what-if branch off the current step")
+    sn_fk.add_argument("session", metavar="SESSION_ID")
+    sn_fk.add_argument("--events", required=True, metavar="FILE",
+                       help="YAML/JSON file holding the branch's "
+                            "{events: [...]}")
+    sn_fk.add_argument("--name", default="", help="fork label")
+    sn_fk.add_argument("--deadline", type=float, default=0.0,
+                       help="fork step budget in seconds (past it the "
+                            "branch is quarantined E_DEADLINE)")
+    sn_fk.add_argument("--controller", action="append", default=[],
+                       metavar="NAME[:k=v,...]",
+                       help="controller roster for the branch (default: "
+                            "the mainline's, state carried over)")
+    sn_cl = sn_sub.add_parser("close", help="close a session")
+    sn_cl.add_argument("session", metavar="SESSION_ID")
 
     mg = sub.add_parser("migrate", help="plan a defragmentation migration of placed pods")
     mg.add_argument("--cluster-config", required=True, help="cluster YAML dir (with placed pods)")
@@ -628,6 +703,96 @@ def _replay_main(args) -> int:
         return 1
 
 
+def _session_main(args) -> int:
+    """simon-tpu session {create, list, status, events, fork, close}:
+    the digital-twin client — thin HTTP over the server's /api/session
+    surface (sessions are server-resident state; the CLI only asks)."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    base = args.server.rstrip("/")
+
+    def call(method: str, path: str, payload=None):
+        data = None if payload is None else _json.dumps(payload).encode()
+        req = urllib.request.Request(
+            base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=600) as r:
+                return r.status, _json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, _json.loads(e.read())
+            except _json.JSONDecodeError:
+                return e.code, {"error": str(e)}
+
+    if not args.session_command:
+        print("error: pick a subcommand: session {create, list, status, "
+              "events, fork, close}", file=sys.stderr)
+        return 2
+    try:
+        if args.session_command == "create":
+            body = {"name": args.name, "spec": {
+                "max_new_nodes": args.max_new_nodes}}
+            if args.node_template:
+                with open(args.node_template, encoding="utf-8") as f:
+                    body["spec"]["node_template"] = f.read()
+            if args.cluster_yaml:
+                with open(args.cluster_yaml, encoding="utf-8") as f:
+                    body["cluster"] = {"yaml": f.read()}
+            if args.controller:
+                from open_simulator_tpu.replay import controller_from_arg
+
+                body["controllers"] = [controller_from_arg(a).spec_dict()
+                                       for a in args.controller]
+            status, out = call("POST", "/api/session", body)
+        elif args.session_command == "list":
+            status, out = call("GET", "/api/session")
+            if status == 200 and not args.json:
+                rows = out.get("sessions") or []
+                print(f"{len(rows)} open session(s) "
+                      f"(max resident {out.get('max_resident')})")
+                for s in rows:
+                    print(f"  {s['session_id']}  steps={s['steps']:<4} "
+                          f"placed={s['placed']:<5} pending={s['pending']:<4} "
+                          f"{'resident' if s['resident'] else 'on-disk '} "
+                          f"digest={s['digest']}  {s.get('name', '')}")
+                return 0
+        elif args.session_command == "status":
+            q = "?placements=1" if args.placements else ""
+            status, out = call("GET", f"/api/session/{args.session}{q}")
+        elif args.session_command == "events":
+            doc = _load_trace_file(args.events)
+            status, out = call(
+                "POST", f"/api/session/{args.session}/events",
+                {"events": doc.get("events")})
+        elif args.session_command == "fork":
+            doc = _load_trace_file(args.events)
+            body = {"events": doc.get("events")}
+            if args.name:
+                body["name"] = args.name
+            if args.deadline > 0:
+                body["deadline_s"] = args.deadline
+            if args.controller:
+                from open_simulator_tpu.replay import controller_from_arg
+
+                body["controllers"] = [controller_from_arg(a).spec_dict()
+                                       for a in args.controller]
+            status, out = call(
+                "POST", f"/api/session/{args.session}/fork", body)
+        else:  # close
+            status, out = call("DELETE", f"/api/session/{args.session}")
+    except SimulationError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except (OSError, urllib.error.URLError) as e:
+        print(f"error: cannot reach {base}: {e}", file=sys.stderr)
+        return 1
+    print(_json.dumps(out, indent=2, sort_keys=True))
+    return 0 if status < 400 else 1
+
+
 def main(argv=None) -> int:
     _init_logging()
     parser = build_parser()
@@ -659,6 +824,9 @@ def main(argv=None) -> int:
 
     if args.command == "replay":
         return _replay_main(args)
+
+    if args.command == "session":
+        return _session_main(args)
 
     if args.command == "lint":
         # analysis/ is pure-AST stdlib: linting never imports jax or the
@@ -808,6 +976,7 @@ def main(argv=None) -> int:
             ledger_dir=args.ledger_dir,
             queue_depth=args.queue_depth,
             drain_timeout_s=args.drain_timeout,
+            max_sessions=args.max_sessions,
         )
 
     if args.command == "gen-doc":

@@ -16,7 +16,7 @@ emitted per pod — the host formats the scheduler's familiar
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, NamedTuple, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -316,15 +316,47 @@ def apply_forced_prefix(arrs: SnapshotArrays, cfg: EngineConfig,
     return state
 
 
+def apply_forced_mask(arrs: SnapshotArrays, cfg: EngineConfig,
+                      state: SimState, mask: jnp.ndarray) -> SimState:
+    """Fold EVERY masked pod's forced-bind carry contribution into the
+    state, wherever the pod sits in the scan order — the prefix hoist
+    generalized to an arbitrary (traced) pin mask. The replay/session
+    engines need this: a trajectory step pins already-placed pods via
+    the forced column, but evicted pods sitting EARLIER in pod order
+    would otherwise be scanned against headroom that later pinned pods
+    have not consumed yet — a physically impossible overcommit the
+    placement auditor rightly rejects. Exactness matches
+    ``apply_forced_prefix`` (0/1 weights, integer-valued requests,
+    Precision.HIGHEST); callers gate it the same way make_config gates
+    the prefix (no order-dependent gpu/storage/WFC/shared-volume
+    carries among pods that can ever be pinned)."""
+    n = arrs.forced_node.shape[0]
+    wt = mask.astype(jnp.float32)
+    for start in range(0, n, _PREFIX_CHUNK):
+        hi = min(start + _PREFIX_CHUNK, n)
+        state = _apply_prefix_chunk(arrs, cfg, state, start, hi,
+                                    wt=wt[start:hi])
+    return state
+
+
 def _apply_prefix_chunk(arrs: SnapshotArrays, cfg: EngineConfig,
-                        state: SimState, lo: int, hi: int) -> SimState:
+                        state: SimState, lo: int, hi: int,
+                        wt: Optional[jnp.ndarray] = None) -> SimState:
+    # wt [c] is the masked-fold weighting (1 = fold this pod, 0 = skip);
+    # None is the prefix path where every pod in [lo, hi) folds
     f32 = jnp.float32
     hp = jax.lax.Precision.HIGHEST
     idx = arrs.forced_node[lo:hi].astype(jnp.int32)       # [c], all >= 0
+    if wt is not None:
+        idx = jnp.maximum(idx, 0)  # unpinned rows are zero-weighted
     oh = jax.nn.one_hot(idx, arrs.alloc.shape[0], dtype=f32)   # [c, N]
+    if wt is not None:
+        oh = oh * wt[:, None]
     headroom = state.headroom - jnp.matmul(oh.T, arrs.req[lo:hi], precision=hp)
     gc = state.group_count
     match = arrs.match_groups[lo:hi].astype(f32)
+    if wt is not None:
+        match = match * wt[:, None]
     if cfg.needs_group_count:
         gc = gc + jnp.matmul(oh.T, match, precision=hp).astype(gc.dtype)
     dom = state.dom_count
@@ -345,11 +377,12 @@ def _apply_prefix_chunk(arrs: SnapshotArrays, cfg: EngineConfig,
     if cfg.enable_anti_affinity or cfg.enable_pref:
         # sd_all[key][pod, node]: nodes sharing pod i's bound node's domain
         k1 = arrs.topo_onehot.shape[0]
-        sd_all = [oh]  # hostname
+        sd_all = [oh]  # hostname (already zero-rowed under wt)
         for kk in range(k1):
-            sd_all.append(jnp.matmul(
+            sd = jnp.matmul(
                 jnp.take(arrs.topo_onehot[kk], idx, axis=0),
-                arrs.topo_onehot[kk].T, precision=hp))    # [c, N]
+                arrs.topo_onehot[kk].T, precision=hp)     # [c, N]
+            sd_all.append(sd if wt is None else sd * wt[:, None])
     if cfg.enable_anti_affinity:
         own = arrs.own_terms[lo:hi].astype(f32)           # [c, T]
         paint = jnp.zeros((state.headroom.shape[0], own.shape[1]), f32)
@@ -1357,7 +1390,8 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("cfg", "state_is_fresh", "waves"),
+                   static_argnames=("cfg", "state_is_fresh", "waves",
+                                    "hoist_forced"),
                    donate_argnames=("state",))
 def schedule_pods(
     arrs: SnapshotArrays,
@@ -1368,6 +1402,7 @@ def schedule_pods(
     nominated: jnp.ndarray | None = None,
     state_is_fresh: bool = False,
     waves=None,
+    hoist_forced: bool = False,
 ) -> ScheduleOutput:
     """Scan the pod sequence, return assignments + reason counts + final state.
 
@@ -1401,15 +1436,38 @@ def schedule_pods(
     # wave plan the plan's own `start` governs: zero when the plan's
     # forced segments subsume the hoist, the hoist prefix when failure
     # accounting needs its zero-diagnostics convention preserved.
+    # hoist_forced: fold EVERY forced-bind pod (wherever it sits in pod
+    # order) into the init state before the scan — the replay/session
+    # pinning semantics, where evicted pods earlier in pod order must
+    # see the consumption of pinned pods later in it. Subsumes the
+    # prefix hoist; same exactness preconditions (fresh state, no
+    # preemption columns, no extensions — callers also gate on no
+    # order-dependent gpu/storage/WFC carries among pinnable pods).
+    hoist = (hoist_forced and waves is None and disabled is None
+             and nominated is None and not cfg.extensions
+             and (state is None or state_is_fresh))
     if waves is not None:
         k = min(waves.start, n_pods)
     else:
         k = min(cfg.forced_prefix, n_pods)
         if k and ((state is not None and not state_is_fresh)
-                  or disabled is not None or nominated is not None):
+                  or disabled is not None or nominated is not None
+                  or hoist):
             k = 0
     if state is None:
         state = init_state(arrs, cfg)
+    pin_mask = None
+    if hoist:
+        import dataclasses
+
+        orig_forced = arrs.forced_node.astype(jnp.int32)
+        pin_mask = orig_forced >= 0
+        state = apply_forced_mask(arrs, cfg, state, pin_mask)
+        # pinned pods become -4 bind-nothing sentinels for the scan (no
+        # double consumption, zero carry effect); their predetermined
+        # node is restored on the output below
+        arrs = dataclasses.replace(arrs, forced_node=jnp.where(
+            pin_mask, jnp.int32(-4), orig_forced))
     if k:
         state = apply_forced_prefix(arrs, cfg, state, k)
         scan_arrs = slice_pods(arrs, k, n_pods)
@@ -1490,6 +1548,10 @@ def schedule_pods(
             [jnp.zeros((k, topk_score.shape[1]), jnp.float32), topk_score])
         topk_parts = jnp.concatenate(
             [jnp.zeros((k,) + topk_parts.shape[1:], jnp.float32), topk_parts])
+    if pin_mask is not None:
+        # hoisted pins scanned as sentinels: restore their predetermined
+        # node (the forced-bind fast path's output)
+        nodes = jnp.where(pin_mask, orig_forced, nodes)
     if not cfg.fail_reasons:
         # keep the output contract ([P, OPS]) without paying a per-step
         # accounting pass or a materialized scan output

@@ -40,6 +40,12 @@ from open_simulator_tpu.replay.report import (  # noqa: F401
     build_report,
     format_report,
 )
+from open_simulator_tpu.replay.session import (  # noqa: F401
+    ReplaySession,
+    SessionJournal,
+    SessionSpec,
+    SessionStore,
+)
 from open_simulator_tpu.replay.synthetic import (  # noqa: F401
     synthetic_frontier_specs,
     synthetic_replay_cluster,

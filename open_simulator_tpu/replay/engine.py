@@ -147,6 +147,9 @@ class ReplayJournal:
                controller_specs: List[Dict[str, Any]],
                surface: str = "replay") -> "ReplayJournal":
         os.makedirs(root, exist_ok=True)
+        # bounded-disk tax on every new replay: completed journals past
+        # the shared keep cap go, resumable (unfinished) ones stay
+        lifecycle.prune_journals(root, REPLAY_JOURNAL_SUFFIX)
         replay_id = uuid.uuid4().hex[:12]
         header = {"kind": "header", "replay_id": replay_id,
                   "ts": round(time.time(), 6), "fingerprint": fingerprint,
@@ -305,7 +308,10 @@ class _Program:
             expand_cluster_pods,
         )
 
-        trace.validate()
+        # allow_empty: a session program starts from a bare baseline
+        # trajectory; the non-session surfaces (CLI/REST/run_replay's
+        # callers) reject empty traces before ever building a program
+        trace.validate(allow_empty=True)
         nodes = [make_valid_node(n) for n in cluster.nodes]
         if not nodes:
             raise SimulationError(
@@ -384,6 +390,23 @@ class _Program:
         self.base_forced = np.array(
             np.asarray(self.snapshot.arrays.forced_node), dtype=np.int32,
             copy=True)
+        # pinned-consumption hoist (scheduler.apply_forced_mask): every
+        # full step folds ALL pinned pods into the init carry so evicted
+        # pods earlier in pod order see true headroom — exact only when
+        # no pod that could ever be pinned carries an order-dependent
+        # gpu/storage/WFC/shared-volume contribution (the make_config
+        # prefix gate, applied over the whole universe)
+        a = self.snapshot.arrays
+        self.hoist_forced = not (
+            bool(self.cfg.extensions)
+            or (self.cfg.enable_gpu
+                and bool(np.any(np.asarray(a.gpu_cnt) > 0)))
+            or (self.cfg.enable_storage
+                and bool(np.any(np.asarray(a.lvm_req) > 0)
+                         or np.any(np.asarray(a.sdev_req) > 0)))
+            or bool(np.any(np.asarray(a.wfc_valid)))
+            or (bool(np.any(np.asarray(a.svol_id) >= 0))
+                and bool(np.any(np.asarray(a.vol_limit_cap) < 1e9))))
 
     def fingerprint(self, controllers) -> Dict[str, Any]:
         from open_simulator_tpu.telemetry import ledger
@@ -474,7 +497,8 @@ class _World:
             forced_node=jnp.asarray(self._forced_pad(
                 self.step_forced() if forced is None else forced)))
         out = schedule_pods(arrs, jnp.asarray(self._active_pad()),
-                            cfg or prog.cfg)
+                            cfg or prog.cfg,
+                            hoist_forced=prog.hoist_forced)
         self.carry = out.state
         return np.asarray(out.node)[: prog.P]
 
@@ -694,6 +718,68 @@ def _controller_loop(world: _World, controllers, step: int, t: float,
     return actions, iters, converged
 
 
+# ---- one settled step ----------------------------------------------------
+
+
+def settle_step(prog: "_Program", world: "_World", controllers, ev: TraceEvent,
+                step: int, *, fast_path: bool = True,
+                max_control_iters: int = 8) -> Dict[str, Any]:
+    """Apply ONE event to the trajectory and settle it: event mutation,
+    the defining scan (or the carry fast path when its exactness
+    preconditions hold), then the controller loop to convergence.
+    Returns the JSON-native journal-schema row. Shared verbatim by
+    ``run_replay`` (the closed-trace loop) and ``replay/session.py``
+    (resident digital-twin sessions) so both surfaces settle steps with
+    bit-identical semantics."""
+    steps_total, events_total, actions_total = _metrics()
+    had_pending = bool(np.any(world.present & (world.bound == -1)))
+    detail = _apply_event(world, ev)
+    events_total.labels(kind=ev.kind).inc()
+    if ev.kind == "arrive":
+        start, stop = prog.batch_ranges[ev.app["name"]]
+    else:
+        start = stop = 0
+    fast_ok = (
+        fast_path and ev.kind == "arrive"
+        and world.carry is not None and not had_pending
+        and stop > start and prog.cfg.tie_break_seed == 0
+        and not prog.cfg.extensions)
+    if fast_ok:
+        world.update_bound(world.slice_scan(start, stop),
+                           lo=start, hi=stop)
+        steps_total.labels(path="slice").inc()
+    elif ev.kind == "arrive" and stop == start:
+        steps_total.labels(path="noop").inc()  # empty batch
+    else:
+        world.update_bound(world.full_scan())
+        steps_total.labels(path="full").inc()
+    actions, iters, converged = _controller_loop(
+        world, controllers, step, ev.t, ev.kind, max_control_iters)
+    for a in actions:
+        actions_total.labels(controller=a["controller"],
+                             action=a["kind"]).inc()
+    placed, pending, lost = world.counts()
+    cpu_pct, mem_pct = world.occupancy()
+    return {
+        "step": step,
+        "t": float(ev.t),
+        "event": ({"kind": BASELINE_KIND, "t": float(ev.t)}
+                  if ev.kind == BASELINE_KIND else ev.row_dict()),
+        "placed": placed, "pending": pending, "lost": lost,
+        "active_nodes": int(np.sum(world.active)),
+        "evicted": detail["evicted"],
+        "event_nodes": detail["nodes"],
+        "actions": actions,
+        "iters": int(iters),
+        "converged": bool(converged),
+        "cpu_pct": round(float(cpu_pct), 3),
+        "mem_pct": round(float(mem_pct), 3),
+        "assign": [int(b) for b in world.bound],
+        "active": [int(a) for a in world.active],
+        "controllers": {c.name: c.state_dict() for c in controllers},
+    }
+
+
 # ---- the replay ----------------------------------------------------------
 
 
@@ -735,7 +821,6 @@ def run_replay(cluster, trace: ReplayTrace,
     t0 = time.perf_counter()
     prog = _Program(cluster, trace, opts)
     world = _World(prog)
-    steps_total, events_total, actions_total = _metrics()
 
     fingerprint = prog.fingerprint(controllers)
     root = lifecycle.checkpoint_dir()
@@ -795,58 +880,14 @@ def run_replay(cluster, trace: ReplayTrace,
                 "replay", tags={"replay": replay_id, "step": step,
                                 "t": float(ev.t), "event": ev.kind}) as cap:
             with span("replay.step", step=step, event=ev.kind):
-                had_pending = bool(np.any(world.present
-                                          & (world.bound == -1)))
-                detail = _apply_event(world, ev)
-                events_total.labels(kind=ev.kind).inc()
-                if ev.kind == "arrive":
-                    start, stop = prog.batch_ranges[ev.app["name"]]
-                else:
-                    start = stop = 0
-                fast_ok = (
-                    opts.fast_path and ev.kind == "arrive"
-                    and world.carry is not None and not had_pending
-                    and stop > start and prog.cfg.tie_break_seed == 0
-                    and not prog.cfg.extensions)
-                if fast_ok:
-                    world.update_bound(world.slice_scan(start, stop),
-                                       lo=start, hi=stop)
-                    steps_total.labels(path="slice").inc()
-                elif ev.kind == "arrive" and stop == start:
-                    steps_total.labels(path="noop").inc()  # empty batch
-                else:
-                    world.update_bound(world.full_scan())
-                    steps_total.labels(path="full").inc()
-                actions, iters, converged = _controller_loop(
-                    world, controllers, step, ev.t, ev.kind,
-                    opts.max_control_iters)
-                for a in actions:
-                    actions_total.labels(controller=a["controller"],
-                                         action=a["kind"]).inc()
-            placed, pending, lost = world.counts()
-            cpu_pct, mem_pct = world.occupancy()
-            row = {
-                "step": step,
-                "t": float(ev.t),
-                "event": ({"kind": BASELINE_KIND, "t": float(ev.t)}
-                          if ev.kind == BASELINE_KIND else ev.row_dict()),
-                "placed": placed, "pending": pending, "lost": lost,
-                "active_nodes": int(np.sum(world.active)),
-                "evicted": detail["evicted"],
-                "event_nodes": detail["nodes"],
-                "actions": actions,
-                "iters": int(iters),
-                "converged": bool(converged),
-                "cpu_pct": round(float(cpu_pct), 3),
-                "mem_pct": round(float(mem_pct), 3),
-                "assign": [int(b) for b in world.bound],
-                "active": [int(a) for a in world.active],
-                "controllers": {c.name: c.state_dict()
-                                for c in controllers},
-            }
+                row = settle_step(prog, world, controllers, ev, step,
+                                  fast_path=opts.fast_path,
+                                  max_control_iters=opts.max_control_iters)
             if cap.recording:
                 cap.set_config(prog.cfg, snapshot=prog.snapshot)
-                cap.set_result_info(placed, pending + lost, row_digest(row))
+                cap.set_result_info(row["placed"],
+                                    row["pending"] + row["lost"],
+                                    row_digest(row))
         rows.append(row)
         if journal is not None:
             journal.append_step(row)

@@ -1,0 +1,1121 @@
+"""Resident digital-twin replay sessions (ARCHITECTURE.md §15).
+
+Replay (engine.py) runs a CLOSED trace end to end and exits. A capacity
+team operating a live cluster wants the opposite: a *persistent*
+trajectory they feed events into as the day unfolds and interrogate
+between events. This module makes that long-lived state **unkillable**:
+
+* **Sessions.** ``ReplaySession.create`` encodes the cluster once and
+  settles the baseline step (the cluster's own pods) on the bucketed
+  scan; ``apply_events`` appends timed events and settles each through
+  the exact ``settle_step`` the trace replay uses — same scan, same
+  controller loop, same journal-schema rows. The carry stays
+  device-resident across chaos/depart/node events and controller
+  iterations; an arrival batch grows the encoded universe (a host-side
+  re-encode into the same node axis) and takes the defining full scan,
+  which is the fast path's own exactness definition — results never
+  depend on when the universe grew.
+
+* **Crash safety.** Every settled step is one fsynced journal line
+  (event + row) under ``<checkpoint dir>/<id>.session.jsonl``. A
+  SIGKILL'd or drained server restarts, ``SessionStore.scan`` finds the
+  open journals, and the first touch rehydrates: cluster rebuilt from
+  the header's serialized docs, trajectory state restored from the last
+  settled row, controllers from their journaled ``state_dict`` — the
+  continued trajectory digest is BIT-IDENTICAL to an uninterrupted
+  session (the replay resume argument: the step semantics are DEFINED
+  by the full scan over the restored binding table). Sessions evicted
+  under the resident cap (LRU, ``--max-sessions``) drop device and
+  program state but stay open on disk and rehydrate transparently on
+  the next touch.
+
+* **Fork isolation.** ``fork`` runs what-if branches (chaos plans,
+  arrival bursts, controller variants) from the current step against
+  the SAME bucketed executable — a fork's scans ask the engine the same
+  shape/config question the mainline asks, so the jit/AOT caches answer
+  them with zero new compiles (asserted via
+  ``simon_compile_cache_total``). A fork owns copies of the host
+  binding tables and starts with a fresh carry (the donated-state
+  contract means sharing the mainline's carry would destroy it), so a
+  fork that raises, blows its deadline, or violates the placement
+  auditor (``campaign/audit.py:audit_assignment``) is QUARANTINED with
+  a structured error record — the PR-8 taxonomy — while the mainline
+  and sibling forks continue untouched.
+
+Concurrency contract (resilience/lifecycle.py): event POSTs serialize
+per session through the single-flight admission queue; interrogation and
+lazy rehydration take the store's per-session ``KeyedMutex``, so reads
+on one session proceed concurrently with the worker settling another.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from open_simulator_tpu.errors import SimulationError
+from open_simulator_tpu.replay.controllers import (
+    controller_from_dict,
+    controllers_digest,
+)
+from open_simulator_tpu.replay.engine import (
+    ReplayOptions,
+    _Program,
+    _World,
+    row_digest,
+    rows_digest,
+    settle_step,
+)
+from open_simulator_tpu.replay.trace import (
+    BASELINE_KIND,
+    ReplayTrace,
+    TraceEvent,
+)
+from open_simulator_tpu.resilience import lifecycle
+
+_log = logging.getLogger(__name__)
+
+SESSION_JOURNAL_SUFFIX = ".session.jsonl"
+# session ids become journal filenames: path separators / dots must
+# never reach os.path.join (created ids are uuid4 hex prefixes)
+_SID_RE = re.compile(r"[A-Za-z0-9_-]{1,64}")
+# structured-error code for "no such session" (REST maps it to 404)
+E_NO_SESSION = "E_NO_SESSION"
+DEFAULT_MAX_RESIDENT = 8
+# fork step budget: a what-if request is an interactive question, not a
+# campaign — cap the branch length so one fork cannot wedge the worker
+MAX_FORK_EVENTS = 256
+
+
+def _spec_err(message: str, field_name: str, hint: str = "") -> SimulationError:
+    return SimulationError(message, code="E_SPEC", ref="session",
+                           field=field_name, hint=hint)
+
+
+def _session_metrics():
+    from open_simulator_tpu import telemetry
+
+    return (
+        telemetry.gauge("simon_session_open",
+                        "digital-twin sessions open (resident + on-disk)"),
+        telemetry.gauge("simon_session_resident",
+                        "digital-twin sessions holding device state"),
+        telemetry.counter("simon_session_events_total",
+                          "events settled into sessions, by kind",
+                          labelnames=("kind",)),
+        telemetry.counter("simon_session_forks_total",
+                          "what-if forks run against sessions, by outcome",
+                          labelnames=("outcome",)),
+        telemetry.counter("simon_session_rehydrations_total",
+                          "sessions rehydrated from their journal"),
+        telemetry.counter("simon_session_evictions_total",
+                          "resident sessions evicted under the LRU cap"),
+    )
+
+
+# ---- the session spec ----------------------------------------------------
+
+
+class SessionSpec:
+    """The headroom envelope a session may scale into — the trace-level
+    knobs (max_new_nodes / node_template / zone_key) fixed at create
+    time so the node axis never changes for the session's lifetime."""
+
+    def __init__(self, max_new_nodes: int = 0, node_template: str = "",
+                 zone_key: str = "", fast_path: bool = True,
+                 max_control_iters: int = 8,
+                 config_overrides: Optional[Dict[str, Any]] = None):
+        from open_simulator_tpu.resilience.chaos import ZONE_KEY_DEFAULT
+
+        self.max_new_nodes = int(max_new_nodes)
+        self.node_template = str(node_template or "")
+        self.zone_key = str(zone_key or ZONE_KEY_DEFAULT)
+        self.fast_path = bool(fast_path)
+        self.max_control_iters = max(1, int(max_control_iters))
+        self.config_overrides = dict(config_overrides or {})
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "SessionSpec":
+        d = d or {}
+        if not isinstance(d, dict):
+            raise _spec_err(
+                f"spec must be an object, got {type(d).__name__}", "spec",
+                hint='{"spec": {"max_new_nodes": 4, "node_template": '
+                     '"<Node yaml>"}}')
+        raw_max = d.get("max_new_nodes", 0)
+        try:
+            max_new = int(raw_max)
+        except (TypeError, ValueError):
+            raise _spec_err(
+                f"spec.max_new_nodes must be an integer, got {raw_max!r}",
+                "spec.max_new_nodes") from None
+        if max_new < 0:
+            raise _spec_err(
+                f"spec.max_new_nodes must be >= 0, got {max_new}",
+                "spec.max_new_nodes")
+        tmpl = d.get("node_template") or ""
+        if isinstance(tmpl, dict):  # {"spec_yaml": "..."} REST convenience
+            tmpl = tmpl.get("spec_yaml") or ""
+        if max_new > 0 and not str(tmpl).strip():
+            raise _spec_err(
+                "spec.max_new_nodes > 0 needs a node_template (a Node "
+                "spec YAML the new slots are cloned from)",
+                "spec.node_template")
+        raw_iters = d.get("max_control_iters", 8)
+        try:
+            iters = int(raw_iters)
+        except (TypeError, ValueError):
+            raise _spec_err(
+                f"spec.max_control_iters must be an integer, got "
+                f"{raw_iters!r}", "spec.max_control_iters") from None
+        overrides = d.get("config_overrides") or {}
+        if not isinstance(overrides, dict):
+            raise _spec_err(
+                f"spec.config_overrides must be an object, got "
+                f"{type(overrides).__name__}", "spec.config_overrides")
+        return cls(max_new_nodes=max_new, node_template=str(tmpl),
+                   zone_key=str(d.get("zone_key") or ""),
+                   fast_path=bool(d.get("fast_path", True)),
+                   max_control_iters=iters, config_overrides=overrides)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"max_new_nodes": self.max_new_nodes,
+                "node_template": self.node_template,
+                "zone_key": self.zone_key,
+                "fast_path": self.fast_path,
+                "max_control_iters": self.max_control_iters,
+                "config_overrides": dict(self.config_overrides)}
+
+
+def cluster_docs(cluster) -> List[Dict[str, Any]]:
+    """Serialize a ClusterResources to JSON-native k8s docs (each object
+    keeps its original ``raw`` dict). The session journal header stores
+    these so rehydration rebuilds the EXACT cluster without touching the
+    original --cluster-config path (which may have changed or vanished
+    by restart time)."""
+    from open_simulator_tpu.k8s.loader import ClusterResources
+
+    docs: List[Dict[str, Any]] = []
+    for kind, attr in ClusterResources._FIELD_BY_KIND.items():
+        for obj in getattr(cluster, attr):
+            d = dict(obj.raw) if getattr(obj, "raw", None) else {}
+            d.setdefault("kind", kind)
+            if not d.get("metadata"):
+                d["metadata"] = {"name": obj.meta.name,
+                                 "namespace": obj.meta.namespace}
+            docs.append(d)
+    return docs
+
+
+def cluster_from_docs(docs: List[Dict[str, Any]]):
+    """Rebuild the ClusterResources a session was created against."""
+    from open_simulator_tpu.k8s.loader import ClusterResources, demux_object
+
+    res = ClusterResources()
+    for d in docs:
+        demux_object(d, res)
+    return res
+
+
+def _docs_digest(docs: List[Dict[str, Any]]) -> str:
+    import hashlib
+
+    return hashlib.sha256(
+        json.dumps(docs, sort_keys=True).encode()).hexdigest()[:16]
+
+
+# ---- journal -------------------------------------------------------------
+
+
+class SessionJournal:
+    """Append-only per-session settlement log, §11-shaped:
+
+      {"kind": "header", "session_id", "ts", "name", "fingerprint",
+       "cluster_docs": [...], "spec": {...}, "controllers": [...],
+       "surface"}
+      {"kind": "step", "event": {...full event, manifests included...},
+       "row": {...journal-schema row...}}
+      {"kind": "fork", "row": {...fork record (no step rows)...}}
+      {"kind": "close", "digest", "steps"}
+
+    A step line is appended only when the step SETTLED (event applied,
+    controllers converged, outputs hosted) and fsynced — a SIGKILL'd
+    server rehydrates every open session from its settled prefix. The
+    header carries the serialized cluster + spec + controller roster, so
+    a journal is fully self-contained: nothing else must survive the
+    crash. Unwritable-dir degrade matches SweepJournal: one warning,
+    journaling off, the session continues (it just stops being
+    crash-safe past the last settled line)."""
+
+    def __init__(self, path: str, header: Dict[str, Any],
+                 steps: Optional[List[Dict[str, Any]]] = None,
+                 forks: Optional[List[Dict[str, Any]]] = None,
+                 closed: Optional[Dict[str, Any]] = None):
+        self.path = path
+        self.header = header
+        self.steps = steps or []       # [{"event": ..., "row": ...}]
+        self.forks = forks or []       # [fork record]
+        self.closed = closed
+        self.broken = False
+
+    @property
+    def session_id(self) -> str:
+        return self.header["session_id"]
+
+    @classmethod
+    def create(cls, root: str, session_id: str, name: str,
+               fingerprint: Dict[str, Any], docs: List[Dict[str, Any]],
+               spec: SessionSpec, controller_specs: List[Dict[str, Any]],
+               surface: str = "session") -> "SessionJournal":
+        os.makedirs(root, exist_ok=True)
+        # bounded-disk tax: CLOSED session journals past the shared keep
+        # cap go; open sessions are live state and are never pruned
+        lifecycle.prune_journals(root, SESSION_JOURNAL_SUFFIX)
+        header = {"kind": "header", "session_id": session_id,
+                  "ts": round(time.time(), 6), "name": name,
+                  "fingerprint": fingerprint, "cluster_docs": docs,
+                  "spec": spec.to_dict(), "controllers": controller_specs,
+                  "surface": surface}
+        journal = cls(
+            os.path.join(root, session_id + SESSION_JOURNAL_SUFFIX), header)
+        journal._append(header)
+        return journal
+
+    @classmethod
+    def load(cls, path: str) -> "SessionJournal":
+        header, steps, forks, closed = None, [], [], None
+        try:
+            f = open(path, "r", encoding="utf-8")
+        except OSError as e:
+            raise SimulationError(
+                f"session journal {path} is unreadable: {e}",
+                code=E_NO_SESSION, ref="session") from None
+        with f:
+            for ln in f:
+                try:
+                    rec = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue  # torn line from the crash
+                kind = rec.get("kind")
+                if kind == "header":
+                    header = rec
+                elif kind == "step":
+                    steps.append({"event": rec.get("event"),
+                                  "row": rec["row"]})
+                elif kind == "fork":
+                    forks.append(rec["row"])
+                elif kind == "close":
+                    closed = rec
+        if header is None:
+            raise lifecycle.ResumeError(
+                f"session journal {os.path.basename(path)} has no header "
+                f"line", ref="session")
+        return cls(path, header, steps, forks, closed)
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        if self.broken:
+            return
+        line = json.dumps(rec, sort_keys=True) + "\n"
+        try:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(line)
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as e:
+            self.broken = True
+            _log.warning(
+                "session journal %s is unwritable (%s); journaling "
+                "disabled for the rest of this session — it cannot be "
+                "rehydrated past the last settled step", self.path, e)
+
+    def append_step(self, event: Dict[str, Any], row: Dict[str, Any]) -> None:
+        self._append({"kind": "step", "event": event, "row": row})
+        self.steps.append({"event": event, "row": row})
+
+    def append_fork(self, record: Dict[str, Any]) -> None:
+        self._append({"kind": "fork", "row": record})
+        self.forks.append(record)
+
+    def close(self, digest: str, steps: int) -> None:
+        rec = {"kind": "close", "digest": digest, "steps": int(steps)}
+        self._append(rec)
+        self.closed = rec
+
+
+# ---- the session ---------------------------------------------------------
+
+
+class ReplaySession:
+    """One resident trajectory. Host state (``rows``, the event history,
+    fork records) always lives in memory once loaded; program + world
+    (the encoded universe and device carry) exist only while the session
+    is RESIDENT — ``evict`` drops them, ``_ensure_resident`` rebuilds
+    them from the journal-backed history. All public methods assume the
+    caller holds the store's per-session mutex (or owns the session
+    exclusively, as tests and bench do)."""
+
+    def __init__(self, session_id: str, name: str,
+                 docs: List[Dict[str, Any]], spec: SessionSpec,
+                 controller_specs: List[Dict[str, Any]],
+                 journal: Optional[SessionJournal],
+                 surface: str = "session"):
+        self.session_id = session_id
+        self.name = name or session_id
+        self.spec = spec
+        self.surface = surface
+        self.journal = journal
+        self.created_ts = time.time()
+        self.last_touch = time.monotonic()
+        self.closed = False
+        self._docs = docs
+        self._controller_specs = list(controller_specs)
+        self._events: List[TraceEvent] = []
+        # width of the SETTLED pod universe (cluster + settled arrival
+        # batches): journal rows truncate their assign column to it so
+        # the trajectory digest is invariant to how events were batched
+        # across POSTs (apply_events grows the program for its whole
+        # batch up front; the transient tail is base sentinels)
+        self._settled_width: Optional[int] = None
+        self.rows: List[Dict[str, Any]] = []
+        self.forks: List[Dict[str, Any]] = []
+        self._fork_seq = 0
+        # resident state (None while evicted / hollow)
+        self._prog: Optional[_Program] = None
+        self._world: Optional[_World] = None
+        self._controllers: Optional[List[Any]] = None
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def create(cls, cluster, spec: Optional[SessionSpec] = None,
+               controllers: Optional[List[Dict[str, Any]]] = None,
+               name: str = "", root: Optional[str] = None,
+               checkpoint: Optional[bool] = None,
+               surface: str = "session") -> "ReplaySession":
+        """Create a session: serialize the cluster, build the program,
+        settle the baseline step (the cluster's own pods), journal it.
+        ``checkpoint=False`` (bench/tests) keeps everything in memory."""
+        spec = spec or SessionSpec()
+        ctrl_specs = list(controllers or [])
+        # build controller objects first: unknown kinds / bad params are
+        # the client's error and must fail BEFORE any state exists
+        ctrl_objs = [controller_from_dict(c) for c in ctrl_specs]
+        names = [c.name for c in ctrl_objs]
+        if len(set(names)) != len(names):
+            raise _spec_err(
+                f"controller names must be unique, got {names}",
+                "controllers")
+        docs = cluster_docs(cluster)
+        session_id = uuid.uuid4().hex[:12]
+        fingerprint = {
+            "cluster": _docs_digest(docs),
+            "spec": _docs_digest([spec.to_dict()]),
+            "controllers": controllers_digest(ctrl_objs),
+        }
+        sess = cls(session_id, name, docs, spec,
+                   [c.spec_dict() for c in ctrl_objs], None,
+                   surface=surface)
+        # build the program FIRST: a failed encode (bad cluster, bad
+        # template) must raise before any journal exists on disk
+        sess._controllers = ctrl_objs
+        sess._build_resident(restore=False)
+        if checkpoint or checkpoint is None:
+            jroot = root or lifecycle.checkpoint_dir()
+            if checkpoint and not jroot:
+                raise ValueError(
+                    "checkpoint=True needs a checkpoint directory: set "
+                    "SIMON_CHECKPOINT_DIR or configure a ledger dir")
+            if jroot:
+                try:
+                    sess.journal = SessionJournal.create(
+                        jroot, session_id, name, fingerprint, docs, spec,
+                        [c.spec_dict() for c in ctrl_objs],
+                        surface=surface)
+                except OSError as e:
+                    _log.warning(
+                        "session checkpoint dir %s is unwritable (%s); "
+                        "journaling disabled for this session", jroot, e)
+        # settle the baseline: every trajectory starts with the cluster's
+        # own pods placed (replay's synthetic step 0)
+        baseline = TraceEvent(t=0.0, kind=BASELINE_KIND)
+        sess._settle(baseline, journal_event={"kind": BASELINE_KIND, "t": 0.0})
+        return sess
+
+    @classmethod
+    def rehydrate(cls, path: str) -> "ReplaySession":
+        """Rebuild a session from its journal alone: cluster from the
+        header docs, history from the step lines. Device/program state
+        stays hollow until the first operation that needs it (status
+        queries answer from the last settled row)."""
+        journal = SessionJournal.load(path)
+        h = journal.header
+        spec = SessionSpec.from_dict(h.get("spec") or {})
+        sess = cls(h["session_id"], h.get("name") or h["session_id"],
+                   h.get("cluster_docs") or [], spec,
+                   list(h.get("controllers") or []), journal,
+                   surface=h.get("surface") or "session")
+        sess.created_ts = float(h.get("ts") or sess.created_ts)
+        for entry in journal.steps:
+            ev = entry.get("event") or {}
+            if ev.get("kind") not in (None, BASELINE_KIND):
+                sess._events.append(TraceEvent.from_dict(ev))
+            sess.rows.append(entry["row"])
+        sess.forks = list(journal.forks)
+        sess._fork_seq = len(sess.forks)
+        if sess.rows:
+            sess._settled_width = len(sess.rows[-1]["assign"])
+        sess.closed = journal.closed is not None
+        if not sess.rows:
+            raise lifecycle.ResumeError(
+                f"session journal {os.path.basename(path)} has no settled "
+                f"baseline step", ref=f"session/{sess.session_id}")
+        # verify the self-contained fingerprint: the header's digests must
+        # match what the header's own payload hashes to NOW — a mangled
+        # journal (hand-edited docs, truncated spec) must not silently
+        # rehydrate into a different trajectory
+        want = h.get("fingerprint") or {}
+        have = {"cluster": _docs_digest(sess._docs),
+                "spec": _docs_digest([spec.to_dict()]),
+                "controllers": controllers_digest(
+                    [controller_from_dict(c)
+                     for c in sess._controller_specs])}
+        if want != have:
+            drift = sorted(k for k in set(want) | set(have)
+                           if want.get(k) != have.get(k))
+            raise lifecycle.ResumeError(
+                f"session fingerprint drifted since the journal header "
+                f"was cut (changed: {drift})",
+                ref=f"session/{sess.session_id}", field="fingerprint",
+                hint="the journal file was modified; restore it or close "
+                     "the session")
+        _session_metrics()[4].inc()  # rehydrations_total
+        return sess
+
+    # -- residency ---------------------------------------------------------
+
+    @property
+    def resident(self) -> bool:
+        return self._prog is not None
+
+    def _trace(self, events: Optional[List[TraceEvent]] = None) -> ReplayTrace:
+        return ReplayTrace(
+            events=list(self._events if events is None else events),
+            max_new_nodes=self.spec.max_new_nodes,
+            node_template=self.spec.node_template,
+            zone_key=self.spec.zone_key)
+
+    def _build_program(self, trace: ReplayTrace) -> _Program:
+        cluster = cluster_from_docs(self._docs)
+        return _Program(cluster, trace, ReplayOptions(
+            config_overrides=dict(self.spec.config_overrides)))
+
+    def _build_resident(self, restore: bool = True) -> None:
+        """(Re)build program + world. ``restore`` replays the settled
+        state from the last journal row; the fresh-create path skips it
+        (there is no row yet)."""
+        prog = self._build_program(self._trace())
+        world = _World(prog)
+        if restore and self.rows:
+            last = self.rows[-1]
+            bound = np.array(last["assign"], dtype=np.int32)
+            # the journaled row may cover a LARGER universe than the
+            # settled events rebuild: apply_events grows the pod universe
+            # for its whole batch up front, so a crash mid-batch journals
+            # base sentinels for arrivals that never settled — pods the
+            # rebuilt program re-creates with the same base values
+            n = min(len(bound), len(world.bound))
+            world.bound[:n] = bound[:n]
+            world.active = np.array(last["active"], dtype=bool)
+            world.present = prog.presence_after(self._events)
+            # carry stays None: the next settle's full scan rebuilds it
+            # deterministically from the restored binding table (the
+            # defining step semantics — the replay-resume argument)
+        self._prog = prog
+        self._world = world
+        if self._controllers is None:
+            ctrls = [controller_from_dict(c)
+                     for c in self._controller_specs]
+            if self.rows:
+                states = self.rows[-1].get("controllers") or {}
+                for c in ctrls:
+                    c.load_state(states.get(c.name) or {})
+            self._controllers = ctrls
+
+    def _ensure_resident(self) -> None:
+        if self.closed:
+            raise SimulationError(
+                f"session {self.session_id} is closed",
+                code=E_NO_SESSION, ref=f"session/{self.session_id}",
+                hint="create a new session with POST /api/session")
+        if self._prog is None:
+            self._build_resident(restore=True)
+
+    def evict(self) -> None:
+        """Drop device + program state (the LRU cap / drain path). The
+        journal and the in-memory history stay; the next touch
+        rehydrates transparently."""
+        if self._prog is None:
+            return
+        self._prog = None
+        self._world = None
+        self._controllers = None
+        _session_metrics()[5].inc()  # evictions_total
+
+    # -- settling ----------------------------------------------------------
+
+    def _grow_universe(self, new_events: List[TraceEvent]) -> None:
+        """An arrival batch grows the pod universe: rebuild the program
+        over the full event history (same node axis, pod prefix ordering
+        unchanged) and carry the settled binding tables across. The
+        carry is dropped — re-encoding may renumber constraint vocab, so
+        the next step takes the defining full scan instead of trusting
+        vocab-indexed carry rows."""
+        old_world = self._world
+        old_p = old_world.prog.P
+        prog = self._build_program(self._trace(self._events + new_events))
+        world = _World(prog)
+        world.bound[:old_p] = old_world.bound
+        world.present[:old_p] = old_world.present
+        world.active = old_world.active.copy()
+        self._prog = prog
+        self._world = world
+
+    def _settle(self, ev: TraceEvent,
+                journal_event: Optional[Dict[str, Any]] = None
+                ) -> Dict[str, Any]:
+        from open_simulator_tpu.telemetry import ledger
+        from open_simulator_tpu.telemetry.spans import span
+
+        step = len(self.rows)
+        with ledger.run_capture(
+                self.surface,
+                tags={"session": self.session_id, "step": step,
+                      "t": float(ev.t), "event": ev.kind}) as cap:
+            with span("session.step", step=step, event=ev.kind):
+                row = settle_step(
+                    self._prog, self._world, self._controllers, ev, step,
+                    fast_path=self.spec.fast_path,
+                    max_control_iters=self.spec.max_control_iters)
+            # truncate to the settled width BEFORE digesting: the ledger
+            # RunRecord must carry the same batching-invariant digest the
+            # journal row does (apply_events grows the universe for its
+            # whole batch up front — the transient tail is not settled
+            # state and must not leak into any digest)
+            if ev.kind == "arrive":
+                stop = self._prog.batch_ranges[ev.app["name"]][1]
+                self._settled_width = max(self._settled_width or 0, stop)
+            elif self._settled_width is None:
+                self._settled_width = self._prog.n_cluster_pods
+            row["assign"] = row["assign"][: self._settled_width]
+            if cap.recording:
+                cap.set_config(self._prog.cfg, snapshot=self._prog.snapshot)
+                cap.set_result_info(row["placed"],
+                                    row["pending"] + row["lost"],
+                                    row_digest(row))
+        if self.journal is not None:
+            self.journal.append_step(
+                ev.to_dict() if journal_event is None else journal_event,
+                row)
+        self.rows.append(row)
+        if ev.kind != BASELINE_KIND:
+            self._events.append(ev)
+        _session_metrics()[2].labels(kind=ev.kind).inc()
+        return row
+
+    def apply_events(self, raw_events: List[Any]) -> List[Dict[str, Any]]:
+        """Append + settle a batch of timed events. Validation covers the
+        WHOLE candidate history (monotone timestamps, unique arrival
+        names, the node_add budget) and fails structurally before any
+        state mutates."""
+        if not isinstance(raw_events, list) or not raw_events:
+            raise _spec_err(
+                "events must be a non-empty list", "events",
+                hint='{"events": [{"t": 1, "kind": "arrive", "app": '
+                     '{...}}]}')
+        new_events = [e if isinstance(e, TraceEvent)
+                      else TraceEvent.from_dict(e, i)
+                      for i, e in enumerate(raw_events)]
+        candidate = self._trace(self._events + new_events)
+        candidate.validate()  # structured E_SPEC; nothing mutated yet
+        if self._events and new_events[0].t < self._events[-1].t:
+            raise _spec_err(
+                f"event timestamps must not precede the settled "
+                f"trajectory: t={new_events[0].t} after settled "
+                f"t={self._events[-1].t}", "events[0].t")
+        self._ensure_resident()
+        if any(e.kind == "arrive" for e in new_events):
+            self._grow_universe(new_events)
+
+        def _partial() -> Dict[str, Any]:
+            return {"session_id": self.session_id,
+                    "steps_completed": len(self.rows)}
+
+        out: List[Dict[str, Any]] = []
+        for ev in new_events:
+            # the deadline/drain boundary: a cancelled request stops HERE,
+            # between steps, with every settled step already journaled
+            lifecycle.check_current("session event boundary",
+                                    partial=_partial)
+            out.append(self._settle(ev))
+        self.last_touch = time.monotonic()
+        return out
+
+    # -- forks -------------------------------------------------------------
+
+    def fork(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Run ONE what-if branch from the current step. Returns a
+        structured record either way: ``status: "completed"`` with the
+        branch rows, or ``status: "quarantined"`` with the error — a
+        poisoned fork NEVER raises into the mainline (cancellation of
+        the enclosing request excepted, which is the request's story).
+        The record (minus the bulky step rows) is journaled so restarts
+        remember the fork history."""
+        if not isinstance(body, dict):
+            raise _spec_err(
+                f"fork must be an object, got {type(body).__name__}",
+                "fork", hint='{"events": [...], "name": "what-if"}')
+        # request-SHAPE errors are the client's 400, raised before the
+        # quarantine boundary; event/controller CONTENT errors are the
+        # what-if's own poison and quarantine below
+        raw_events = body.get("events")
+        if not isinstance(raw_events, list) or not raw_events:
+            raise _spec_err(
+                "fork needs a non-empty events list", "fork.events",
+                hint='{"events": [{"t": 9, "kind": "kill_node", '
+                     '"target": "n0"}]}')
+        if len(raw_events) > MAX_FORK_EVENTS:
+            raise _spec_err(
+                f"fork has {len(raw_events)} events; the per-fork cap is "
+                f"{MAX_FORK_EVENTS}", "fork.events",
+                hint="run long branches as their own replay/campaign")
+        raw_ctrl = body.get("controllers")
+        if raw_ctrl is not None and not isinstance(raw_ctrl, list):
+            raise _spec_err(
+                f"fork.controllers must be a list, got "
+                f"{type(raw_ctrl).__name__}", "fork.controllers")
+        raw_deadline = body.get("deadline_s")
+        if raw_deadline is not None:
+            try:
+                deadline = float(raw_deadline)
+            except (TypeError, ValueError):
+                raise _spec_err(
+                    f"fork.deadline_s must be a number, got "
+                    f"{raw_deadline!r}", "fork.deadline_s") from None
+            if deadline <= 0:
+                raise _spec_err(
+                    f"fork.deadline_s must be positive, got {deadline}",
+                    "fork.deadline_s")
+        self._fork_seq += 1
+        name = str(body.get("name") or f"fork-{self._fork_seq}")
+        t0 = time.perf_counter()
+        base_step = len(self.rows) - 1
+        outcome = "completed"
+        try:
+            record = self._run_fork(name, body, base_step)
+        except lifecycle.CancelledError as e:
+            if getattr(e, "_session_fork_deadline", False):
+                # the FORK's own deadline: quarantine the branch
+                record = self._quarantine(name, base_step, e.to_dict(),
+                                          getattr(e, "partial", None))
+                outcome = "quarantined"
+            else:
+                raise  # the request's deadline/drain — not this fork's story
+        except SimulationError as e:
+            record = self._quarantine(name, base_step, e.to_dict())
+            outcome = "quarantined"
+        except Exception as e:  # noqa: BLE001 — the fork fault boundary's
+            # last line of defense: an unexpected crash quarantines the
+            # BRANCH (with the E_INTERNAL this-is-our-bug marker), never
+            # the mainline or its sibling forks
+            record = self._quarantine(name, base_step, {
+                "code": "E_INTERNAL", "ref": f"fork/{name}", "field": "",
+                "hint": "file the session journal as a repro",
+                "message": f"{type(e).__name__}: {e}"})
+            outcome = "quarantined"
+        record["wall_s"] = round(time.perf_counter() - t0, 6)
+        journal_rec = {k: v for k, v in record.items() if k != "rows"}
+        if self.journal is not None:
+            self.journal.append_fork(journal_rec)
+        self.forks.append(journal_rec)
+        _session_metrics()[3].labels(outcome=outcome).inc()
+        from open_simulator_tpu.telemetry import ledger
+
+        ledger.append_event(
+            self.surface + ":fork",
+            tags={"session": self.session_id, "fork": name,
+                  "status": record["status"], "base_step": base_step,
+                  "steps": record.get("steps",
+                                      record.get("steps_completed", 0))},
+            wall_s=record["wall_s"])
+        self.last_touch = time.monotonic()
+        return record
+
+    def _quarantine(self, name: str, base_step: int, err: Dict[str, Any],
+                    partial: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
+        _log.warning("session %s: fork %s quarantined [%s]: %s",
+                     self.session_id, name, err.get("code"),
+                     err.get("message") or err.get("error"))
+        rec = {"fork": name, "status": "quarantined",
+               "base_step": base_step, "error": err,
+               "steps_completed": int((partial or {}).get(
+                   "steps_completed", 0))}
+        return rec
+
+    def _run_fork(self, name: str, body: Dict[str, Any],
+                  base_step: int) -> Dict[str, Any]:
+        from open_simulator_tpu.campaign.audit import (
+            AuditError,
+            audit_assignment,
+        )
+        from open_simulator_tpu.replay.report import trim_row
+
+        raw_events = body.get("events")
+        self._ensure_resident()
+        events = [e if isinstance(e, TraceEvent)
+                  else TraceEvent.from_dict(e, i)
+                  for i, e in enumerate(raw_events)]
+        candidate = self._trace(self._events + events)
+        candidate.validate()
+        # fork controllers: an explicit roster (the autoscaler-variant
+        # what-if) or clones of the mainline's; either way they inherit
+        # the mainline's journaled state for matching kinds, then diverge
+        raw_ctrl = body.get("controllers")
+        if raw_ctrl is not None:
+            ctrls = [controller_from_dict(c) for c in raw_ctrl]
+        else:
+            ctrls = [controller_from_dict(c.spec_dict())
+                     for c in self._controllers]
+        main_state = {c.name: c.state_dict() for c in self._controllers}
+        for c in ctrls:
+            if c.name in main_state:
+                c.load_state(main_state[c.name])
+
+        # fork isolation: copies of the host tables, a fresh carry (the
+        # mainline's carry would be DONATED — destroyed — by the fork's
+        # first scan), and a program that is either the mainline's
+        # (read-only; no arrivals) or the fork's own grown universe
+        if any(e.kind == "arrive" for e in events):
+            prog = self._build_program(candidate)
+        else:
+            prog = self._prog
+        world = _World(prog)
+        main_world = self._world
+        world.bound[: main_world.prog.P] = main_world.bound
+        world.present[: main_world.prog.P] = main_world.present
+        world.active = main_world.active.copy()
+
+        raw_deadline = body.get("deadline_s")
+        token: Optional[lifecycle.CancelToken] = None
+        if raw_deadline is not None:
+            # shape validated in fork() — a 400, not a quarantine
+            token = lifecycle.CancelToken(float(raw_deadline), reason="")
+
+        rows: List[Dict[str, Any]] = []
+        for i, ev in enumerate(events):
+            # the REQUEST's deadline/drain propagates (outside the fork
+            # boundary — see fork()); the FORK's own deadline quarantines
+            lifecycle.check_current("session fork boundary")
+            if token is not None and token.cancelled:
+                err = token.error(f"fork step {i}",
+                                  partial={"steps_completed": len(rows)})
+                err._session_fork_deadline = True
+                raise err
+            rows.append(settle_step(
+                prog, world, ctrls, ev, base_step + 1 + i,
+                fast_path=self.spec.fast_path,
+                max_control_iters=self.spec.max_control_iters))
+        if bool(body.get("audit", True)):
+            report = audit_assignment(prog.snapshot, world.bound,
+                                      world.active, world.present)
+            if not report.ok:
+                raise AuditError(report, ref=f"fork/{name}")
+        last = rows[-1]
+        return {
+            "fork": name, "status": "completed", "base_step": base_step,
+            "steps": len(rows), "digest": rows_digest(rows),
+            "totals": {"placed": last["placed"],
+                       "pending": last["pending"], "lost": last["lost"],
+                       "active_nodes": last["active_nodes"]},
+            "rows": [trim_row(r) for r in rows],
+        }
+
+    # -- interrogation / close ---------------------------------------------
+
+    @property
+    def digest(self) -> str:
+        return rows_digest(self.rows)
+
+    def status(self) -> Dict[str, Any]:
+        """The between-events view: answered from the last settled row,
+        so an evicted session costs no device work to interrogate."""
+        last = self.rows[-1] if self.rows else {}
+        forks = {"completed": 0, "quarantined": 0}
+        for f in self.forks:
+            forks[f.get("status", "completed")] = forks.get(
+                f.get("status", "completed"), 0) + 1
+        return {
+            "session_id": self.session_id,
+            "name": self.name,
+            "created_ts": self.created_ts,
+            "closed": self.closed,
+            "resident": self.resident,
+            "steps": len(self.rows),
+            "events": len(self._events),
+            "last_t": float(last.get("t") or 0.0),
+            "placed": int(last.get("placed") or 0),
+            "pending": int(last.get("pending") or 0),
+            "lost": int(last.get("lost") or 0),
+            "active_nodes": int(last.get("active_nodes") or 0),
+            "cpu_pct": float(last.get("cpu_pct") or 0.0),
+            "mem_pct": float(last.get("mem_pct") or 0.0),
+            "digest": self.digest,
+            "forks": forks,
+            "controllers": [dict(c) for c in self._controller_specs],
+        }
+
+    def placements(self) -> Dict[str, List[str]]:
+        """Current node -> pod-key placements (rehydrates if needed)."""
+        self._ensure_resident()
+        world, prog = self._world, self._prog
+        out: Dict[str, List[str]] = {}
+        live = world.present & (world.bound >= 0)
+        for pi in np.nonzero(live)[0]:
+            out.setdefault(prog.node_names[int(world.bound[pi])],
+                           []).append(prog.pods[pi].key)
+        for pods in out.values():
+            pods.sort()
+        self.last_touch = time.monotonic()
+        return out
+
+    def close(self) -> Dict[str, Any]:
+        """Close the session: journal the close marker (the journal
+        becomes prunable), drop device state. Idempotent."""
+        from open_simulator_tpu.telemetry import ledger
+
+        if not self.closed:
+            self.closed = True
+            if self.journal is not None and self.journal.closed is None:
+                self.journal.close(self.digest, len(self.rows))
+            ledger.append_event(
+                self.surface,
+                tags={"session": self.session_id, "steps": len(self.rows),
+                      "events": len(self._events), "digest": self.digest,
+                      "forks": len(self.forks), "closed": True})
+        self._prog = None
+        self._world = None
+        self._controllers = None
+        return {"session_id": self.session_id, "closed": True,
+                "steps": len(self.rows), "digest": self.digest}
+
+
+# ---- the store -----------------------------------------------------------
+
+
+class SessionStore:
+    """The server's session table: open journals on disk + resident
+    sessions in memory, bounded by an LRU residency cap. Thread-safe:
+    per-session operations serialize on a ``KeyedMutex`` (events arrive
+    via the single-flight admission queue; interrogation and lazy
+    rehydration run on handler threads), the table itself on one lock —
+    reads of session A never wait on session B's settle."""
+
+    def __init__(self, root: Optional[str] = None,
+                 max_resident: int = DEFAULT_MAX_RESIDENT,
+                 surface: str = "session"):
+        self._root_override = root
+        self.max_resident = max(1, int(max_resident))
+        self.surface = surface
+        self._guard = threading.Lock()
+        self._mutex = lifecycle.KeyedMutex()
+        # sid -> ReplaySession (loaded) | None (open on disk, not loaded)
+        self._sessions: Dict[str, Optional[ReplaySession]] = {}
+        self._scanned = False
+
+    # -- root / scan -------------------------------------------------------
+
+    def root(self) -> Optional[str]:
+        return self._root_override or lifecycle.checkpoint_dir()
+
+    def _path(self, sid: str) -> str:
+        return os.path.join(self.root() or "", sid + SESSION_JOURNAL_SUFFIX)
+
+    def scan(self) -> List[str]:
+        """Register every OPEN session journal under the root (server
+        start / after a SIGKILL). Journals are NOT parsed here — the
+        first touch rehydrates lazily."""
+        root = self.root()
+        found: List[str] = []
+        if root and os.path.isdir(root):
+            for n in sorted(os.listdir(root)):
+                if not n.endswith(SESSION_JOURNAL_SUFFIX):
+                    continue
+                if lifecycle.journal_is_done(os.path.join(root, n)):
+                    continue  # closed: history, not an open session
+                found.append(n[: -len(SESSION_JOURNAL_SUFFIX)])
+        with self._guard:
+            self._scanned = True
+            for sid in found:
+                self._sessions.setdefault(sid, None)
+        self._gauges()
+        return found
+
+    def _ensure_scanned(self) -> None:
+        if not self._scanned:
+            self.scan()
+
+    def _gauges(self) -> None:
+        open_g, resident_g, *_ = _session_metrics()
+        with self._guard:
+            open_g.set(len(self._sessions))
+            resident_g.set(sum(1 for s in self._sessions.values()
+                               if s is not None and s.resident))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def create(self, cluster, spec: Optional[SessionSpec] = None,
+               controllers: Optional[List[Dict[str, Any]]] = None,
+               name: str = "") -> ReplaySession:
+        self._ensure_scanned()
+        sess = ReplaySession.create(
+            cluster, spec=spec, controllers=controllers, name=name,
+            root=self._root_override, surface=self.surface)
+        with self._guard:
+            self._sessions[sess.session_id] = sess
+        self._evict_overflow(keep=sess.session_id)
+        self._gauges()
+        return sess
+
+    def get(self, sid: str, touch: bool = True) -> ReplaySession:
+        """Resolve an open session, rehydrating from its journal when the
+        server restarted or the LRU cap evicted it. E_NO_SESSION (404)
+        for unknown/closed ids. ``touch=False`` (listing) leaves the LRU
+        recency order alone — a monitoring poller walking every session
+        must not make the residency cap evict the actively-used ones."""
+        if not _SID_RE.fullmatch(sid or ""):
+            # ids are journal FILENAMES: an unvalidated sid in the URL
+            # would traverse outside the checkpoint dir (../../other)
+            raise SimulationError(
+                f"no open session {sid!r}", code=E_NO_SESSION,
+                ref="session", field="session_id",
+                hint="list open sessions with GET /api/session")
+        self._ensure_scanned()
+        with self._mutex.hold(sid):
+            with self._guard:
+                known = sid in self._sessions
+                sess = self._sessions.get(sid)
+            if sess is None:
+                path = self._path(sid)
+                if not known and not os.path.isfile(path):
+                    raise SimulationError(
+                        f"no open session {sid!r}", code=E_NO_SESSION,
+                        ref=f"session/{sid}",
+                        hint="list open sessions with GET /api/session")
+                sess = ReplaySession.rehydrate(path)
+                if sess.closed:
+                    with self._guard:
+                        self._sessions.pop(sid, None)
+                    raise SimulationError(
+                        f"session {sid} is closed", code=E_NO_SESSION,
+                        ref=f"session/{sid}")
+                with self._guard:
+                    self._sessions[sid] = sess
+            if touch:
+                sess.last_touch = time.monotonic()
+        if touch:
+            self._evict_overflow(keep=sid)
+        self._gauges()
+        return sess
+
+    def hold(self, sid: str):
+        """The per-session mutex (callers wrap multi-step operations)."""
+        return self._mutex.hold(sid)
+
+    def close(self, sid: str) -> Dict[str, Any]:
+        with self._mutex.hold(sid):
+            sess = self.get(sid)
+            out = sess.close()
+            with self._guard:
+                self._sessions.pop(sid, None)
+        self._gauges()
+        return out
+
+    def list(self) -> List[Dict[str, Any]]:
+        """Status of every open session — loaded ones from memory,
+        on-disk ones rehydrated lazily (host-side parse only; status
+        never touches the device)."""
+        self._ensure_scanned()
+        with self._guard:
+            sids = sorted(self._sessions)
+        out = []
+        for sid in sids:
+            try:
+                out.append(self.get(sid, touch=False).status())
+            except SimulationError:
+                continue  # closed/vanished between listdir and open
+        return out
+
+    # -- residency cap / drain ---------------------------------------------
+
+    def _evict_overflow(self, keep: str = "") -> None:
+        """LRU-evict resident sessions past ``max_resident`` (never the
+        one currently being touched). Evicted sessions stay open: their
+        device state is gone, their journal is the truth. Victims are
+        taken with a NON-blocking ``try_hold`` — the caller may already
+        hold ``keep``'s mutex (rest.py wraps whole operations in it), so
+        blocking on another session's mutex here while that session's
+        own thread evicts toward ``keep`` would be an AB-BA deadlock; a
+        victim whose lock is busy is mid-operation (recently used by
+        definition) and is skipped this round."""
+        busy: set = set()
+        while True:
+            with self._guard:
+                # journal-less sessions (no checkpoint dir configured)
+                # cannot rehydrate: they are exempt from eviction — the
+                # cap applies to what the journal can bring back
+                resident = [(s.last_touch, sid)
+                            for sid, s in self._sessions.items()
+                            if s is not None and s.resident
+                            and s.journal is not None and sid != keep]
+                candidates = [r for r in resident if r[1] not in busy]
+                if len(resident) + (1 if keep else 0) <= self.max_resident \
+                        or not candidates:
+                    return
+                _, victim = min(candidates)
+                sess = self._sessions[victim]
+            with self._mutex.try_hold(victim) as got:
+                if got:
+                    sess.evict()
+                else:
+                    busy.add(victim)
+            self._gauges()
+
+    def drain(self) -> Dict[str, Any]:
+        """The graceful-drain hook (server.begin_drain): every settled
+        step is already fsynced, so draining only records each open
+        session's final status in the ledger and releases device state.
+        A restarted server rehydrates every one of them."""
+        from open_simulator_tpu.telemetry import ledger
+
+        self._ensure_scanned()
+        with self._guard:
+            loaded = [(sid, s) for sid, s in self._sessions.items()
+                      if s is not None]
+            n_open = len(self._sessions)
+        for sid, sess in loaded:
+            with self._mutex.hold(sid):
+                ledger.append_event(
+                    self.surface,
+                    tags={"session": sid, "steps": len(sess.rows),
+                          "digest": sess.digest, "drained": True})
+                sess.evict()
+        self._gauges()
+        return {"open_sessions": n_open, "flushed": len(loaded)}

@@ -194,11 +194,13 @@ class ReplayTrace:
     def arrivals(self) -> List[TraceEvent]:
         return [e for e in self.events if e.kind == "arrive"]
 
-    def validate(self) -> None:
+    def validate(self, allow_empty: bool = False) -> None:
         """Structural validation with structured errors. Does NOT parse
         app manifests (that needs the k8s loaders and happens at build
-        time, still behind the same taxonomy)."""
-        if not self.events:
+        time, still behind the same taxonomy). ``allow_empty`` is the
+        digital-twin session case: a freshly created session holds a
+        baseline trajectory with no events yet."""
+        if not self.events and not allow_empty:
             raise _spec_err(
                 "trace has no events", "events",
                 hint='add events like {"t": 0, "kind": "arrive", ...}')

@@ -60,11 +60,127 @@ _log = logging.getLogger(__name__)
 
 CHECKPOINT_DIR_ENV = "SIMON_CHECKPOINT_DIR"
 SWEEP_JOURNAL_SUFFIX = ".sweep.jsonl"
-# completed journals kept per checkpoint dir (pruned oldest-first when a
-# new sweep starts); unfinished journals — crash evidence awaiting a
-# --resume — are never pruned automatically
+# completed journals kept per (checkpoint dir, journal kind) — pruned
+# oldest-first when a new journal of that kind starts; unfinished/open
+# journals — crash evidence awaiting a --resume, or live digital-twin
+# sessions — are never pruned automatically. SIMON_JOURNAL_KEEP bounds
+# every journal kind; SIMON_SWEEP_JOURNAL_KEEP is the pre-existing
+# sweep-specific override and still wins for sweeps.
 JOURNAL_KEEP_ENV = "SIMON_SWEEP_JOURNAL_KEEP"
+SHARED_JOURNAL_KEEP_ENV = "SIMON_JOURNAL_KEEP"
 DEFAULT_JOURNAL_KEEP = 32
+# the done-marker tokens a completed journal's tail may carry — "done"
+# for sweeps/campaigns/replays, "close" for digital-twin sessions
+_DONE_TOKENS = (b'"kind": "done"', b'"kind": "close"')
+
+
+def journal_keep(env: str = "") -> int:
+    """Resolve the keep-N-completed bound: the kind-specific env override
+    (when given), then the shared SIMON_JOURNAL_KEEP, then the default."""
+    for name in filter(None, (env, SHARED_JOURNAL_KEEP_ENV)):
+        raw = os.environ.get(name)
+        if raw is not None:
+            try:
+                return max(0, int(raw))
+            except ValueError:
+                continue  # unparsable override: fall through to the
+                # shared setting / default rather than ignoring both
+    return DEFAULT_JOURNAL_KEEP
+
+
+def journal_is_done(path: str) -> bool:
+    """Cheap completion probe shared by every journal kind: a done/close
+    marker lives in the file's last line — read only the tail, never
+    parse the rows."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            f.seek(max(0, f.tell() - 4096))
+            tail = f.read()
+    except OSError:
+        return False
+    return any(tok in tail for tok in _DONE_TOKENS)
+
+
+def prune_journals(root: str, suffix: str, keep: Optional[int] = None,
+                   env: str = "") -> int:
+    """Bound a checkpoint dir for ONE journal kind (the run ledger
+    rotates; its siblings must too): delete COMPLETED ``*<suffix>``
+    journals oldest-first past ``keep``. Unfinished journals are
+    resumable crash evidence (or live sessions) and are never
+    auto-deleted — the policy every journal kind (sweep, campaign,
+    replay, session) shares. Returns the number removed."""
+    if keep is None:
+        keep = journal_keep(env)
+    keep = max(0, int(keep))
+    try:
+        names = [n for n in os.listdir(root) if n.endswith(suffix)]
+    except OSError:
+        return 0
+    done = [n for n in names if journal_is_done(os.path.join(root, n))]
+    done.sort(key=lambda n: os.path.getmtime(os.path.join(root, n)))
+    removed = 0
+    for n in done[:max(0, len(done) - keep)]:
+        try:
+            os.remove(os.path.join(root, n))
+            removed += 1
+        except OSError:
+            pass  # concurrent prune/cleanup: not our problem
+    return removed
+
+
+class KeyedMutex:
+    """Per-key reentrant locks with refcounted cleanup: the session
+    store's concurrency primitive. Events for ONE session serialize (the
+    admission queue already orders them FIFO; the mutex closes the gap
+    against handler-thread interrogation and lazy rehydration), while
+    operations on DIFFERENT sessions proceed concurrently."""
+
+    def __init__(self):
+        self._guard = threading.Lock()
+        self._locks: Dict[Any, Tuple[threading.RLock, int]] = {}
+
+    @contextlib.contextmanager
+    def hold(self, key):
+        with self._guard:
+            lock, refs = self._locks.get(key, (None, 0))
+            if lock is None:
+                lock = threading.RLock()
+            self._locks[key] = (lock, refs + 1)
+        lock.acquire()
+        try:
+            yield
+        finally:
+            lock.release()
+            self._unref(key)
+
+    @contextlib.contextmanager
+    def try_hold(self, key):
+        """Non-blocking ``hold``: yields True with the lock held, or
+        False without it. Callers that already hold ONE key and want
+        another (the session store's LRU eviction touching a victim)
+        must use this — a blocking cross-key acquire is an AB-BA
+        deadlock waiting for two threads to pick each other's key."""
+        with self._guard:
+            lock, refs = self._locks.get(key, (None, 0))
+            if lock is None:
+                lock = threading.RLock()
+            self._locks[key] = (lock, refs + 1)
+        got = lock.acquire(blocking=False)
+        try:
+            yield got
+        finally:
+            if got:
+                lock.release()
+            self._unref(key)
+
+    def _unref(self, key) -> None:
+        with self._guard:
+            lock, refs = self._locks[key]
+            if refs <= 1:
+                del self._locks[key]
+            else:
+                self._locks[key] = (lock, refs - 1)
 
 
 # ---- cancellation --------------------------------------------------------
@@ -492,46 +608,15 @@ class SweepJournal:
 
     @staticmethod
     def _is_done(path: str) -> bool:
-        """Cheap completion probe: a done marker lives in the file's last
-        line — read only the tail, never parse the rounds."""
-        try:
-            with open(path, "rb") as f:
-                f.seek(0, os.SEEK_END)
-                f.seek(max(0, f.tell() - 4096))
-                tail = f.read()
-        except OSError:
-            return False
-        return b'"kind": "done"' in tail
+        return journal_is_done(path)
 
     @classmethod
     def prune(cls, root: str, keep: Optional[int] = None) -> int:
-        """Bound the checkpoint dir (the run ledger rotates; its sibling
-        must too): delete COMPLETED journals oldest-first past ``keep``
-        (SIMON_SWEEP_JOURNAL_KEEP, default 32). Unfinished journals are
-        resumable crash evidence and are never auto-deleted. Returns the
-        number removed."""
-        if keep is None:
-            try:
-                keep = int(os.environ.get(JOURNAL_KEEP_ENV,
-                                          DEFAULT_JOURNAL_KEEP))
-            except ValueError:
-                keep = DEFAULT_JOURNAL_KEEP
-        keep = max(0, keep)
-        try:
-            names = [n for n in os.listdir(root)
-                     if n.endswith(SWEEP_JOURNAL_SUFFIX)]
-        except OSError:
-            return 0
-        done = [n for n in names if cls._is_done(os.path.join(root, n))]
-        done.sort(key=lambda n: os.path.getmtime(os.path.join(root, n)))
-        removed = 0
-        for n in done[:max(0, len(done) - keep)]:
-            try:
-                os.remove(os.path.join(root, n))
-                removed += 1
-            except OSError:
-                pass  # concurrent prune/cleanup: not our problem
-        return removed
+        """Bound the checkpoint dir: the shared keep-N-completed policy
+        (``prune_journals``) applied to sweep journals, honoring the
+        pre-existing SIMON_SWEEP_JOURNAL_KEEP override."""
+        return prune_journals(root, SWEEP_JOURNAL_SUFFIX, keep=keep,
+                              env=JOURNAL_KEEP_ENV)
 
     @classmethod
     def create(cls, root: str, fingerprint: Dict[str, Any], max_new: int,

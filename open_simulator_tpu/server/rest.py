@@ -48,6 +48,26 @@ Endpoint parity with the reference (pkg/server/server.go:148-314):
                              runs through the admission queue with
                              cancellation observed at cluster boundaries,
                              returns the fleet report (campaign/)
+  POST /api/session       -> create a resident digital-twin session: a
+                             journaled live trajectory events are fed
+                             into as the day unfolds (replay/session.py)
+  GET  /api/session       -> list open sessions (resident + on-disk)
+  GET  /api/session/<id>  -> interrogate a session between events
+                             (?placements=1 for the full node->pods map)
+  POST /api/session/<id>/events
+                          -> append + settle timed events; one fsynced
+                             journal line per settled step — a SIGKILL'd
+                             server restarts and resumes the session
+                             bit-identically
+  POST /api/session/<id>/fork
+                          -> what-if branches (chaos plans, arrival
+                             bursts, controller variants) off the
+                             current step, zero new compiles; a fork
+                             that raises / times out / fails the
+                             placement audit is quarantined with a
+                             structured record while the mainline and
+                             sibling forks continue
+  DELETE /api/session/<id> -> close (journal becomes prunable history)
   POST /api/replay        -> time-stepped trace replay (replay/):
                              {"trace": {"events": [...]}, "controllers":
                               [...], "resume"?, "frontier"?} — the
@@ -126,6 +146,7 @@ DEFAULT_MAX_BODY_BYTES = 8 * 1024 * 1024
 DEFAULT_REQUEST_TIMEOUT_S = 300.0
 DEFAULT_QUEUE_DEPTH = 8
 DEFAULT_DRAIN_TIMEOUT_S = 30.0
+DEFAULT_MAX_SESSIONS = 8
 # after cancelling a timed-out job's token, how long the handler waits
 # for the worker to reach a cancellation boundary and surface partial
 # results before replying with a bare E_DEADLINE body
@@ -143,7 +164,7 @@ _KNOWN_PATHS = frozenset({
     "/debug/profile",
     "/api/explain", "/api/deploy-apps", "/api/scale-apps", "/api/chaos",
     "/api/capacity", "/api/campaign", "/api/replay", "/api/runs",
-    "/api/trace",
+    "/api/trace", "/api/session",
 })
 
 
@@ -178,7 +199,8 @@ class SimulationServer:
                  explain_topk: int = DEFAULT_EXPLAIN_TOPK,
                  compile_cache_dir: str = "", ledger_dir: str = "",
                  queue_depth: int = DEFAULT_QUEUE_DEPTH,
-                 drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S):
+                 drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S,
+                 max_sessions: int = DEFAULT_MAX_SESSIONS):
         self.cluster_config = cluster_config
         # recorded API dump standing in for the reference's 10 live
         # informers (pkg/server/server.go:97-137; no cluster access here)
@@ -207,6 +229,15 @@ class SimulationServer:
         self._trace_mark = None
         if ledger_dir:
             telemetry.ledger.configure(ledger_dir)
+        # digital-twin sessions (replay/session.py): resident journaled
+        # trajectories bounded by an LRU residency cap. The store scans
+        # the checkpoint dir NOW (after the ledger config resolves it) so
+        # a restarted/SIGKILL'd server serves every open session again —
+        # rehydration itself stays lazy, on first touch.
+        from open_simulator_tpu.replay.session import SessionStore
+
+        self._sessions = SessionStore(max_resident=max_sessions)
+        self._sessions.scan()
         telemetry.install_runtime_gauges()
         if compile_cache_dir:
             # persistent XLA compilation cache: a restarted server skips
@@ -246,6 +277,11 @@ class SimulationServer:
             # one short follow-up wait: cooperative cancellation needs the
             # worker to reach its next round/event boundary
             clean = self._queue.join(max(1.0, 0.1 * self.drain_timeout_s))
+        # flush the digital twins AFTER the queue is quiet: every settled
+        # step is already fsynced in its session journal, so this only
+        # records each open session's final status and drops device
+        # state — a restarted server rehydrates every one of them
+        session_info = self._sessions.drain()
         from open_simulator_tpu.telemetry import ledger
 
         run_id = ledger.append_event(
@@ -254,10 +290,11 @@ class SimulationServer:
                   "simulations": self._stats["simulations"],
                   "errors": self._stats["errors"],
                   "drained_clean": bool(clean),
+                  **session_info,
                   **self._queue.stats()},
             wall_s=time.monotonic() - t0)
         return {"draining": True, "drained_clean": bool(clean),
-                "ledger_run_id": run_id,
+                "ledger_run_id": run_id, **session_info,
                 "wall_s": round(time.monotonic() - t0, 3)}
 
     # ---- debug surface (the gin pprof analog, server.go:148-152) -------
@@ -583,6 +620,109 @@ class SimulationServer:
         self._stats["simulations"] += report["totals"]["steps"]
         return report
 
+    # ---- digital-twin sessions (replay/session.py) ---------------------
+
+    def session_create(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """POST /api/session: create a resident journaled trajectory.
+
+        Body: {"cluster": {...}?, "name"?, "spec": {"max_new_nodes",
+               "node_template", "zone_key", "config_overrides"}?,
+               "controllers": [{"kind": "autoscaler", ...}]?}
+
+        Encodes the cluster once, settles the baseline step (the
+        cluster's own pods), journals it under the checkpoint dir — from
+        here the session survives SIGKILL. Runs on the admission queue
+        (the baseline settle is device work)."""
+        from open_simulator_tpu.replay.session import SessionSpec
+
+        self._stats["requests"] += 1
+        cluster = self.base_cluster(body.get("cluster"))
+        spec = SessionSpec.from_dict(body.get("spec"))
+        raw_ctrl = body.get("controllers") or []
+        if not isinstance(raw_ctrl, list):
+            raise SimulationError(
+                f"controllers must be a list, got "
+                f"{type(raw_ctrl).__name__}", code="E_BAD_REQUEST",
+                ref="request", field="controllers",
+                hint='[{"kind": "autoscaler", "scale_step": 2}]')
+        sess = self._sessions.create(cluster, spec=spec,
+                                     controllers=raw_ctrl,
+                                     name=str(body.get("name") or ""))
+        self._stats["simulations"] += 1
+        return {"created": True, **sess.status()}
+
+    def session_events(self, sid: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        """POST /api/session/<id>/events: append + settle timed events.
+
+        Body: {"events": [{"t", "kind", ...}, ...]} — the ReplayTrace
+        event vocabulary. Each event settles through the controller loop
+        and lands as one fsynced journal line before the next begins;
+        the deadline/drain CancelToken is observed BETWEEN steps, so a
+        504 leaves every settled step journaled and the session
+        resumable."""
+        from open_simulator_tpu.replay.report import trim_row
+
+        self._stats["requests"] += 1
+        with self._sessions.hold(sid):
+            sess = self._sessions.get(sid)
+            rows = sess.apply_events(body.get("events"))
+            self._stats["simulations"] += len(rows)
+            return {"session_id": sess.session_id,
+                    "steps": [trim_row(r) for r in rows],
+                    "digest": sess.digest,
+                    "status": sess.status()}
+
+    def session_fork(self, sid: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        """POST /api/session/<id>/fork: what-if branches off the current
+        step. Body: one fork object ({"name"?, "events": [...],
+        "controllers"?, "deadline_s"?, "audit"?}) or {"forks": [...]}
+        for siblings. A poisoned fork returns a structured quarantine
+        record; the mainline and its siblings are untouched."""
+        self._stats["requests"] += 1
+        raw_forks = body.get("forks")
+        if raw_forks is not None and not isinstance(raw_forks, list):
+            raise SimulationError(
+                f"forks must be a list, got {type(raw_forks).__name__}",
+                code="E_BAD_REQUEST", ref="request", field="forks",
+                hint='{"forks": [{"events": [...]}, ...]}')
+        with self._sessions.hold(sid):
+            sess = self._sessions.get(sid)
+            mainline = sess.digest
+            if raw_forks is None:
+                record = sess.fork(body)
+                self._stats["simulations"] += record.get("steps", 0)
+                return {"session_id": sess.session_id,
+                        "mainline_digest": mainline, **record}
+            records = [sess.fork(f) for f in raw_forks]
+            self._stats["simulations"] += sum(
+                r.get("steps", 0) for r in records)
+            return {"session_id": sess.session_id,
+                    "mainline_digest": mainline, "forks": records}
+
+    def session_status(self, sid: str,
+                       query: Dict[str, List[str]]) -> Dict[str, Any]:
+        """GET /api/session/<id>: interrogate between events (host-side;
+        answered from the last settled row — an evicted session costs no
+        device work unless ?placements=1 asks for the full table)."""
+        with self._sessions.hold(sid):
+            sess = self._sessions.get(sid)
+            out = sess.status()
+            if (query.get("placements") or ["0"])[0] not in ("", "0",
+                                                             "false"):
+                out["placements"] = sess.placements()
+            return out
+
+    def session_list(self) -> Dict[str, Any]:
+        """GET /api/session: every open session (resident or on-disk)."""
+        return {"sessions": self._sessions.list(),
+                "max_resident": self._sessions.max_resident}
+
+    def session_close(self, sid: str) -> Dict[str, Any]:
+        """DELETE /api/session/<id>: journal the close marker (the
+        journal becomes prunable history) and release device state."""
+        self._stats["requests"] += 1
+        return self._sessions.close(sid)
+
     def chaos(self, body: Dict[str, Any]) -> Dict[str, Any]:
         """Fault-injection re-simulation (resilience/chaos.py)."""
         from open_simulator_tpu.resilience.chaos import ChaosPlan, run_chaos
@@ -796,6 +936,8 @@ def _make_handler(server: SimulationServer):
             if path.startswith("/api/runs/"):
                 # per-run lookups collapse to one label (id cardinality)
                 label = "/api/runs"
+            elif path.startswith("/api/session/"):
+                label = "/api/session"  # session-id cardinality collapses
             else:
                 label = path if path in _KNOWN_PATHS else "other"
             method = self.command or "-"
@@ -899,6 +1041,26 @@ def _make_handler(server: SimulationServer):
                         json.dumps(RECORDER.chrome_trace(
                             since=server._trace_mark)).encode(),
                         "application/json")
+            elif self.path == "/api/session" \
+                    or self.path.startswith("/api/session?") \
+                    or self.path.startswith("/api/session/"):
+                from urllib.parse import parse_qs, unquote, urlparse
+
+                parsed = urlparse(self.path)
+                try:
+                    if parsed.path in ("/api/session", "/api/session/"):
+                        self._send(200, server.session_list())
+                    else:
+                        sid = unquote(
+                            parsed.path[len("/api/session/"):]).strip("/")
+                        self._send(200, server.session_status(
+                            sid, parse_qs(parsed.query)))
+                except SimulationError as e:
+                    server._stats["errors"] += 1
+                    self._send(_status_for(e), _err_payload(e))
+                except Exception as e:  # noqa: BLE001
+                    server._stats["errors"] += 1
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
             elif self.path == "/debug/stats":
                 # profiling surface, the gin pprof analog
                 # (/root/reference/pkg/server/server.go:148-152): process +
@@ -930,14 +1092,57 @@ def _make_handler(server: SimulationServer):
             finally:
                 in_flight.dec()
 
-        def _do_post(self):
+        def do_DELETE(self):
+            self._t0 = time.perf_counter()
+            in_flight.inc()
+            try:
+                self._do_delete()
+            finally:
+                in_flight.dec()
+
+        def _do_delete(self):
+            # DELETE /api/session/<id>: host-side journal close — no
+            # device work, so it runs on the handler thread (works even
+            # while the worker settles another session's events)
+            if not self.path.startswith("/api/session/"):
+                self._send(404, {"error": "not found"})
+                return
+            from urllib.parse import unquote
+
+            sid = unquote(self.path[len("/api/session/"):]).strip("/")
+            try:
+                self._send(200, server.session_close(sid))
+            except SimulationError as e:
+                server._stats["errors"] += 1
+                self._send(_status_for(e), _err_payload(e))
+            except Exception as e:  # noqa: BLE001
+                server._stats["errors"] += 1
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def _resolve_post(self):
             routes = {"/api/deploy-apps": server.deploy_apps,
                       "/api/scale-apps": server.scale_apps,
                       "/api/capacity": server.capacity,
                       "/api/campaign": server.campaign,
                       "/api/replay": server.replay,
-                      "/api/chaos": server.chaos}
-            handler_fn = routes.get(self.path)
+                      "/api/chaos": server.chaos,
+                      "/api/session": server.session_create}
+            fn = routes.get(self.path)
+            if fn is not None:
+                return fn
+            # session sub-resources carry the id in the path:
+            # /api/session/<id>/{events,fork}
+            if self.path.startswith("/api/session/"):
+                parts = self.path[len("/api/session/"):].strip("/")
+                sid, _, verb = parts.partition("/")
+                if sid and verb == "events":
+                    return lambda body: server.session_events(sid, body)
+                if sid and verb == "fork":
+                    return lambda body: server.session_fork(sid, body)
+            return None
+
+        def _do_post(self):
+            handler_fn = self._resolve_post()
             if handler_fn is None:
                 self._send(404, {"error": "not found"})
                 return
@@ -1101,6 +1306,7 @@ _STATUS_BY_CODE = {
     "E_RESUME": 409,       # checkpoint fingerprint/parameter mismatch
     "E_NO_SIMULATION": 404,
     "E_NO_RUN": 404,
+    "E_NO_SESSION": 404,   # unknown/closed digital-twin session id
 }
 
 
@@ -1115,7 +1321,8 @@ def serve(address: str = "127.0.0.1", port: int = 8899, cluster_config: str = ""
           explain_topk: int = DEFAULT_EXPLAIN_TOPK,
           compile_cache_dir: str = "", ledger_dir: str = "",
           queue_depth: int = DEFAULT_QUEUE_DEPTH,
-          drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S) -> int:
+          drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S,
+          max_sessions: int = DEFAULT_MAX_SESSIONS) -> int:
     if kubeconfig:
         # validate up front so a real kubeconfig fails fast with the
         # record-a-dump recipe instead of 500s per request
@@ -1129,7 +1336,8 @@ def serve(address: str = "127.0.0.1", port: int = 8899, cluster_config: str = ""
                                   compile_cache_dir=compile_cache_dir,
                                   ledger_dir=ledger_dir,
                                   queue_depth=queue_depth,
-                                  drain_timeout_s=drain_timeout_s)
+                                  drain_timeout_s=drain_timeout_s,
+                                  max_sessions=max_sessions)
     httpd = ThreadingHTTPServer((address, port), _make_handler(sim_server))
 
     def _drain_and_stop(signame: str) -> None:

@@ -209,6 +209,50 @@ def test_sweep_journal_prune_keeps_unfinished(tmp_path):
     assert left == {ids[2], ids[3]}      # unfinished + newest done
 
 
+def test_journal_keep_env_resolution(monkeypatch):
+    """Kind-specific override wins; an UNPARSABLE override falls through
+    to the shared setting (not the default — the operator's disk bound
+    must not silently 8x because of a typo in the specific env)."""
+    monkeypatch.delenv(lifecycle.JOURNAL_KEEP_ENV, raising=False)
+    monkeypatch.delenv(lifecycle.SHARED_JOURNAL_KEEP_ENV, raising=False)
+    assert lifecycle.journal_keep(
+        lifecycle.JOURNAL_KEEP_ENV) == lifecycle.DEFAULT_JOURNAL_KEEP
+    monkeypatch.setenv(lifecycle.SHARED_JOURNAL_KEEP_ENV, "4")
+    assert lifecycle.journal_keep(lifecycle.JOURNAL_KEEP_ENV) == 4
+    monkeypatch.setenv(lifecycle.JOURNAL_KEEP_ENV, "7")
+    assert lifecycle.journal_keep(lifecycle.JOURNAL_KEEP_ENV) == 7
+    monkeypatch.setenv(lifecycle.JOURNAL_KEEP_ENV, "n/a")
+    assert lifecycle.journal_keep(lifecycle.JOURNAL_KEEP_ENV) == 4
+
+
+def test_keyed_mutex_try_hold_nonblocking():
+    """try_hold: the session store's eviction path must never block on
+    another key's lock (AB-BA deadlock with a thread evicting the other
+    way); it yields False while the key is held elsewhere and True with
+    the lock once it is free."""
+    m = lifecycle.KeyedMutex()
+    acquired = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with m.hold("a"):
+            acquired.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert acquired.wait(5.0)
+    with m.try_hold("a") as got:
+        assert not got                   # held by the other thread
+    with m.try_hold("b") as got:
+        assert got                       # free key: taken
+    release.set()
+    t.join(5.0)
+    with m.try_hold("a") as got:
+        assert got                       # free again
+    assert m._locks == {}                # refcounted cleanup ran
+
+
 def test_queue_close_rejects_and_join_waits():
     q = lifecycle.AdmissionQueue(depth=4)
     done = []
@@ -733,5 +777,110 @@ spec:
             url + "/api/capacity",
             {**body, "sweep_mode": "exhaustive", "resume": out1["sweep_id"]})
         assert s4 == 400 and out4["field"] == "resume"
+    finally:
+        httpd.shutdown()
+
+
+# ---- drain with open digital-twin sessions (ISSUE 11 satellite) ----------
+
+
+TWIN_CLUSTER_YAML = """
+apiVersion: v1
+kind: Node
+metadata: {name: s0}
+status:
+  allocatable: {cpu: "8", memory: 16Gi, pods: "110"}
+---
+apiVersion: v1
+kind: Node
+metadata: {name: s1}
+status:
+  allocatable: {cpu: "8", memory: 16Gi, pods: "110"}
+"""
+
+
+def test_drain_with_open_sessions_journals_and_resumes(tmp_path,
+                                                       monkeypatch):
+    """SIGTERM (begin_drain) with an in-flight /events POST: the step
+    FINISHES and lands in the session journal, readyz flips while
+    healthz stays 200, new events bounce E_BUSY, the drain reports the
+    flushed sessions — and a restarted server serves the session with
+    the drained-through digest intact."""
+    from open_simulator_tpu.replay import session as sess_mod
+
+    monkeypatch.setenv(lifecycle.CHECKPOINT_DIR_ENV, str(tmp_path))
+    srv, httpd, url = _mini_server(depth=2, drain_timeout_s=10.0)
+    try:
+        status, _, created = _post_status(
+            url + "/api/session",
+            {"cluster": {"yaml": TWIN_CLUSTER_YAML}, "name": "drainme"})
+        assert status == 200, created
+        sid = created["session_id"]
+
+        real_settle = sess_mod.settle_step
+        started, release = threading.Event(), threading.Event()
+
+        def slow_settle(*a, **kw):
+            started.set()
+            release.wait(10.0)
+            return real_settle(*a, **kw)
+
+        monkeypatch.setattr(sess_mod, "settle_step", slow_settle)
+        inflight = {}
+
+        def post_events():
+            inflight["out"] = _post_status(
+                url + f"/api/session/{sid}/events",
+                {"events": [{"t": 1, "kind": "kill_node", "target": "s0"}]})
+
+        t = threading.Thread(target=post_events)
+        t.start()
+        assert started.wait(10.0), "events POST never reached the worker"
+        drain_info = {}
+        drainer = threading.Thread(
+            target=lambda: drain_info.update(srv.begin_drain()))
+        drainer.start()
+        deadline = time.time() + 5
+        flipped = None
+        while time.time() < deadline:
+            try:
+                urllib.request.urlopen(url + "/readyz")
+            except urllib.error.HTTPError as e:
+                flipped = (e.code, json.loads(e.read()))
+                break
+            time.sleep(0.05)
+        assert flipped == (503, {"ready": False, "draining": True}), flipped
+        with urllib.request.urlopen(url + "/healthz") as r:
+            hz = json.loads(r.read())
+        assert hz["status"] == "healthy" and hz["draining"] is True
+        status, _, body = _post_status(
+            url + f"/api/session/{sid}/events",
+            {"events": [{"t": 2, "kind": "kill_node", "target": "s1"}]})
+        assert status == 503 and body["code"] == "E_BUSY", (status, body)
+        release.set()
+        t.join(15.0)
+        drainer.join(15.0)
+        assert not t.is_alive() and not drainer.is_alive()
+        # the in-flight step FINISHED the drain (not cancelled)
+        assert inflight["out"][0] == 200, inflight["out"]
+        digest = inflight["out"][2]["digest"]
+        assert inflight["out"][2]["status"]["steps"] == 2
+        assert drain_info["drained_clean"] is True
+        assert drain_info["open_sessions"] == 1
+        assert drain_info["flushed"] == 1
+        monkeypatch.setattr(sess_mod, "settle_step", real_settle)
+        # every settled step is on disk: header + baseline + the event
+        jpath = tmp_path / (sid + sess_mod.SESSION_JOURNAL_SUFFIX)
+        with open(jpath, encoding="utf-8") as f:
+            kinds = [json.loads(ln)["kind"] for ln in f]
+        assert kinds == ["header", "step", "step"]
+        # "restart": a fresh server over the same checkpoint dir serves
+        # the session bit-identically and keeps settling events
+        srv2 = SimulationServer()
+        out = srv2.session_status(sid, {})
+        assert out["digest"] == digest and out["steps"] == 2
+        more = srv2.session_events(sid, {"events": [
+            {"t": 2, "kind": "kill_node", "target": "s1"}]})
+        assert more["status"]["steps"] == 3
     finally:
         httpd.shutdown()

@@ -1,0 +1,690 @@
+"""Digital-twin session tests (replay/session.py, ISSUE 11).
+
+Covers the crash-safety contract (SIGKILL a child mid-session, rehydrate,
+continue to a BIT-IDENTICAL trajectory digest), fork isolation (raise /
+timeout / audit violation each quarantine the branch while the mainline
+digest is untouched), the zero-new-compile fork claim, LRU eviction +
+transparent rehydration, the REST surface, and the fuzzed trace boundary
+(~50 seeded mutations -> structured 400s, never 500s)."""
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from open_simulator_tpu.errors import SimulationError
+from open_simulator_tpu.replay import (
+    ReplaySession,
+    SessionSpec,
+    SessionStore,
+    synthetic_replay_cluster,
+    synthetic_trace_dict,
+)
+from open_simulator_tpu.replay.session import (
+    E_NO_SESSION,
+    SESSION_JOURNAL_SUFFIX,
+    SessionJournal,
+)
+from open_simulator_tpu.resilience import lifecycle
+
+N_NODES = 3
+N_INITIAL = 3
+KILL_AFTER_STEPS = 3
+
+
+def _workload():
+    """One shared shape for every test in this file (same buckets ->
+    the process-level jit cache makes later sessions cheap)."""
+    td = synthetic_trace_dict(n_batches=4, batch_pods=4, depart_every=2,
+                              max_new_nodes=4)
+    cluster = synthetic_replay_cluster(n_nodes=N_NODES,
+                                       n_initial_pods=N_INITIAL)
+    spec = SessionSpec(max_new_nodes=4, node_template=td["node_template"])
+    return cluster, spec, td["events"]
+
+
+def _make_session(tmp_path=None, controllers=None):
+    """checkpoint=None is the auto mode: journaled when ``tmp_path`` (or
+    the child process's SIMON_CHECKPOINT_DIR) provides a root."""
+    cluster, spec, events = _workload()
+    sess = ReplaySession.create(
+        cluster, spec,
+        controllers=controllers
+        if controllers is not None
+        else [{"kind": "autoscaler", "scale_step": 2}],
+        root=str(tmp_path) if tmp_path else None)
+    return sess, events
+
+
+@pytest.fixture()
+def no_checkpoint(monkeypatch):
+    monkeypatch.delenv(lifecycle.CHECKPOINT_DIR_ENV, raising=False)
+    monkeypatch.delenv("SIMON_LEDGER_DIR", raising=False)
+    from open_simulator_tpu.telemetry import ledger
+
+    ledger.configure(None)
+    yield
+
+
+# ---- lifecycle basics ----------------------------------------------------
+
+
+def test_session_baseline_events_status_close(tmp_path, no_checkpoint):
+    sess, events = _make_session(tmp_path)
+    assert len(sess.rows) == 1  # the settled baseline step
+    assert sess.rows[0]["event"]["kind"] == "baseline"
+    assert sess.status()["placed"] == N_INITIAL
+
+    rows = sess.apply_events(events[:3])
+    assert len(rows) == 3 and len(sess.rows) == 4
+    st = sess.status()
+    assert st["steps"] == 4 and st["events"] == 3
+    assert st["resident"] and not st["closed"]
+    placements = sess.placements()
+    assert sum(len(v) for v in placements.values()) == st["placed"]
+
+    # every settled step is one fsynced journal line
+    [journal] = [n for n in os.listdir(tmp_path)
+                 if n.endswith(SESSION_JOURNAL_SUFFIX)]
+    with open(tmp_path / journal, encoding="utf-8") as f:
+        kinds = [json.loads(ln)["kind"] for ln in f]
+    assert kinds == ["header"] + ["step"] * 4
+
+    out = sess.close()
+    assert out["closed"] and out["steps"] == 4
+    assert lifecycle.journal_is_done(str(tmp_path / journal))
+    with pytest.raises(SimulationError) as ei:
+        sess.apply_events(events[3:4])
+    assert ei.value.code == E_NO_SESSION
+
+
+def test_session_validation_rejects_before_mutating(tmp_path,
+                                                    no_checkpoint):
+    sess, events = _make_session(tmp_path)
+    sess.apply_events(events[:1])
+    before = len(sess.rows)
+    cases = [
+        ([], "events"),                                     # empty batch
+        ([{"t": 99, "kind": "meteor", "target": "x"}], ".kind"),
+        ([{"t": -1, "kind": "kill_node", "target": "rn-0"}], ".t"),
+        ([events[0]], ".app.name"),  # duplicate arrival name
+        ([{"t": 99, "kind": "arrive", "app": {"name": "nx"}}], ".app.yaml"),
+    ]
+    for bad, field_frag in cases:
+        with pytest.raises(SimulationError) as ei:
+            sess.apply_events(bad)
+        assert ei.value.code == "E_SPEC", bad
+        assert field_frag in (ei.value.field or "") or field_frag == (
+            ei.value.field or ""), (bad, ei.value.field)
+    assert len(sess.rows) == before  # nothing settled, nothing journaled
+
+
+def test_session_spec_validation(no_checkpoint):
+    with pytest.raises(SimulationError) as ei:
+        SessionSpec.from_dict({"max_new_nodes": -1})
+    assert ei.value.code == "E_SPEC"
+    with pytest.raises(SimulationError) as ei:
+        SessionSpec.from_dict({"max_new_nodes": 2})
+    assert "node_template" in ei.value.field
+    with pytest.raises(SimulationError) as ei:
+        SessionSpec.from_dict({"max_new_nodes": "many"})
+    assert ei.value.code == "E_SPEC"
+
+
+# ---- crash safety --------------------------------------------------------
+
+
+def _uninterrupted_digest(tmp_path, events):
+    sess, _ = _make_session(tmp_path)
+    sess.apply_events(events)
+    return sess.digest, sess.session_id
+
+
+def _child_main():
+    """Crash subprocess: settle events but SIGKILL self the moment step
+    KILL_AFTER_STEPS lands in the journal — a real uncatchable kill."""
+    from open_simulator_tpu.replay import session as sess_mod
+
+    real_append = sess_mod.SessionJournal.append_step
+
+    def kamikaze(self, event, row):
+        real_append(self, event, row)
+        if len(self.steps) >= KILL_AFTER_STEPS:
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    sess_mod.SessionJournal.append_step = kamikaze
+    from tests.test_session import _make_session
+
+    sess, events = _make_session()  # journals via SIMON_CHECKPOINT_DIR
+    assert sess.journal is not None
+    sess.apply_events(events)
+    raise SystemExit("unreachable: the kill must fire mid-session")
+
+
+def test_sigkill_mid_session_rehydrates_bit_identical(tmp_path,
+                                                      no_checkpoint):
+    """The acceptance criterion: a process killed mid-session, then a
+    fresh SessionStore scan + rehydrate + the remaining events, produces
+    a trajectory digest BIT-IDENTICAL to an uninterrupted session."""
+    cluster, spec, events = _workload()
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    ref_digest, _ = _uninterrupted_digest(ref_dir, events)
+
+    crash_dir = tmp_path / "crash"
+    crash_dir.mkdir()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           lifecycle.CHECKPOINT_DIR_ENV: str(crash_dir)}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, %r); "
+         "from tests.test_session import _child_main; _child_main()"
+         % repo],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == -signal.SIGKILL, (
+        proc.returncode, proc.stdout[-2000:], proc.stderr[-2000:])
+
+    store = SessionStore(root=str(crash_dir))
+    [sid] = store.scan()
+    sess = store.get(sid)
+    # the settled prefix: baseline + KILL_AFTER_STEPS events (step rows
+    # include the baseline, so events settled = KILL_AFTER_STEPS - 1)
+    assert len(sess.rows) == KILL_AFTER_STEPS
+    sess.apply_events(events[KILL_AFTER_STEPS - 1:])
+    assert sess.digest == ref_digest
+
+
+def test_rehydrate_rejects_mangled_journal(tmp_path, no_checkpoint):
+    sess, events = _make_session(tmp_path)
+    sess.apply_events(events[:1])
+    path = sess.journal.path
+    # mangle the header's cluster docs: the self-contained fingerprint
+    # must refuse to rehydrate a journal whose payload no longer hashes
+    # to what the header recorded
+    lines = open(path, encoding="utf-8").read().splitlines()
+    header = json.loads(lines[0])
+    header["cluster_docs"] = header["cluster_docs"][:-1]
+    lines[0] = json.dumps(header, sort_keys=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")
+    with pytest.raises(lifecycle.ResumeError):
+        ReplaySession.rehydrate(path)
+
+
+# ---- fork isolation ------------------------------------------------------
+
+
+def test_fork_completes_and_mainline_untouched(tmp_path, no_checkpoint):
+    sess, events = _make_session(tmp_path)
+    sess.apply_events(events[:3])
+    digest = sess.digest
+    bound_before = sess._world.bound.copy()
+    t = sess.rows[-1]["t"] + 1
+    rec = sess.fork({"name": "chaos", "events": [
+        {"t": t, "kind": "kill_node", "target": "rn-0"}]})
+    assert rec["status"] == "completed"
+    assert rec["steps"] == 1 and rec["rows"][0]["event"]["kind"] == "kill_node"
+    # the branch saw the fault, the mainline never did
+    assert rec["rows"][0]["evicted"] or rec["totals"]["lost"] >= 0
+    assert sess.digest == digest
+    assert (sess._world.bound == bound_before).all()
+    # mainline advances fine after the fork
+    sess.apply_events(events[3:4])
+    assert len(sess.rows) == 5
+
+
+def test_poisoned_fork_quarantines_raise_timeout_audit(tmp_path,
+                                                       no_checkpoint,
+                                                       monkeypatch):
+    """The three quarantine triggers, each leaving the mainline digest
+    unchanged and the session usable: (1) a raise inside the branch,
+    (2) a blown fork deadline, (3) a placement-audit violation."""
+    sess, events = _make_session(tmp_path)
+    sess.apply_events(events[:2])
+    digest = sess.digest
+    t = sess.rows[-1]["t"] + 1
+
+    # (1) raise: unknown node target surfaces mid-branch
+    rec = sess.fork({"events": [
+        {"t": t, "kind": "node_remove", "target": "no-such-node"}]})
+    assert rec["status"] == "quarantined"
+    assert rec["error"]["code"] == "E_SPEC"
+    assert sess.digest == digest
+
+    # (2) timeout: an already-expired fork deadline quarantines with the
+    # deadline story, not the request's
+    rec = sess.fork({"deadline_s": 1e-9, "events": [
+        {"t": t, "kind": "kill_node", "target": "rn-1"}]})
+    assert rec["status"] == "quarantined"
+    assert rec["error"]["code"] == "E_DEADLINE"
+    assert sess.digest == digest
+
+    # (3) audit violation: corrupt the branch's outcome (every live pod
+    # piled onto node 0) — audit_assignment must catch the overcommit
+    from open_simulator_tpu.replay import session as sess_mod
+
+    real_settle = sess_mod.settle_step
+
+    def corrupting(prog, world, controllers, ev, step, **kw):
+        row = real_settle(prog, world, controllers, ev, step, **kw)
+        world.bound[world.present] = 0
+        return row
+
+    monkeypatch.setattr(sess_mod, "settle_step", corrupting)
+    rec = sess.fork({"events": [
+        {"t": t, "kind": "kill_node", "target": "rn-1"}]})
+    monkeypatch.setattr(sess_mod, "settle_step", real_settle)
+    assert rec["status"] == "quarantined"
+    assert rec["error"]["code"] == "E_AUDIT"
+    assert rec["error"]["audit"]["violations"], rec["error"]
+    assert sess.digest == digest
+
+    # quarantine history is journaled and survives rehydration
+    st = sess.status()
+    assert st["forks"]["quarantined"] == 3
+    s2 = ReplaySession.rehydrate(sess.journal.path)
+    assert s2.status()["forks"]["quarantined"] == 3
+    # the mainline still settles events after all three poisons
+    sess.apply_events(events[2:3])
+    assert len(sess.rows) == 4
+
+
+def test_fork_zero_new_compiles(tmp_path, no_checkpoint):
+    """Acceptance: forks execute as extra launches of the SAME bucketed
+    executable — the schedule_pods jit cache gains no entries and
+    simon_compile_cache_total records no new misses."""
+    from open_simulator_tpu import telemetry
+    from open_simulator_tpu.engine.scheduler import schedule_pods
+
+    sess, events = _make_session(tmp_path)
+    sess.apply_events(events[:2])
+    t = sess.rows[-1]["t"] + 1
+    before = telemetry.jit_cache_size(schedule_pods)
+    misses_before = sum(
+        v for k, v in telemetry.REGISTRY.counter_samples().items()
+        if "simon_compile_cache_total" in k and "event=miss" in k)
+    rec = sess.fork({"events": [
+        {"t": t, "kind": "kill_node", "target": "rn-0"},
+        {"t": t + 1, "kind": "node_add", "count": 2}]})
+    assert rec["status"] == "completed"
+    assert telemetry.jit_cache_size(schedule_pods) == before
+    misses_after = sum(
+        v for k, v in telemetry.REGISTRY.counter_samples().items()
+        if "simon_compile_cache_total" in k and "event=miss" in k)
+    assert misses_after == misses_before
+
+
+def test_fork_controller_variant_diverges(tmp_path, no_checkpoint):
+    """An autoscaler-variant fork sees different scaling than the
+    mainline would — the policy-search payoff."""
+    sess, events = _make_session(tmp_path, controllers=[])
+    # no autoscaler: the post-chaos arrivals overflow the surviving nodes
+    sess.apply_events(events[:6])
+    st = sess.status()
+    assert st["pending"] > 0
+    t = sess.rows[-1]["t"] + 1
+    rec = sess.fork({
+        "controllers": [{"kind": "autoscaler", "scale_step": 4}],
+        "events": [{"t": t, "kind": "kill_node", "target": "rn-2"}]})
+    assert rec["status"] == "completed"
+    # the fork's autoscaler scaled into the template slots; the mainline
+    # still has no scale-ups recorded
+    assert any(a["kind"] == "scale_up"
+               for r in rec["rows"] for a in r["actions"])
+    assert all(not r["actions"] for r in sess.rows)
+
+
+# ---- eviction / residency cap --------------------------------------------
+
+
+def test_lru_eviction_keeps_sessions_open_and_rehydrates(tmp_path,
+                                                         no_checkpoint):
+    store = SessionStore(root=str(tmp_path), max_resident=1)
+    cluster, spec, events = _workload()
+    a = store.create(cluster, spec)
+    a_digest = a.digest
+    b = store.create(synthetic_replay_cluster(
+        n_nodes=N_NODES, n_initial_pods=N_INITIAL), spec)
+    # the cap is 1: creating b evicted a (device state dropped, still open)
+    assert not a.resident and b.resident
+    listed = {s["session_id"] for s in store.list()}
+    assert listed == {a.session_id, b.session_id}
+    # touching a rehydrates it transparently (and evicts b, the new LRU)
+    a2 = store.get(a.session_id)
+    rows = a2.apply_events(events[:1])
+    assert len(rows) == 1 and a2.digest != a_digest
+    assert a2.resident and not b.resident
+
+
+def test_store_unknown_and_closed_sessions_404(tmp_path, no_checkpoint):
+    store = SessionStore(root=str(tmp_path))
+    with pytest.raises(SimulationError) as ei:
+        store.get("feedfacecafe")
+    assert ei.value.code == E_NO_SESSION
+    cluster, spec, _ = _workload()
+    sess = store.create(cluster, spec)
+    store.close(sess.session_id)
+    with pytest.raises(SimulationError) as ei:
+        store.get(sess.session_id)
+    assert ei.value.code == E_NO_SESSION
+    assert store.list() == []
+
+
+def test_session_id_traversal_rejected(tmp_path, no_checkpoint):
+    """Session ids become journal filenames: a path-shaped id must be a
+    structured 404, never an os.path.join escape from the checkpoint
+    dir."""
+    store = SessionStore(root=str(tmp_path / "ckpt"))
+    outside = tmp_path / "outside"
+    outside.mkdir()
+    (outside / "victim.session.jsonl").write_text(
+        json.dumps({"kind": "header", "session_id": "victim"}) + "\n")
+    for sid in ("../outside/victim", "a/b", "..", ".", "x" * 65, "",
+                "..\\victim"):
+        with pytest.raises(SimulationError) as ei:
+            store.get(sid)
+        assert ei.value.code == E_NO_SESSION, sid
+
+
+def test_list_does_not_perturb_lru_recency(tmp_path, no_checkpoint):
+    """GET /api/session is a monitoring surface: walking every session
+    must not reset last_touch, or a poller would turn LRU eviction into
+    sid-sorted eviction of the actively-used sessions."""
+    store = SessionStore(root=str(tmp_path), max_resident=2)
+    cluster, spec, _ = _workload()
+    a = store.create(cluster, spec)
+    b = store.create(synthetic_replay_cluster(
+        n_nodes=N_NODES, n_initial_pods=N_INITIAL), spec)
+    before = (a.last_touch, b.last_touch)
+    assert len(store.list()) == 2
+    assert (a.last_touch, b.last_touch) == before
+
+
+# ---- journal pruning (satellite: shared keep-N policy) -------------------
+
+
+def test_closed_session_journals_pruned_open_kept(tmp_path, monkeypatch,
+                                                  no_checkpoint):
+    monkeypatch.setenv(lifecycle.SHARED_JOURNAL_KEEP_ENV, "2")
+    cluster, spec, events = _workload()
+    keep_open = []
+    for i in range(5):
+        sess = ReplaySession.create(cluster, spec, root=str(tmp_path),
+                                    checkpoint=True)
+        if i < 2:
+            keep_open.append(sess.session_id)  # stays open
+        else:
+            sess.close()
+    # a new create prunes closed journals past keep=2; open ones stay
+    sess = ReplaySession.create(cluster, spec, root=str(tmp_path),
+                                checkpoint=True)
+    names = [n for n in os.listdir(tmp_path)
+             if n.endswith(SESSION_JOURNAL_SUFFIX)]
+    open_names = [n for n in names
+                  if not lifecycle.journal_is_done(str(tmp_path / n))]
+    closed_names = [n for n in names
+                    if lifecycle.journal_is_done(str(tmp_path / n))]
+    assert len(closed_names) <= 2
+    assert {n.split(".")[0] for n in open_names} >= set(keep_open)
+
+
+# ---- REST surface --------------------------------------------------------
+
+
+CLUSTER_YAML = textwrap.dedent("""
+    apiVersion: v1
+    kind: Node
+    metadata: {name: s0, labels: {"topology.kubernetes.io/zone": z0}}
+    status:
+      allocatable: {cpu: "8", memory: 16Gi, pods: "110"}
+    ---
+    apiVersion: v1
+    kind: Node
+    metadata: {name: s1, labels: {"topology.kubernetes.io/zone": z1}}
+    status:
+      allocatable: {cpu: "8", memory: 16Gi, pods: "110"}
+""")
+
+APP_YAML = textwrap.dedent("""
+    apiVersion: apps/v1
+    kind: Deployment
+    metadata: {name: wrest, namespace: default}
+    spec:
+      replicas: 2
+      selector: {matchLabels: {app: wrest}}
+      template:
+        metadata: {labels: {app: wrest}}
+        spec:
+          containers:
+            - name: c
+              resources: {requests: {cpu: "1", memory: 1Gi}}
+""")
+
+
+@pytest.fixture()
+def session_server(tmp_path, monkeypatch):
+    from open_simulator_tpu.server.rest import (
+        SimulationServer,
+        _make_handler,
+    )
+
+    monkeypatch.setenv(lifecycle.CHECKPOINT_DIR_ENV, str(tmp_path))
+    httpd = ThreadingHTTPServer(
+        ("127.0.0.1", 0), _make_handler(SimulationServer()))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def _call(base, method, path, payload=None):
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        base + path, data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_session_rest_lifecycle(session_server):
+    base = session_server
+    st, out = _call(base, "POST", "/api/session",
+                    {"cluster": {"yaml": CLUSTER_YAML}, "name": "rest"})
+    assert st == 200 and out["created"] and out["steps"] == 1
+    sid = out["session_id"]
+    st, out = _call(base, "POST", f"/api/session/{sid}/events", {
+        "events": [{"t": 1, "kind": "arrive",
+                    "app": {"name": "wrest", "yaml": APP_YAML}}]})
+    assert st == 200 and out["status"]["placed"] == 2, out
+    st, out = _call(base, "GET", f"/api/session/{sid}?placements=1")
+    assert st == 200 and sum(
+        len(v) for v in out["placements"].values()) == 2
+    st, out = _call(base, "POST", f"/api/session/{sid}/fork", {"forks": [
+        {"events": [{"t": 2, "kind": "kill_node", "target": "s0"}]},
+        {"events": [{"t": 2, "kind": "node_remove", "target": "zz"}]},
+    ]})
+    assert st == 200
+    statuses = [f["status"] for f in out["forks"]]
+    assert statuses == ["completed", "quarantined"]
+    st, listing = _call(base, "GET", "/api/session")
+    assert st == 200 and len(listing["sessions"]) == 1
+    st, out = _call(base, "DELETE", f"/api/session/{sid}")
+    assert st == 200 and out["closed"]
+    st, out = _call(base, "GET", f"/api/session/{sid}")
+    assert st == 404 and out["code"] == E_NO_SESSION
+    st, out = _call(base, "POST", "/api/session/zzz/events",
+                    {"events": [{"t": 1, "kind": "node_add", "count": 1}]})
+    assert st == 404 and out["code"] == E_NO_SESSION
+
+
+def test_session_rest_validation_400s(session_server):
+    base = session_server
+    st, out = _call(base, "POST", "/api/session", {
+        "cluster": {"yaml": CLUSTER_YAML},
+        "spec": {"max_new_nodes": "lots"}})
+    assert st == 400 and out["code"] == "E_SPEC"
+    st, out = _call(base, "POST", "/api/session", {
+        "cluster": {"yaml": CLUSTER_YAML}, "controllers": "autoscaler"})
+    assert st == 400 and out["code"] == "E_BAD_REQUEST"
+    st, created = _call(base, "POST", "/api/session",
+                        {"cluster": {"yaml": CLUSTER_YAML}})
+    sid = created["session_id"]
+    st, out = _call(base, "POST", f"/api/session/{sid}/events",
+                    {"events": [{"t": 0, "kind": "meteor"}]})
+    assert st == 400 and out["code"] == "E_SPEC", out
+    st, out = _call(base, "POST", f"/api/session/{sid}/fork",
+                    {"events": []})
+    assert st == 400 and out["code"] == "E_SPEC"
+    # a path-shaped session id must 404 structurally, not escape the
+    # checkpoint dir via os.path.join
+    st, out = _call(base, "GET", "/api/session/..%2Fescape")
+    assert st == 404 and out["code"] == E_NO_SESSION
+    st, out = _call(base, "DELETE", "/api/session/..%2Fescape")
+    assert st == 404 and out["code"] == E_NO_SESSION
+
+
+# ---- fuzzed trace boundary (satellite) -----------------------------------
+
+
+def _base_trace():
+    return {
+        "events": [
+            {"t": 0, "kind": "arrive",
+             "app": {"name": "fz", "yaml": APP_YAML}},
+            {"t": 1, "kind": "kill_node", "target": "s0"},
+            {"t": 2, "kind": "depart", "app": "fz"},
+        ],
+        "max_new_nodes": 0,
+        "node_template": "",
+    }
+
+
+def _mutate_trace(doc, rng):
+    """One seeded mutation per the ISSUE families: dropped keys, wrong
+    types, negative timestamps, bogus event kinds, mangled nesting."""
+    doc = json.loads(json.dumps(doc))
+    events = doc.get("events") or []
+    kind = rng.randrange(7)
+    if kind == 0 and events:          # drop a key from a random event
+        ev = rng.choice(events)
+        if ev:
+            ev.pop(rng.choice(sorted(ev)), None)
+    elif kind == 1 and events:        # wrong type for a random field
+        ev = rng.choice(events)
+        key = rng.choice(sorted(ev)) if ev else None
+        if key:
+            ev[key] = rng.choice([42, ["x"], None, {"deep": []}])
+    elif kind == 2 and events:        # negative / non-monotone timestamp
+        rng.choice(events)["t"] = rng.choice([-5, -1e9, "noon", None])
+    elif kind == 3 and events:        # bogus event kind
+        rng.choice(events)["kind"] = rng.choice(
+            ["meteor", 7, "", None, "ARRIVE"])
+    elif kind == 4:                   # events is the wrong shape
+        doc["events"] = rng.choice([42, "nope", {"a": 1}, None])
+    elif kind == 5:                   # trace-level knobs mangled
+        doc[rng.choice(["max_new_nodes", "node_template", "zone_key"])] = \
+            rng.choice([-3, ["x"], {"y": 2}, "not yaml: ["])
+    else:                             # event list truncated to garbage
+        doc["events"] = events[: rng.randrange(len(events) + 1)] + [
+            rng.choice([[], "ev", 3.14])]
+    return doc
+
+
+def test_fuzzed_traces_structured_400s_never_500(session_server):
+    """~50 seeded ReplayTrace mutations against BOTH boundaries: every
+    answer is a 200 (mutation happened to stay valid) or a structured
+    400 — never a 500 (tracebacks are the server's bug, not the
+    client's)."""
+    base = session_server
+    rng = random.Random(1211)
+    st, created = _call(base, "POST", "/api/session",
+                        {"cluster": {"yaml": CLUSTER_YAML}})
+    assert st == 200
+    sid = created["session_id"]
+    outcomes = {"ok": 0, "structured": 0}
+    next_t = [100.0]
+    for i in range(50):
+        doc = _mutate_trace(_base_trace(), rng)
+        if i % 2 == 0:
+            status, out = _call(base, "POST", "/api/replay",
+                                {"cluster": {"yaml": CLUSTER_YAML},
+                                 "trace": doc})
+        else:
+            evs = doc.get("events")
+            if isinstance(evs, list):
+                # keep timestamps ahead of the settled trajectory and
+                # arrival names fresh so surviving mutants stay valid
+                for off, ev in enumerate(evs):
+                    if isinstance(ev, dict):
+                        if isinstance(ev.get("t"), (int, float)):
+                            ev["t"] = next_t[0] + off
+                        app = ev.get("app")
+                        if isinstance(app, dict) and app.get("name"):
+                            app["name"] = f"fz{i}"
+                        elif isinstance(app, str):
+                            ev["app"] = f"fz{i}"
+                next_t[0] += len(evs) + 1
+            status, out = _call(base, "POST", f"/api/session/{sid}/events",
+                                {"events": evs})
+        assert status in (200, 400), (i, doc, status, out)
+        if status == 200:
+            outcomes["ok"] += 1
+        else:
+            assert out.get("code"), (i, doc, out)
+            assert out.get("error"), (i, doc, out)
+            outcomes["structured"] += 1
+    assert outcomes["structured"] > 30, outcomes
+    assert sum(outcomes.values()) == 50
+
+
+def test_digest_invariant_to_event_batching(tmp_path, no_checkpoint):
+    """The trajectory digest must not depend on how events were split
+    across POSTs (rows canonicalize assign to the SETTLED universe; the
+    transient batch tail is base sentinels either way)."""
+    cluster, spec, events = _workload()
+    a = ReplaySession.create(cluster, spec, root=str(tmp_path))
+    a.apply_events(events)
+    b = ReplaySession.create(
+        synthetic_replay_cluster(n_nodes=N_NODES,
+                                 n_initial_pods=N_INITIAL),
+        spec, root=str(tmp_path))
+    for e in events:
+        b.apply_events([e])
+    assert a.digest == b.digest
+
+
+def test_ledger_step_digests_match_journal_rows(tmp_path, monkeypatch):
+    """The per-step ledger RunRecord must carry the digest of the
+    TRUNCATED (settled-width) row — the same batching-invariant digest
+    the journal line has — not the transient whole-batch assign tail."""
+    from open_simulator_tpu.replay.engine import row_digest
+    from open_simulator_tpu.telemetry import ledger
+
+    monkeypatch.delenv(lifecycle.CHECKPOINT_DIR_ENV, raising=False)
+    ledger.configure(str(tmp_path / "ledger"))
+    try:
+        cluster, spec, events = _workload()
+        sess = ReplaySession.create(cluster, spec,
+                                    root=str(tmp_path / "ckpt"))
+        sess.apply_events(events)  # one batched POST
+        recs = [r for r in ledger.default_ledger().records(
+                    surface="session")
+                if r["tags"].get("session") == sess.session_id]
+        recs.sort(key=lambda r: r["tags"]["step"])
+        assert [r["result"]["digest"] for r in recs] == \
+            [row_digest(r) for r in sess.rows]
+    finally:
+        ledger.configure(None)

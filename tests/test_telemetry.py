@@ -5,6 +5,7 @@ trace, utils/trace coverage, stack instrumentation, and the REST
 import json
 import logging
 import threading
+import time
 import urllib.error
 import urllib.request
 from http.server import ThreadingHTTPServer
@@ -362,12 +363,21 @@ def test_explain_404_before_any_simulation(telemetry_server):
 def test_metrics_endpoint_serves_core_series(telemetry_server, caplog):
     with caplog.at_level(logging.DEBUG, logger="simon-tpu.http"):
         out = _post(telemetry_server + "/api/deploy-apps", _tiny_body())
-    assert not out["unscheduled_pods"]
-    # the access log routed method/path/status/duration through the logger
-    access = [r.getMessage() for r in caplog.records
-              if r.name == "simon-tpu.http"]
-    assert any("POST /api/deploy-apps -> 200" in m and "ms" in m
-               for m in access)
+        assert not out["unscheduled_pods"]
+        # the access log routed method/path/status/duration through the
+        # logger; the server thread writes it AFTER flushing the response
+        # body, so the client can observe the response first — wait out
+        # that handoff instead of racing it
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            access = [r.getMessage() for r in caplog.records
+                      if r.name == "simon-tpu.http"]
+            if any("POST /api/deploy-apps -> 200" in m and "ms" in m
+                   for m in access):
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError(f"no timed access-log line in {access}")
 
     status, headers, text = _get(telemetry_server + "/metrics")
     assert status == 200

@@ -9,7 +9,10 @@ in flight, SIGTERM the process, then assert
   2. new POSTs are rejected 503 E_BUSY ("draining"),
   3. the in-flight request still completes 200,
   4. the process exits 0 and its final ledger record
-     (surface "server:drain") is on disk.
+     (surface "server:drain") is on disk,
+  5. (ISSUE 11) an open digital-twin session created before the SIGTERM
+     is served by a RESTARTED server with its drained-through digest
+     intact, and keeps settling events.
 """
 
 import json
@@ -77,11 +80,14 @@ def main() -> int:
     port = _free_port()
     base = f"http://127.0.0.1:{port}"
     ledger_dir = tempfile.mkdtemp(prefix="simon-lifecycle-smoke-")
+    ckpt_dir = tempfile.mkdtemp(prefix="simon-lifecycle-ckpt-")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "SIMON_CHECKPOINT_DIR": ckpt_dir}
     proc = subprocess.Popen(
         [sys.executable, "-m", "open_simulator_tpu.cli", "server",
          "--port", str(port), "--ledger-dir", ledger_dir,
          "--drain-timeout", "60"],
-        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
     try:
         deadline = time.time() + 60
@@ -100,6 +106,18 @@ def main() -> int:
 
         status, ready = _get(base + "/readyz")
         assert status == 200 and ready == {"ready": True}, (status, ready)
+
+        # an open digital-twin session that must survive the drain
+        status, sess = _post(base + "/api/session", {
+            "cluster": {"yaml": CLUSTER_YAML}, "name": "drain-smoke"})
+        assert status == 200 and sess["steps"] == 1, (status, sess)
+        sid = sess["session_id"]
+        status, fed = _post(base + f"/api/session/{sid}/events", {
+            "events": [{"t": 1, "kind": "arrive",
+                        "app": {"name": "smoke", "yaml": APP_YAML}}]})
+        assert status == 200, (status, fed)
+        sess_digest = fed["digest"]
+        print(f"lifecycle: session {sid} open with 2 settled steps")
 
         # one request in flight: the FIRST simulation in the process has
         # the XLA compile ahead of it — seconds of real work to drain over
@@ -148,8 +166,52 @@ def main() -> int:
                   encoding="utf-8") as f:
             surfaces = [json.loads(ln).get("surface") for ln in f]
         assert "server:drain" in surfaces, surfaces
-        print(f"lifecycle smoke OK: drained clean, final ledger record "
-              f"written ({surfaces.count('server:drain')} drain record)")
+        print(f"lifecycle: drained clean, final ledger record written "
+              f"({surfaces.count('server:drain')} drain record)")
+
+        # restart over the same checkpoint dir: the drained session must
+        # come back with its digest intact and keep settling events
+        port2 = _free_port()
+        base2 = f"http://127.0.0.1:{port2}"
+        proc2 = subprocess.Popen(
+            [sys.executable, "-m", "open_simulator_tpu.cli", "server",
+             "--port", str(port2), "--ledger-dir", ledger_dir],
+            env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        try:
+            deadline = time.time() + 60
+            while True:
+                try:
+                    status, _ = _get(base2 + "/test", timeout=1.0)
+                    if status == 200:
+                        break
+                except OSError:
+                    pass
+                if time.time() > deadline:
+                    raise SystemExit("restarted server never came up")
+                if proc2.poll() is not None:
+                    raise SystemExit(
+                        f"restarted server exited early rc={proc2.returncode}")
+                time.sleep(0.2)
+            status, listing = _get(base2 + "/api/session")
+            ids = [s["session_id"] for s in listing.get("sessions", [])]
+            assert status == 200 and sid in ids, (status, listing)
+            status, st = _get(base2 + f"/api/session/{sid}")
+            assert status == 200 and st["digest"] == sess_digest, (
+                status, st, sess_digest)
+            status, more = _post(base2 + f"/api/session/{sid}/events", {
+                "events": [{"t": 2, "kind": "depart", "app": "smoke"}]})
+            assert status == 200 and more["status"]["steps"] == 3, (
+                status, more)
+            print("lifecycle smoke OK: restarted server resumed the open "
+                  "session digest-identical and settled a new event")
+        finally:
+            if proc2.poll() is None:
+                proc2.send_signal(signal.SIGTERM)
+                try:
+                    proc2.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc2.kill()
         return 0
     finally:
         if proc.poll() is None:

@@ -135,6 +135,15 @@ if [ "$rc" -ne 0 ]; then
 fi
 
 echo
+echo "== digital-twin sessions (SIGKILL the server, resume digest-identical, fork isolation) =="
+make session-smoke
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: session-smoke exited $rc" >&2
+  exit "$rc"
+fi
+
+echo
 echo "== serving lifecycle (SIGTERM drain: readyz flip, 503s, in-flight finishes) =="
 make lifecycle-smoke
 rc=$?
