@@ -3,7 +3,7 @@
 # scenario end to end (tools/smoke.sh).
 
 .PHONY: test lint smoke bench bench-smoke bench-regress lifecycle-smoke \
-	multichip-smoke campaign-smoke replay-smoke session-smoke
+	multichip-smoke campaign-smoke replay-smoke session-smoke serve-smoke
 
 test:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -68,6 +68,14 @@ replay-smoke:
 # the mainline keeps settling events
 session-smoke:
 	env JAX_PLATFORMS=cpu python tools/session_smoke.py
+
+# inference-serving gate (server/serving.py): POST a cluster once, probe
+# it by digest — delta probes must digest bit-identically to cold full
+# re-encodes; mixed coalesced/singleton load with ONE poisoned lane must
+# answer the siblings 200 with singleton digests (the poisoned member
+# gets its own 504); SIGTERM drain finishes the in-flight probe, exits 0
+serve-smoke:
+	env JAX_PLATFORMS=cpu python tools/serve_smoke.py
 
 # regression gate over the run ledger (SIMON_LEDGER_DIR or
 # BENCH_LEDGER_DIR=... make bench-regress): the newest bench record per

@@ -216,6 +216,13 @@ PRESETS = {
     # shared bucketed executable), gated by bench-regress like every
     # other shape
     "session": dict(sessions=4, nodes=16, batches=6, batch_pods=16),
+    # inference-grade serving (server/serving.py): an in-process server
+    # admits ONE snapshot, then a client pool hammers it with base-digest
+    # probes (the POST-once-probe-millions loop) — requests/sec at a
+    # fixed snapshot-reuse ratio, coalesced launches counted, the shared
+    # placement digest tagged so a regression in EITHER throughput or
+    # determinism shows in the tracked line
+    "serve": dict(nodes=12, requests=96, clients=6),
 }
 
 
@@ -356,6 +363,92 @@ def run_session_bench(n_sessions: int, n_nodes: int, n_batches: int,
     return dt, n_events, sessions[0].digest, label
 
 
+def run_serve_bench(n_nodes: int, n_requests: int, n_clients: int):
+    """Time the inference-grade serving path: an in-process server admits
+    ONE snapshot (the only encode), then ``n_clients`` threads hammer it
+    with ``{"base": digest}`` probes — the POST-once-probe-millions loop
+    of server/serving.py. Probes queued behind an in-flight launch merge
+    into coalesced batches, so the measured rate covers the whole
+    admission-queue + resident-cache + batched-launch path, not just the
+    device. Every response's placement digest must equal the admitting
+    POST's (a coalesced lane is bit-identical to its singleton run)."""
+    import threading
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    import yaml as _yaml
+
+    from open_simulator_tpu import telemetry
+    from open_simulator_tpu.replay import synthetic_replay_cluster
+    from open_simulator_tpu.server.rest import SimulationServer, _make_handler
+    from open_simulator_tpu.telemetry import ledger
+
+    cluster = synthetic_replay_cluster(n_nodes=n_nodes,
+                                       n_initial_pods=n_nodes * 2)
+    cluster_yaml = _yaml.safe_dump_all(
+        [{"apiVersion": "v1", "kind": "Node", **n.raw}
+         for n in cluster.nodes]
+        + [{"apiVersion": "v1", "kind": "Pod", **p.raw}
+           for p in cluster.pods])
+
+    srv = SimulationServer(queue_depth=max(16, n_clients * 2), workers=2)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _make_handler(srv))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}/api/simulate"
+
+    def post(payload):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=300.0) as r:
+            return json.loads(r.read())
+
+    launches = telemetry.counter("simon_coalesced_launches_total",
+                                 labelnames=("kind",))
+    per_client = max(1, n_requests // n_clients)
+    n_probes = per_client * n_clients
+    try:
+        with ledger.run_capture("bench") as lcap:
+            admitted = post({"cluster": {"yaml": cluster_yaml}})
+            digest = admitted["snapshot_digest"]
+            post({"base": digest})  # warm-up: arrays resident, AOT hot
+            l0 = (launches.value(kind="coalesced")
+                  + launches.value(kind="singleton"))
+            results = []
+            lock = threading.Lock()
+
+            def client():
+                mine = [post({"base": digest}) for _ in range(per_client)]
+                with lock:
+                    results.extend(mine)
+
+            threads = [threading.Thread(target=client)
+                       for _ in range(n_clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            n_launches = int(launches.value(kind="coalesced")
+                             + launches.value(kind="singleton") - l0)
+            label = f"serve{n_probes}r_{n_nodes}n_x{n_clients}c"
+            _bench_gauge().labels(shape=label).set(dt)
+            lcap.tag("preset", "serve")
+            lcap.tag("shape", label)
+            lcap.tag("seconds", round(dt, 6))
+            lcap.tag("value", round(n_probes / dt, 3))
+            lcap.tag("launches", n_launches)
+            lcap.tag("reuse_ratio", n_probes)
+            lcap.tag("placement_digest", admitted["digest"])
+        assert len(results) == n_probes, (len(results), n_probes)
+        assert all(r["digest"] == admitted["digest"] for r in results), (
+            "a coalesced probe diverged from the admitting run's digest")
+    finally:
+        httpd.shutdown()
+    return dt, n_probes, n_launches, admitted["digest"], label
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--preset", choices=sorted(PRESETS), default="default")
@@ -448,6 +541,27 @@ def main():
             "events": n_events,
             "reuse_ratio": n_events // preset["sessions"],
             "trajectory_digest": digest,
+        }))
+        return
+    if args.preset == "serve":
+        # serving bench: requests/sec through the resident-snapshot +
+        # coalescing path at a fixed snapshot-reuse ratio; the shared
+        # placement digest rides along so a regression in EITHER
+        # throughput or determinism shows in the tracked line
+        dt, n_probes, n_launches, digest, label = run_serve_bench(
+            args.nodes or preset["nodes"], preset["requests"],
+            preset["clients"])
+        print(json.dumps({
+            "metric": f"serve_requests_per_sec@{label}",
+            "value": round(n_probes / dt, 3),
+            "unit": "requests/s",
+            "vs_baseline": 0.0,
+            "baseline": "none_serving_path",
+            "preset": "serve",
+            "requests": n_probes,
+            "launches": n_launches,
+            "reuse_ratio": n_probes,
+            "placement_digest": digest,
         }))
         return
     for k in ("nodes", "pods", "scenarios", "max_new"):

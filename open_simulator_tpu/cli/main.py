@@ -162,6 +162,21 @@ def build_parser() -> argparse.ArgumentParser:
              "this the least-recently-touched session drops its device "
              "state (it stays open in its journal and rehydrates "
              "transparently on the next touch)")
+    sp.add_argument(
+        "--max-resident-mib", type=int, default=1024,
+        help="byte budget (MiB) for device-resident snapshot arrays in "
+             "the /api/simulate | /api/capacity serving cache: past it "
+             "the least-recently-used snapshot drops its device arrays "
+             "(the host copy stays — an evicted digest re-transfers "
+             "transparently, never a 500); 0 disables the budget")
+    sp.add_argument(
+        "--workers", type=int, default=1,
+        help="admission-queue worker threads: 1 (default) keeps the "
+             "classic single-flight front end; more let coalesced "
+             "serving batches and long singleton jobs (sweeps, "
+             "campaigns) interleave so neither starves the other's "
+             "deadlines — a crashed worker is replaced without losing "
+             "queued jobs")
 
     ch = sub.add_parser(
         "chaos",
@@ -977,6 +992,8 @@ def main(argv=None) -> int:
             queue_depth=args.queue_depth,
             drain_timeout_s=args.drain_timeout,
             max_sessions=args.max_sessions,
+            max_resident_bytes=int(args.max_resident_mib) * 1024 * 1024,
+            workers=args.workers,
         )
 
     if args.command == "gen-doc":

@@ -97,6 +97,12 @@ def filter_op_table(resources: Sequence[str]) -> List[str]:
 class EncodeOptions:
     max_new_nodes: int = 0  # extra padded node slots cloned from the template
     new_node_template: Optional[Node] = None
+    # index-named template clones (sim-new-NNN) instead of the
+    # reference's random simon-<rand5> names: required on every
+    # content-addressed surface (the serving snapshot cache, resume
+    # fingerprints) where a random name would make two encodes of the
+    # same cluster hash differently
+    deterministic_new_nodes: bool = False
     max_gpus_per_node: int = 8
     # Upper bound on distinct non-hostname topology domains (zones etc.).
     # Raised automatically if the cluster has more.
@@ -317,7 +323,14 @@ def encode_cluster(
     if opts.max_new_nodes > 0:
         if opts.new_node_template is None:
             raise ValueError("max_new_nodes > 0 requires a new_node_template")
-        all_nodes += new_fake_nodes(opts.new_node_template, opts.max_new_nodes)
+        if opts.deterministic_new_nodes:
+            from open_simulator_tpu.k8s.loader import deterministic_fake_nodes
+
+            all_nodes += deterministic_fake_nodes(opts.new_node_template,
+                                                  opts.max_new_nodes)
+        else:
+            all_nodes += new_fake_nodes(opts.new_node_template,
+                                        opts.max_new_nodes)
     N = len(all_nodes)
     if N == 0:
         raise ValueError("cannot encode a cluster with zero nodes")

@@ -397,6 +397,24 @@ def new_fake_nodes(template: k8s.Node, count: int) -> List[k8s.Node]:
     return out
 
 
+def deterministic_fake_nodes(template: k8s.Node, count: int,
+                             prefix: str = "sim-new") -> List[k8s.Node]:
+    """``new_fake_nodes`` with index names instead of random ones: the
+    variant for every content-addressed surface — replay/session resume
+    fingerprints and the serving snapshot cache, where a random name
+    would make two encodes of the SAME cluster hash differently (the
+    hostname label feeds the topology vocab) and make placements on new
+    nodes irreproducible."""
+    out = []
+    for i in range(count):
+        n = template.clone()
+        n.meta.name = f"{prefix}-{i:03d}"
+        n.meta.labels[LABEL_NEW_NODE] = "true"
+        n.meta.labels["kubernetes.io/hostname"] = n.meta.name
+        out.append(make_valid_node(n))
+    return out
+
+
 def sort_node_names(names: List[str]) -> List[str]:
     """Real nodes first (alphabetical), simon- fake nodes last
     (reference: pkg/utils/utils.go:574-622)."""

@@ -298,18 +298,12 @@ def clone_template_nodes(template, count: int, prefix: str = "sim-new"):
     """Deterministically-named clones of a node template (the new-node
     slots replay scales into). ``k8s.loader.new_fake_nodes`` draws RANDOM
     names, which would leak nondeterminism into re-encoded resume
-    fingerprints and journal rows — replay names its slots by index."""
-    from open_simulator_tpu.k8s.loader import make_valid_node
-    from open_simulator_tpu.k8s.objects import LABEL_NEW_NODE
+    fingerprints and journal rows — replay names its slots by index
+    (now the shared ``k8s.loader.deterministic_fake_nodes``, which the
+    serving snapshot cache uses for the same reason)."""
+    from open_simulator_tpu.k8s.loader import deterministic_fake_nodes
 
-    out = []
-    for i in range(count):
-        n = template.clone()
-        n.meta.name = f"{prefix}-{i:03d}"
-        n.meta.labels[LABEL_NEW_NODE] = "true"
-        n.meta.labels["kubernetes.io/hostname"] = n.meta.name
-        out.append(make_valid_node(n))
-    return out
+    return deterministic_fake_nodes(template, count, prefix=prefix)
 
 
 def parse_node_template(yaml_text: str):

@@ -14,14 +14,28 @@ ARCHITECTURE.md §11). Four cooperating pieces:
     keeps burning the device" into "work stops at the next round".
 
 ``AdmissionQueue``
-    A bounded FIFO drained by ONE worker thread (the device runs one
-    program at a time — single-flight is a feature, not a lock). A full
-    queue sheds load with a structured ``E_OVERLOADED`` whose
-    ``retry_after_s`` is computed from the queue's EWMA service time,
-    replacing the instant busy-503 (which remains only while draining).
-    Jobs whose deadline already passed while queued are skipped, not
-    executed. Depth, wait time, sheds, and in-flight all flow into the
-    telemetry registry.
+    A bounded FIFO drained by a small pool of worker threads (one by
+    default — the single-flight front end; ``--workers N`` lets
+    coalesced batches and singleton jobs interleave so neither starves
+    the other's deadlines). A full queue sheds load with a structured
+    ``E_OVERLOADED`` whose ``retry_after_s`` is computed from the
+    queue's EWMA service time, replacing the instant busy-503 (which
+    remains only while draining). Jobs whose deadline already passed
+    while queued are skipped, not executed. A worker that crashes (a
+    BaseException escaping the loop itself, not a job) is replaced
+    without losing queued jobs. Depth, wait time, sheds, and in-flight
+    all flow into the telemetry registry.
+
+    **Coalescing** (ARCHITECTURE.md §16): jobs submitted with a
+    ``group_key`` + ``group_fn`` are popped as a GROUP — when a worker
+    takes one, every queued job with the same key joins the launch and
+    ``group_fn(members)`` answers all of them in one device program.
+    Fault isolation is per member: a member whose token cancelled is
+    skipped (or answered 504 by ``group_fn``) while siblings complete.
+    Retry-After accounting counts coalesced MEMBERS, not merged
+    launches: ``in_flight`` is the member count of the executing group
+    and the EWMA records launch-time / members (per-member service), so
+    the ``EWMA × backlog`` hint stays honest when launches batch.
 
 ``SweepJournal``
     Crash-survivable capacity sweeps: each completed bisection round
@@ -330,16 +344,26 @@ class QueueClosedError(SimulationError):
 
 
 class Job:
-    """One queued unit of work: ``fn`` runs on the worker thread under
+    """One queued unit of work: ``fn`` runs on a worker thread under
     ``cancel_scope(token)``; the submitting thread waits on ``done``.
     ``error`` holds the exception if ``fn`` raised (the worker survives
-    a poisoned job — see ``_loop``); ``result`` stays None then."""
+    a poisoned job — see ``_loop``); ``result`` stays None then.
+
+    Coalescible jobs carry a ``group_key`` + shared ``group_fn``
+    instead: the worker hands the whole same-key group to ``group_fn``,
+    which must set each member's ``result`` (or ``error``) itself —
+    ``payload`` carries the prepared per-member work the group executor
+    reads."""
 
     __slots__ = ("fn", "token", "label", "done", "result", "error",
-                 "queued_at", "abandoned")
+                 "queued_at", "abandoned", "group_key", "group_fn",
+                 "payload")
 
-    def __init__(self, fn: Callable[[], Any], token: Optional[CancelToken],
-                 label: str):
+    def __init__(self, fn: Optional[Callable[[], Any]],
+                 token: Optional[CancelToken], label: str,
+                 group_key: Any = None,
+                 group_fn: Optional[Callable[[List["Job"]], None]] = None,
+                 payload: Any = None):
         self.fn = fn
         self.token = token
         self.label = label
@@ -348,6 +372,9 @@ class Job:
         self.error: Optional[BaseException] = None
         self.queued_at = time.monotonic()
         self.abandoned = False
+        self.group_key = group_key
+        self.group_fn = group_fn
+        self.payload = payload
 
     def wait(self, timeout: Optional[float]) -> bool:
         return self.done.wait(timeout)
@@ -376,41 +403,57 @@ def _queue_metrics():
             "skipped = cancelled/abandoned before execution started)",
             labelnames=("outcome",)),
         telemetry.gauge("simon_queue_service_seconds_ewma",
-                        "EWMA of job service time (feeds Retry-After)"),
+                        "EWMA of PER-MEMBER job service time (launch wall "
+                        "time / coalesced members; feeds Retry-After)"),
+        telemetry.histogram("simon_queue_coalesce_members",
+                            "members per coalesced launch (1 = singleton)"),
     )
 
 
 class AdmissionQueue:
-    """Bounded FIFO + one worker thread. ``submit`` never blocks: a full
-    queue raises ``QueueFullError`` with a Retry-After computed from the
-    EWMA service time and the current backlog; a closed (draining) queue
-    raises ``QueueClosedError``."""
+    """Bounded FIFO + a pool of ``workers`` threads (1 = the classic
+    single-flight front end). ``submit`` never blocks: a full queue
+    raises ``QueueFullError`` with a Retry-After computed from the EWMA
+    per-member service time and the current member backlog; a closed
+    (draining) queue raises ``QueueClosedError``."""
 
     EWMA_ALPHA = 0.2
 
-    def __init__(self, depth: int = 8, initial_service_s: float = 1.0):
+    def __init__(self, depth: int = 8, initial_service_s: float = 1.0,
+                 workers: int = 1):
         self.depth = max(1, int(depth))
+        self.workers = max(1, int(workers))
         self._jobs: deque = deque()
         self._cv = threading.Condition()
         self._closed = False
-        self._in_flight = 0
+        self._in_flight = 0          # MEMBERS executing (not launches)
         self._ewma_s = float(initial_service_s)
-        self._worker: Optional[threading.Thread] = None
-        self._current: Optional[Job] = None
+        self._threads: List[threading.Thread] = []
+        self._current: List[Job] = []
+        # test hook: raising here simulates a worker CRASH (a failure of
+        # the loop itself, not of a job) — the replacement path's regression
+        self._fault_hook: Optional[Callable[[], None]] = None
 
     # -- submit side -----------------------------------------------------
 
     def _retry_after_locked(self) -> float:
-        """Expected wait for a new job: everyone ahead of it (queued +
-        executing) times the EWMA service time, floored at 1s so clients
-        never busy-loop. Caller holds the condition lock."""
+        """Expected wait for a new job: every MEMBER ahead of it (queued
+        + executing, coalesced members counted individually — a merged
+        launch is still that many callers' worth of service) times the
+        EWMA per-member service time, floored at 1s so clients never
+        busy-loop. Caller holds the condition lock."""
         backlog = len(self._jobs) + self._in_flight
         return max(1.0, math.ceil(self._ewma_s * (backlog + 1)))
 
-    def submit(self, fn: Callable[[], Any],
+    def submit(self, fn: Optional[Callable[[], Any]],
                token: Optional[CancelToken] = None,
-               label: str = "") -> Job:
-        job = Job(fn, token, label)
+               label: str = "", group_key: Any = None,
+               group_fn: Optional[Callable[[List[Job]], None]] = None,
+               payload: Any = None) -> Job:
+        if fn is None and group_fn is None:
+            raise ValueError("submit needs fn or group_fn")
+        job = Job(fn, token, label, group_key=group_key, group_fn=group_fn,
+                  payload=payload)
         with self._cv:
             if self._closed:
                 raise QueueClosedError(
@@ -418,7 +461,7 @@ class AdmissionQueue:
                     ref="server",
                     hint="retry against another replica, or after restart")
             if len(self._jobs) >= self.depth:
-                _, _, _, shed, _, _ = _queue_metrics()
+                shed = _queue_metrics()[3]
                 shed.inc()
                 ra = self._retry_after_locked()
                 raise QueueFullError(
@@ -426,19 +469,25 @@ class AdmissionQueue:
                     retry_after_s=ra, ref="server",
                     hint=f"retry after ~{ra:.0f}s (Retry-After header)")
             self._jobs.append(job)
-            depth_g, *_ = _queue_metrics()
+            depth_g = _queue_metrics()[0]
             depth_g.set(len(self._jobs))
-            self._ensure_worker()
+            self._ensure_workers()
             self._cv.notify()
         return job
 
-    def _ensure_worker(self) -> None:
+    def _ensure_workers(self) -> None:
         # lazily started so bare SimulationServer() in unit tests costs no
-        # thread until the first queued POST; caller holds the lock
-        if self._worker is None or not self._worker.is_alive():
-            self._worker = threading.Thread(
-                target=self._loop, name="simon-admission-worker", daemon=True)
-            self._worker.start()
+        # thread until the first queued POST; also the crashed-worker
+        # replacement path (a dead thread is pruned and respawned without
+        # touching the queued jobs). Caller holds the lock.
+        self._threads = [t for t in self._threads if t.is_alive()]
+        while len(self._threads) < self.workers:
+            t = threading.Thread(
+                target=self._worker_main,
+                name=f"simon-admission-worker-{len(self._threads)}",
+                daemon=True)
+            self._threads.append(t)
+            t.start()
 
     # -- drain side ------------------------------------------------------
 
@@ -461,77 +510,154 @@ class AdmissionQueue:
             return True
 
     def cancel_all(self, reason: str = "drain timeout") -> None:
-        """Cancel the executing job's token (cooperative: it stops at its
-        next phase boundary) AND every queued job's — a drain past its
-        budget must not let the worker start fresh device work for
+        """Cancel every executing job's token (cooperative: each stops at
+        its next phase boundary) AND every queued job's — a drain past
+        its budget must not let a worker start fresh device work for
         clients that are about to lose their connection; skipped jobs
         resolve with a structured 504 instead of a reset."""
         with self._cv:
-            jobs = list(self._jobs)
-            cur = self._current
+            jobs = list(self._jobs) + list(self._current)
         for job in jobs:
             if job.token is not None:
                 job.token.cancel(reason)
-        if cur is not None and cur.token is not None:
-            cur.token.cancel(reason)
 
     def stats(self) -> Dict[str, Any]:
         with self._cv:
             return {"queued": len(self._jobs), "in_flight": self._in_flight,
                     "closed": self._closed,
+                    "workers": sum(1 for t in self._threads if t.is_alive()),
                     "ewma_service_s": round(self._ewma_s, 3)}
 
     # -- worker ----------------------------------------------------------
 
+    def _worker_main(self) -> None:
+        crashed = False
+        try:
+            self._loop()
+        except BaseException:  # noqa: BLE001 — a crash of the LOOP (not a
+            # job: job exceptions are captured onto the job) must not
+            # strand the queue; log it and hand off to a replacement
+            crashed = True
+            _log.exception("admission worker crashed; replacing it")
+        finally:
+            with self._cv:
+                me = threading.current_thread()
+                self._threads = [t for t in self._threads
+                                 if t is not me and t.is_alive()]
+                if crashed and not self._closed:
+                    # replace immediately: queued jobs must not starve
+                    # waiting for the next submit to notice the corpse
+                    self._ensure_workers()
+                self._cv.notify_all()
+
+    def _pop_group_locked(self) -> List[Job]:
+        """Pop the next job plus — when it is coalescible — every queued
+        job sharing its group key. One launch answers the whole group;
+        each member still gets its own skip/cancel/error treatment."""
+        leader = self._jobs.popleft()
+        group = [leader]
+        if leader.group_key is not None:
+            keep: deque = deque()
+            while self._jobs:
+                j = self._jobs.popleft()
+                if (j.group_key == leader.group_key
+                        and j.group_fn is leader.group_fn):
+                    group.append(j)
+                else:
+                    keep.append(j)
+            self._jobs = keep
+        return group
+
+    def _run_group(self, group: List[Job], jobs_total, coalesce_h) -> None:
+        """Execute one popped group: skip dead members, run the rest
+        (group_fn for coalescible jobs — even a group of one, so
+        coalesced and singleton results share one code path — plain
+        ``fn`` otherwise), then update the per-member EWMA."""
+        runnable: List[Job] = []
+        for job in group:
+            if job.abandoned or (job.token is not None
+                                 and job.token.cancelled):
+                # the submitter's deadline passed while the job sat in
+                # the queue — executing it would burn the device for a
+                # response nobody is waiting for
+                jobs_total.labels(outcome="skipped").inc()
+                job.result = None
+                job.done.set()
+            else:
+                runnable.append(job)
+        if not runnable:
+            return
+        leader = runnable[0]
+        t0 = time.monotonic()
+        try:
+            if leader.group_fn is not None:
+                coalesce_h.observe(len(runnable))
+                leader.group_fn(runnable)
+                for job in runnable:
+                    jobs_total.labels(
+                        outcome="error" if job.error is not None
+                        else "done").inc()
+            else:
+                try:
+                    leader.result = leader.fn()
+                except BaseException as e:  # noqa: BLE001 — a poisoned job
+                    # must not kill its worker and strand the jobs queued
+                    # behind it; the exception goes back via .error
+                    leader.error = e
+                    jobs_total.labels(outcome="error").inc()
+                else:
+                    jobs_total.labels(outcome="done").inc()
+        except BaseException as e:  # noqa: BLE001 — a group_fn that died
+            # before distributing results: every unanswered member gets
+            # the error instead of hanging its handler thread
+            for job in runnable:
+                if job.result is None and job.error is None:
+                    job.error = e
+                    jobs_total.labels(outcome="error").inc()
+        if any(job.error is None for job in runnable):
+            # per-MEMBER service time: a launch of k members took dur
+            # wall seconds but served k callers — recording dur per
+            # member would overshoot Retry-After k-fold, recording the
+            # launch once under-counts the backlog the members represent
+            dur = (time.monotonic() - t0) / len(runnable)
+            with self._cv:
+                self._ewma_s = (self.EWMA_ALPHA * dur
+                                + (1 - self.EWMA_ALPHA) * self._ewma_s)
+                _queue_metrics()[5].set(self._ewma_s)
+
     def _loop(self) -> None:
-        depth_g, inflight_g, wait_h, _, jobs_total, ewma_g = _queue_metrics()
+        depth_g, inflight_g, wait_h, _, jobs_total, _, coalesce_h = (
+            _queue_metrics())
         while True:
+            hook = self._fault_hook
+            if hook is not None:
+                self._fault_hook = None
+                hook()
             with self._cv:
                 while not self._jobs:
                     if self._closed:
                         self._cv.notify_all()
                         return
                     self._cv.wait(timeout=1.0)
-                job = self._jobs.popleft()
+                group = self._pop_group_locked()
                 depth_g.set(len(self._jobs))
-                self._in_flight += 1
-                self._current = job
+                self._in_flight += len(group)
+                self._current.extend(group)
                 inflight_g.set(self._in_flight)
-            wait_s = time.monotonic() - job.queued_at
-            wait_h.observe(wait_s)
-            t0 = time.monotonic()
+            now = time.monotonic()
+            for job in group:
+                wait_h.observe(now - job.queued_at)
             try:
-                if job.abandoned or (job.token is not None
-                                     and job.token.cancelled):
-                    # the submitter's deadline passed while the job sat in
-                    # the queue — executing it would burn the device for a
-                    # response nobody is waiting for
-                    jobs_total.labels(outcome="skipped").inc()
-                    job.result = None
-                else:
-                    try:
-                        job.result = job.fn()
-                    except BaseException as e:  # noqa: BLE001 — the worker
-                        # is a singleton: a poisoned job must not kill it
-                        # and strand every job queued behind it; the
-                        # exception goes back to the submitter via .error
-                        job.error = e
-                        jobs_total.labels(outcome="error").inc()
-                    else:
-                        jobs_total.labels(outcome="done").inc()
-                        dur = time.monotonic() - t0
-                        with self._cv:
-                            self._ewma_s = (
-                                self.EWMA_ALPHA * dur
-                                + (1 - self.EWMA_ALPHA) * self._ewma_s)
-                            ewma_g.set(self._ewma_s)
+                self._run_group(group, jobs_total, coalesce_h)
             finally:
                 with self._cv:
-                    self._in_flight -= 1
-                    self._current = None
+                    self._in_flight -= len(group)
+                    for job in group:
+                        self._current.remove(job)
                     inflight_g.set(self._in_flight)
                     self._cv.notify_all()
-                job.done.set()
+                for job in group:
+                    job.done.set()
 
 
 # ---- sweep checkpoint journal -------------------------------------------

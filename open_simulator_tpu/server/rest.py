@@ -27,12 +27,28 @@ Endpoint parity with the reference (pkg/server/server.go:148-314):
                              POST request's span tree — the server-side
                              mirror of the CLI --trace-out flag
   POST /api/deploy-apps   -> simulate deploying new apps (+ optional new nodes)
+  POST /api/simulate      -> the inference-grade probe (server/serving.py,
+                             ARCHITECTURE.md §16): one scheduling lane
+                             against a RESIDENT snapshot. A full body
+                             encodes once and returns "snapshot_digest";
+                             {"base": "<digest>"} + optional {"delta":
+                             {add_nodes, remove_nodes, remove_pods,
+                              add_apps}} probes it with zero re-encode.
+                             Concurrent mask-only probes of one snapshot
+                             COALESCE into a single batched launch, each
+                             caller getting its own lane back (digests
+                             identical to singleton runs; a poisoned
+                             lane fails alone)
   POST /api/capacity      -> "how many nodes of this spec must I add?" —
                              the capacity sweep as a service: monotone
                              bisection by default (sweep_mode
                              "exhaustive" opts out), reusing the AOT
                              executable cache across requests in the
-                             same shape bucket
+                             same shape bucket. Accepts the same
+                             "base"/"delta" resident-snapshot vocabulary
+                             as /api/simulate; exhaustive-mode lanes
+                             coalesce with sibling probes of the same
+                             snapshot
   POST /api/scale-apps    -> simulate re-scaling existing workloads (their
                              current pods are removed first — the re-rollout
                              semantics of removePodsOfApp, server.go:404-444)
@@ -131,6 +147,7 @@ from open_simulator_tpu import telemetry
 from open_simulator_tpu.core import AppResource, SimulateResult, simulate
 from open_simulator_tpu.errors import SimulationError
 from open_simulator_tpu.resilience import lifecycle
+from open_simulator_tpu.server import serving
 from open_simulator_tpu.k8s.loader import (
     ClusterResources,
     demux_object,
@@ -147,6 +164,7 @@ DEFAULT_REQUEST_TIMEOUT_S = 300.0
 DEFAULT_QUEUE_DEPTH = 8
 DEFAULT_DRAIN_TIMEOUT_S = 30.0
 DEFAULT_MAX_SESSIONS = 8
+DEFAULT_WORKERS = 1
 # after cancelling a timed-out job's token, how long the handler waits
 # for the worker to reach a cancellation boundary and surface partial
 # results before replying with a bare E_DEADLINE body
@@ -163,8 +181,8 @@ _KNOWN_PATHS = frozenset({
     "/healthz", "/readyz", "/test", "/metrics", "/debug/stats",
     "/debug/profile",
     "/api/explain", "/api/deploy-apps", "/api/scale-apps", "/api/chaos",
-    "/api/capacity", "/api/campaign", "/api/replay", "/api/runs",
-    "/api/trace", "/api/session",
+    "/api/capacity", "/api/simulate", "/api/campaign", "/api/replay",
+    "/api/runs", "/api/trace", "/api/session",
 })
 
 
@@ -191,6 +209,10 @@ DEFAULT_EXPLAIN_TOPK = 3
 # encode to materialize (the exhaustive mode also turns this into lanes)
 MAX_CAPACITY_NEW_NODES = 4096
 
+# route-table placeholder for the serving endpoints _do_post dispatches
+# itself (never called; only marks the path as known, not a 404)
+_SERVING_ROUTE = object()
+
 
 class SimulationServer:
     def __init__(self, cluster_config: str = "", kubeconfig: str = "",
@@ -200,7 +222,9 @@ class SimulationServer:
                  compile_cache_dir: str = "", ledger_dir: str = "",
                  queue_depth: int = DEFAULT_QUEUE_DEPTH,
                  drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S,
-                 max_sessions: int = DEFAULT_MAX_SESSIONS):
+                 max_sessions: int = DEFAULT_MAX_SESSIONS,
+                 max_resident_bytes: int = serving.DEFAULT_MAX_RESIDENT_BYTES,
+                 workers: int = DEFAULT_WORKERS):
         self.cluster_config = cluster_config
         # recorded API dump standing in for the reference's 10 live
         # informers (pkg/server/server.go:97-137; no cluster access here)
@@ -212,10 +236,19 @@ class SimulationServer:
         # 0 disables the recording (and the explain candidate lists)
         self.explain_topk = max(0, int(explain_topk))
         self.drain_timeout_s = float(drain_timeout_s)
-        # bounded admission queue drained by one worker thread: the
-        # single-flight front end (resilience/lifecycle.py) — POSTs wait
-        # in line instead of bouncing off a TryLock, full = 429 + Retry-After
-        self._queue = lifecycle.AdmissionQueue(depth=queue_depth)
+        # bounded admission queue drained by a small worker pool (1 by
+        # default — the single-flight front end, resilience/lifecycle.py;
+        # --workers N lets coalesced serving batches and long singleton
+        # jobs interleave) — POSTs wait in line instead of bouncing off a
+        # TryLock, full = 429 + Retry-After
+        self._queue = lifecycle.AdmissionQueue(depth=queue_depth,
+                                               workers=workers)
+        # resident snapshot cache (server/serving.py, ARCHITECTURE.md
+        # §16): encoded clusters keyed by workload digest, device arrays
+        # held under an LRU + byte budget — the POST-once-probe-millions
+        # fast path behind /api/simulate and /api/capacity
+        self._snapshots = serving.ResidentSnapshotCache(
+            max_bytes=max_resident_bytes)
         self._draining = threading.Event()
         self._stats = {"requests": 0, "simulations": 0, "errors": 0,
                        "last_elapsed_s": 0.0, "started_at": time.time()}
@@ -282,6 +315,11 @@ class SimulationServer:
         # records each open session's final status and drops device
         # state — a restarted server rehydrates every one of them
         session_info = self._sessions.drain()
+        # release the resident snapshots (host + device): clients re-POST
+        # after a restart (the digest is content-addressed, so the same
+        # cluster lands on the same digest); gauges drain to 0
+        resident = self._snapshots.stats()
+        self._snapshots.drop_all()
         from open_simulator_tpu.telemetry import ledger
 
         run_id = ledger.append_event(
@@ -290,6 +328,8 @@ class SimulationServer:
                   "simulations": self._stats["simulations"],
                   "errors": self._stats["errors"],
                   "drained_clean": bool(clean),
+                  "resident_snapshots": resident["entries"],
+                  "resident_bytes": resident["resident_bytes"],
                   **session_info,
                   **self._queue.stats()},
             wall_s=time.monotonic() - t0)
@@ -312,6 +352,8 @@ class SimulationServer:
             "cpu_user_s": round(ru.ru_utime, 2),
             "devices": [str(d) for d in jax.devices()],
             "profiling_to": self._profile_dir or None,
+            "queue": self._queue.stats(),
+            "resident_snapshots": self._snapshots.stats(),
         }
 
     def toggle_profile(self, trace_dir: str = "") -> Dict[str, Any]:
@@ -374,100 +416,6 @@ class SimulationServer:
         explain endpoint has score breakdowns for the last result."""
         return simulate(cluster, apps,
                         config_overrides={"explain_topk": self.explain_topk})
-
-    def capacity(self, body: Dict[str, Any]) -> Dict[str, Any]:
-        """Minimum new-node count for the requested apps (POST /api/capacity).
-
-        Body: {"cluster": {...}?, "apps": [{"name", "yaml"}, ...],
-               "new_node": {"spec_yaml": "<Node yaml>"},
-               "max_new_nodes": 64?, "sweep_mode": "bisect"|"exhaustive"?,
-               "thresholds": {"max_cpu_pct", "max_memory_pct", "max_vg_pct"}?,
-               "resume": "<sweep_id prefix | last>"?,
-               "deadline_s": 30?}
-
-        With a checkpoint directory configured (a ledger dir, or
-        SIMON_CHECKPOINT_DIR) every bisect round is journaled; the
-        response's "sweep_id" names the journal and "resume" replays it
-        after a crash — the digest matches an uninterrupted run.
-        """
-        from open_simulator_tpu.core import build_pod_sequence, with_volume_objects
-        from open_simulator_tpu.encode.snapshot import EncodeOptions, encode_cluster
-        from open_simulator_tpu.engine.scheduler import make_config
-        from open_simulator_tpu.parallel.sweep import (
-            SweepThresholds,
-            capacity_bisect,
-            capacity_sweep,
-        )
-
-        self._stats["requests"] += 1
-        cluster = self.base_cluster(body.get("cluster"))
-        cluster.nodes = [make_valid_node(n) for n in cluster.nodes]
-        apps = self._request_apps(body)
-        new_node = body.get("new_node") or {}
-        if not new_node.get("spec_yaml"):
-            raise SimulationError(
-                "capacity planning needs a new-node template",
-                code="E_BAD_REQUEST", ref="request", field="new_node",
-                hint='include {"new_node": {"spec_yaml": "<Node yaml>"}}')
-        template = make_valid_node(Node.from_dict(
-            yaml.safe_load(new_node["spec_yaml"])))
-        max_new = max(0, int(body.get("max_new_nodes", 64)))
-        if max_new > MAX_CAPACITY_NEW_NODES:
-            # encode materializes max_new padded node rows (and exhaustive
-            # mode max_new+1 lanes) — an unbounded request would wedge the
-            # single-flight worker; reject before any allocation
-            raise SimulationError(
-                f"max_new_nodes {max_new} exceeds the server cap "
-                f"{MAX_CAPACITY_NEW_NODES}",
-                code="E_BAD_REQUEST", ref="request", field="max_new_nodes",
-                hint="ask a smaller what-if, or run simon-tpu apply locally "
-                     "with --max-new-nodes")
-        mode = body.get("sweep_mode", "bisect")
-        if mode not in ("bisect", "exhaustive"):
-            raise SimulationError(
-                f"unknown sweep_mode {mode!r}",
-                code="E_BAD_REQUEST", ref="request", field="sweep_mode",
-                hint='use "bisect" (default) or "exhaustive"')
-        resume = body.get("resume") or None
-        if resume is not None and mode != "bisect":
-            raise SimulationError(
-                "resume requires sweep_mode \"bisect\" (only bisection "
-                "rounds are checkpointed)",
-                code="E_BAD_REQUEST", ref="request", field="resume",
-                hint='drop "sweep_mode" or set it to "bisect"')
-        th = body.get("thresholds") or {}
-        thresholds = SweepThresholds(
-            max_cpu_pct=float(th.get("max_cpu_pct", 100.0)),
-            max_memory_pct=float(th.get("max_memory_pct", 100.0)),
-            max_vg_pct=float(th.get("max_vg_pct", 100.0)))
-
-        pods = build_pod_sequence(cluster, apps)
-        snapshot = encode_cluster(
-            cluster.nodes, pods,
-            with_volume_objects(
-                EncodeOptions(max_new_nodes=max_new, new_node_template=template),
-                cluster, apps))
-        cfg = make_config(snapshot)
-        if mode == "bisect":
-            plan = capacity_bisect(snapshot, cfg, max_new, thresholds,
-                                   resume=resume)
-        else:
-            plan = capacity_sweep(snapshot, cfg, list(range(max_new + 1)),
-                                  thresholds)
-        self._stats["simulations"] += 1
-        return {
-            "best_count": plan.best_count,
-            "mode": mode,
-            "max_new_nodes": max_new,
-            "counts": list(plan.counts),
-            "all_scheduled": list(plan.all_scheduled),
-            "satisfied": list(plan.satisfied),
-            "cpu_occupancy_pct": [round(v, 2) for v in plan.cpu_occupancy_pct],
-            "mem_occupancy_pct": [round(v, 2) for v in plan.mem_occupancy_pct],
-            "trial_errors": {str(k): v for k, v in plan.trial_errors.items()},
-            "sweep_id": plan.sweep_id,
-            "resumed_rounds": plan.resumed_rounds,
-        }
 
     def campaign(self, body: Dict[str, Any]) -> Dict[str, Any]:
         """Fleet campaign as a service (POST /api/campaign).
@@ -1122,7 +1070,11 @@ def _make_handler(server: SimulationServer):
         def _resolve_post(self):
             routes = {"/api/deploy-apps": server.deploy_apps,
                       "/api/scale-apps": server.scale_apps,
-                      "/api/capacity": server.capacity,
+                      # serving routes are dispatched by _do_post itself
+                      # (preparation runs on the handler thread); the
+                      # truthy placeholder only marks the path as known
+                      "/api/capacity": _SERVING_ROUTE,
+                      "/api/simulate": _SERVING_ROUTE,
                       "/api/campaign": server.campaign,
                       "/api/replay": server.replay,
                       "/api/chaos": server.chaos,
@@ -1200,10 +1152,24 @@ def _make_handler(server: SimulationServer):
                 deadline_s = min(deadline_s, client_deadline)
             token = lifecycle.CancelToken(deadline_s)
             route = self.path
+            if route in ("/api/simulate", "/api/capacity"):
+                # the inference-grade serving path (server/serving.py):
+                # resident snapshots, host-side deltas, coalesced lanes
+                self._serving_post(route, body, token, deadline_s)
+                return
+            job = self._submit(self._work(route, token,
+                                          lambda: handler_fn(body)),
+                               token, route)
+            if job is not None:
+                self._await_job(job, token, deadline_s)
+
+        def _work(self, route, token, thunk):
+            """Wrap a handler thunk for the queue worker: cancel scope +
+            ledger surface + the structured-error-to-status mapping."""
 
             def work():
                 # window marker for GET /api/trace: spans recorded from
-                # execution start belong to this (single-worker) request
+                # execution start belong to this request
                 from open_simulator_tpu.telemetry.ledger import (
                     surface_override,
                 )
@@ -1217,7 +1183,7 @@ def _make_handler(server: SimulationServer):
                     # their round/event boundaries
                     with lifecycle.cancel_scope(token), \
                             surface_override(f"server:{route}"):
-                        return (200, handler_fn(body))
+                        return (200, thunk())
                 except SimulationError as e:
                     # includes CancelledError: E_DEADLINE/E_CANCELLED map
                     # to 504 and carry partial results in the body
@@ -1230,12 +1196,71 @@ def _make_handler(server: SimulationServer):
                     server._stats["errors"] += 1
                     return (500, {"error": f"{type(e).__name__}: {e}"})
 
+            return work
+
+        def _serving_post(self, route, body, token, deadline_s):
+            """POST /api/simulate | /api/capacity: preparation — body
+            validation, delta resolution, host-side encode + cache
+            admission — runs on the HANDLER thread, so malformed
+            requests are structured 400s BEFORE anything is queued and
+            the resident cache is never left half-touched. The prepared
+            lanes then queue with a coalesce key: a worker popping one
+            takes every queued sibling with the same key into ONE
+            batched launch (serving.execute_group answers each member
+            under its own token — fault isolation is per lane)."""
+            from open_simulator_tpu.telemetry.spans import RECORDER
+
+            if server.draining:
+                # non-serving POSTs reject at queue submit; serving POSTs
+                # must reject BEFORE preparation, which would otherwise
+                # encode/admit into the just-dropped resident cache (and
+                # answer 400 for digests the drain released)
+                server._stats["errors"] += 1
+                e = SimulationError(
+                    "server is draining: not accepting new work",
+                    code="E_BUSY", ref="server",
+                    hint="retry against another replica, or after restart")
+                self._send(_status_for(e), _err_payload(e))
+                return
             try:
-                job = server._queue.submit(work, token=token, label=route)
-            except lifecycle.QueueClosedError as e:
+                if route == "/api/simulate":
+                    prepared = serving.prepare_simulate(server, body)
+                else:
+                    prepared = serving.prepare_capacity(
+                        server, body, MAX_CAPACITY_NEW_NODES)
+            except SimulationError as e:
                 server._stats["errors"] += 1
                 self._send(_status_for(e), _err_payload(e))
                 return
+            except Exception as e:  # noqa: BLE001 — preparation bugs are
+                # this request's 500; the queue and cache are untouched
+                server._stats["errors"] += 1
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            server._trace_mark = RECORDER.mark()
+            if callable(prepared):
+                # bisect mode: a multi-round journaled sweep — a classic
+                # singleton job with cancellation at round boundaries
+                job = self._submit(self._work(route, token, prepared),
+                                   token, route)
+            else:
+                job = self._submit(None, token, route,
+                                   group_key=prepared.coalesce_key,
+                                   group_fn=serving.execute_group,
+                                   payload=prepared)
+            if job is not None:
+                self._await_job(job, token, deadline_s)
+
+        def _submit(self, fn, token, route, **group_kw):
+            """Queue a job, mapping admission rejections to structured
+            responses. Returns None when the rejection was already sent."""
+            try:
+                return server._queue.submit(fn, token=token, label=route,
+                                            **group_kw)
+            except lifecycle.QueueClosedError as e:
+                server._stats["errors"] += 1
+                self._send(_status_for(e), _err_payload(e))
+                return None
             except lifecycle.QueueFullError as e:
                 # load shed: Retry-After from the queue's EWMA service
                 # time x backlog, so clients pace themselves instead of
@@ -1244,7 +1269,9 @@ def _make_handler(server: SimulationServer):
                 self._send(_status_for(e), _err_payload(e),
                            headers=(("Retry-After",
                                      str(int(e.retry_after_s))),))
-                return
+                return None
+
+        def _await_job(self, job, token, deadline_s):
             if not job.wait(deadline_s):
                 # deadline passed (queued or executing): cancel
                 # cooperatively, then give the worker one short grace
@@ -1288,30 +1315,11 @@ def _make_handler(server: SimulationServer):
     return Handler
 
 
-def _err_payload(e: SimulationError) -> Dict[str, Any]:
-    """Structured error body; `error` stays a plain string for pre-taxonomy
-    clients."""
-    out = e.to_dict()
-    out["error"] = e.message
-    return out
-
-
-_STATUS_BY_CODE = {
-    "E_PAYLOAD_TOO_LARGE": 413,
-    "E_TIMEOUT": 504,
-    "E_DEADLINE": 504,     # deadline observed (handler- or worker-side)
-    "E_CANCELLED": 504,    # explicit cooperative cancellation
-    "E_OVERLOADED": 429,   # admission queue full (Retry-After attached)
-    "E_BUSY": 503,         # draining: not accepting new work
-    "E_RESUME": 409,       # checkpoint fingerprint/parameter mismatch
-    "E_NO_SIMULATION": 404,
-    "E_NO_RUN": 404,
-    "E_NO_SESSION": 404,   # unknown/closed digital-twin session id
-}
-
-
-def _status_for(e: SimulationError) -> int:
-    return _STATUS_BY_CODE.get(e.code, 400)
+# ONE code->status taxonomy for every route: the table lives in
+# serving.py (the group executor needs it without importing the handler)
+# — a second hand-maintained copy here had already drifted on E_AUDIT
+_err_payload = serving.error_payload
+_status_for = serving.status_for
 
 
 def serve(address: str = "127.0.0.1", port: int = 8899, cluster_config: str = "",
@@ -1322,7 +1330,9 @@ def serve(address: str = "127.0.0.1", port: int = 8899, cluster_config: str = ""
           compile_cache_dir: str = "", ledger_dir: str = "",
           queue_depth: int = DEFAULT_QUEUE_DEPTH,
           drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S,
-          max_sessions: int = DEFAULT_MAX_SESSIONS) -> int:
+          max_sessions: int = DEFAULT_MAX_SESSIONS,
+          max_resident_bytes: int = serving.DEFAULT_MAX_RESIDENT_BYTES,
+          workers: int = DEFAULT_WORKERS) -> int:
     if kubeconfig:
         # validate up front so a real kubeconfig fails fast with the
         # record-a-dump recipe instead of 500s per request
@@ -1337,7 +1347,9 @@ def serve(address: str = "127.0.0.1", port: int = 8899, cluster_config: str = ""
                                   ledger_dir=ledger_dir,
                                   queue_depth=queue_depth,
                                   drain_timeout_s=drain_timeout_s,
-                                  max_sessions=max_sessions)
+                                  max_sessions=max_sessions,
+                                  max_resident_bytes=max_resident_bytes,
+                                  workers=workers)
     httpd = ThreadingHTTPServer((address, port), _make_handler(sim_server))
 
     def _drain_and_stop(signame: str) -> None:

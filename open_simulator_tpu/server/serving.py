@@ -1,0 +1,1142 @@
+"""Inference-grade serving: resident snapshots, deltas, coalesced lanes.
+
+The serving path behind ``POST /api/simulate`` and ``POST /api/capacity``
+(ARCHITECTURE.md §16). The flagship interactive mode — snapshot a
+cluster once, probe it millions of times — needs three things the
+per-request encode-from-YAML loop cannot give:
+
+* **Resident snapshot cache** (``ResidentSnapshotCache``): encoded
+  snapshots keyed by the ledger's workload digest, holding the bucketed
+  device-resident ``SnapshotArrays``. The first POST pays encode +
+  transfer and returns ``snapshot_digest``; every later request says
+  ``{"base": "<digest>"}`` and pays neither. An LRU + byte-budget
+  (``--max-resident-bytes``) eviction drops DEVICE state only — the
+  host snapshot stays, so an evicted entry rehydrates transparently
+  (degrade to re-transfer, never a 500). Victims are taken with a
+  non-blocking ``KeyedMutex.try_hold`` (the session store's AB-BA rule);
+  hits/misses/evictions/bytes land in the ``simon_resident_*`` family.
+
+* **Delta requests**: ``{"base": digest, "delta": {...}}`` applies
+  pod/node add/remove diffs host-side instead of re-encoding —
+  ``remove_nodes`` deactivates (pods pinned there go -2, the
+  node-not-found sentinel), ``add_nodes`` activates padded template
+  slots, ``remove_pods`` rewrites the forced column to the bind-nothing
+  sentinel (-4) — the exact levers chaos, the capacity sweep and replay
+  already pull, so the delta-applied result is bit-identical (placement
+  digest) to a cold full re-encode of the diffed cluster.
+  ``add_apps`` is the one diff that genuinely needs rows the encode
+  never materialized: it degrades to a host re-encode from the entry's
+  own stored objects and admits the derived snapshot under its own
+  digest. Every malformed diff is the CLIENT's error: structured 400,
+  cache state untouched.
+
+* **Fault-isolated coalescing** (``execute_group`` + the queue's
+  ``group_key`` machinery): concurrent requests against the same
+  resident snapshot whose diffs are mask-only merge into ONE batched
+  launch on the existing scenario axis — each caller's lanes are sliced
+  back out and decoded under its own token, so a member that blew its
+  deadline answers 504, one that trips the placement auditor answers
+  its structured ``E_AUDIT``, and the siblings return 200 with digests
+  identical to singleton runs. Requests that rewrite the forced column
+  (pod deltas) run as singleton launches of the same cached executable
+  (same shapes + cfg — zero extra compiles).
+
+Lane-quarantine table (who fails, who survives — ARCHITECTURE.md §16):
+
+  ==========================  =========================  ==============
+  fault                       poisoned member            sibling lanes
+  ==========================  =========================  ==============
+  spec/delta validation       400 (before submit)        unaffected
+  deadline while queued       504 E_DEADLINE (skipped)   unaffected
+  deadline during launch      504 E_DEADLINE             200, digests
+                                                         == singleton
+  placement-audit violation   E_AUDIT (500)              200
+  decode raise                structured error / 500     200
+  whole-launch failure        every member errors        (no siblings)
+  ==========================  =========================  ==============
+
+Everything here is HOST machinery around one device launch per group;
+nothing runs inside jit/scan scope (graftlint GL4). Serving lanes run
+with ``fail_reasons`` off and no wave plan — one lean executable per
+shape bucket, shared by probes, capacity lanes and delta overlays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from open_simulator_tpu.errors import SimulationError
+from open_simulator_tpu.resilience import lifecycle
+
+_log = logging.getLogger(__name__)
+
+# the engine's bind-nothing sentinel (exec_cache pads pods with it; a
+# delta-removed pod takes zero scan work and zero carry)
+SENTINEL = -4
+# the node-not-found sentinel: a pod whose pinned node a delta removed
+# decodes unscheduled, exactly like a cold re-encode of the shrunk
+# cluster (make_valid's forced -2 treatment)
+NODE_GONE = -2
+
+DEFAULT_MAX_RESIDENT_BYTES = 1 << 30   # 1 GiB of device-resident arrays
+DEFAULT_MAX_ENTRIES = 64               # host-side snapshots kept (LRU)
+
+E_NO_SNAPSHOT = "E_NO_SNAPSHOT"
+
+# ---- HTTP status taxonomy (rest.py renders these; kept here so the
+# group executor can answer per-member without importing the handler) ----
+
+STATUS_BY_CODE = {
+    "E_PAYLOAD_TOO_LARGE": 413,
+    "E_TIMEOUT": 504,
+    "E_DEADLINE": 504,     # deadline observed (handler- or worker-side)
+    "E_CANCELLED": 504,    # explicit cooperative cancellation
+    "E_OVERLOADED": 429,   # admission queue full (Retry-After attached)
+    "E_BUSY": 503,         # draining: not accepting new work
+    "E_RESUME": 409,       # checkpoint fingerprint/parameter mismatch
+    "E_NO_SIMULATION": 404,
+    "E_NO_RUN": 404,
+    "E_NO_SESSION": 404,   # unknown/closed digital-twin session id
+    "E_AUDIT": 500,        # the engine's own invariants failed — server bug
+}
+
+
+def status_for(e: SimulationError) -> int:
+    return STATUS_BY_CODE.get(e.code, 400)
+
+
+def error_payload(e: SimulationError) -> Dict[str, Any]:
+    """Structured error body; `error` stays a plain string for
+    pre-taxonomy clients."""
+    out = e.to_dict()
+    out["error"] = e.message
+    return out
+
+
+# ---- telemetry -----------------------------------------------------------
+
+
+def _resident_metrics():
+    from open_simulator_tpu import telemetry
+
+    return (
+        telemetry.gauge("simon_resident_snapshots",
+                        "resident-cache entries with device arrays live"),
+        telemetry.gauge("simon_resident_bytes",
+                        "bytes of device-resident snapshot arrays"),
+        telemetry.gauge("simon_resident_entries",
+                        "resident-cache entries (host snapshots, incl. "
+                        "device-evicted ones)"),
+        telemetry.counter(
+            "simon_resident_total",
+            "resident snapshot cache events (hit/miss/insert/rehydrate/"
+            "eviction/drop/uncacheable; device_hit = arrays already "
+            "resident at launch, distinct from the table-lookup hit so "
+            "hit/miss ratios stay per-request)", labelnames=("event",)),
+        telemetry.counter(
+            "simon_coalesced_launches_total",
+            "serving launches by member count bucket",
+            labelnames=("kind",)),
+    )
+
+
+# ---- resident entries ----------------------------------------------------
+
+
+class ResidentEntry:
+    """One cached snapshot: the host ``ClusterSnapshot`` (always kept —
+    it is what eviction degrades back to), the serving ``EngineConfig``,
+    and the bucketed device arrays (droppable)."""
+
+    __slots__ = ("digest", "snapshot", "encode_opts", "cfg", "n_nodes",
+                 "n_pods", "n_pad", "p_pad", "dev", "device_bytes",
+                 "last_touch", "created_at")
+
+    def __init__(self, digest: str, snapshot, encode_opts, cfg,
+                 n_pad: int, p_pad: int):
+        self.digest = digest
+        self.snapshot = snapshot
+        self.encode_opts = encode_opts
+        self.cfg = cfg
+        self.n_nodes = snapshot.n_nodes
+        self.n_pods = snapshot.n_pods
+        self.n_pad = int(n_pad)
+        self.p_pad = int(p_pad)
+        self.dev = None
+        self.device_bytes = 0
+        self.created_at = time.time()
+        self.last_touch = time.monotonic()
+
+    @property
+    def resident(self) -> bool:
+        return self.dev is not None
+
+    def info(self) -> Dict[str, Any]:
+        return {"digest": self.digest, "nodes": self.n_nodes,
+                "pods": self.n_pods, "bucket": [self.n_pad, self.p_pad],
+                "resident": self.resident,
+                "device_bytes": int(self.device_bytes)}
+
+
+def entry_from_snapshot(snapshot, encode_opts=None) -> ResidentEntry:
+    """Build a cacheable entry: content digest + the lean serving config
+    (fail_reasons off — probes and capacity lanes want assignments, the
+    sweep-lane precedent) + the bucket this snapshot compiles at.
+
+    The digest extends the ledger workload digest (arrays only) with the
+    node-name and pod-key vocabularies: two clusters differing ONLY in
+    names encode identical arrays, and aliasing them onto one entry
+    would answer requests with the OTHER cluster's names."""
+    from open_simulator_tpu.engine.exec_cache import bucket_shape
+    from open_simulator_tpu.engine.scheduler import make_config
+    from open_simulator_tpu.telemetry.ledger import workload_digest
+
+    h = hashlib.sha256(workload_digest(snapshot.arrays).encode())
+    for name in snapshot.node_names:
+        h.update(name.encode())
+        h.update(b";")
+    for pod in snapshot.pods:
+        h.update(pod.key.encode())
+        h.update(b";")
+    digest = h.hexdigest()[:16]
+    cfg = make_config(snapshot)._replace(fail_reasons=False)
+    nb, pb = bucket_shape(snapshot.n_nodes, snapshot.n_pods)
+    return ResidentEntry(digest, snapshot, encode_opts, cfg, nb, pb)
+
+
+class ResidentSnapshotCache:
+    """Digest-keyed snapshot table with LRU + byte-budget device
+    residency. Thread-safe: the table on one lock, per-digest operations
+    (rehydrate vs evict races) on a ``KeyedMutex`` whose eviction side
+    only ever ``try_hold``s (AB-BA rule, see the session store)."""
+
+    def __init__(self, max_bytes: int = DEFAULT_MAX_RESIDENT_BYTES,
+                 max_entries: int = DEFAULT_MAX_ENTRIES):
+        self.max_bytes = int(max_bytes)
+        self.max_entries = max(1, int(max_entries))
+        self._guard = threading.Lock()
+        self._mutex = lifecycle.KeyedMutex()
+        self._entries: "OrderedDict[str, ResidentEntry]" = OrderedDict()
+
+    # -- bookkeeping -----------------------------------------------------
+
+    def _gauges(self) -> None:
+        res_g, bytes_g, entries_g, _, _ = _resident_metrics()
+        with self._guard:
+            res_g.set(sum(1 for e in self._entries.values() if e.resident))
+            bytes_g.set(sum(e.device_bytes for e in self._entries.values()))
+            entries_g.set(len(self._entries))
+
+    def stats(self) -> Dict[str, Any]:
+        with self._guard:
+            entries = list(self._entries.values())
+        return {"entries": len(entries),
+                "resident": sum(1 for e in entries if e.resident),
+                "resident_bytes": sum(e.device_bytes for e in entries),
+                "max_resident_bytes": self.max_bytes,
+                "snapshots": [e.info() for e in entries]}
+
+    # -- admission / lookup ----------------------------------------------
+
+    def admit(self, snapshot, encode_opts=None) -> ResidentEntry:
+        """Insert (or return the already-cached entry for) an encoded
+        snapshot. Insertion may drop whole LRU entries past
+        ``max_entries`` — a dropped digest is a re-POST, not an error."""
+        return self.admit_entry(entry_from_snapshot(snapshot, encode_opts))
+
+    def admit_entry(self, entry: ResidentEntry) -> ResidentEntry:
+        """``admit`` for a pre-built (not yet cached) entry — the
+        request path builds the entry first so delta validation can run
+        against it BEFORE the cache mutates (a rejected request must
+        leave the table, and therefore its LRU order, untouched)."""
+        _, _, _, events, _ = _resident_metrics()
+        with self._guard:
+            existing = self._entries.get(entry.digest)
+            if existing is not None:
+                self._entries.move_to_end(entry.digest)
+                existing.last_touch = time.monotonic()
+                events.labels(event="hit").inc()
+                return existing
+            self._entries[entry.digest] = entry
+            dropped = []
+            while len(self._entries) > self.max_entries:
+                _, old = self._entries.popitem(last=False)
+                dropped.append(old)
+        for old in dropped:
+            old.dev = None
+            events.labels(event="drop").inc()
+        events.labels(event="insert").inc()
+        self._gauges()
+        return entry
+
+    def get(self, digest: str) -> Optional[ResidentEntry]:
+        _, _, _, events, _ = _resident_metrics()
+        with self._guard:
+            entry = self._entries.get(digest or "")
+            if entry is not None:
+                self._entries.move_to_end(digest)
+                entry.last_touch = time.monotonic()
+        events.labels(event="hit" if entry is not None else "miss").inc()
+        return entry
+
+    def require(self, digest: str) -> ResidentEntry:
+        entry = self.get(digest)
+        if entry is None:
+            raise SimulationError(
+                f"no resident snapshot {digest!r}", code="E_BAD_REQUEST",
+                ref="request", field="base",
+                hint="POST the full cluster once and reuse the returned "
+                     "snapshot_digest; evicted/unknown digests need a "
+                     "re-POST (the cache is bounded)")
+        return entry
+
+    # -- device residency -------------------------------------------------
+
+    def device_arrays(self, entry: ResidentEntry):
+        """The entry's bucketed device arrays, rehydrating when evicted
+        (pad + transfer — the host snapshot is the durable truth). An
+        entry bigger than the whole budget is served TRANSIENTLY: the
+        caller's launch still runs, nothing is cached, no error."""
+        import jax
+        import jax.numpy as jnp
+
+        from open_simulator_tpu.engine.exec_cache import pad_snapshot_arrays
+
+        _, _, _, events, _ = _resident_metrics()
+        with self._mutex.hold(entry.digest):
+            dev = entry.dev
+            if dev is not None:
+                entry.last_touch = time.monotonic()
+                events.labels(event="device_hit").inc()
+                return dev
+            padded = pad_snapshot_arrays(entry.snapshot.arrays,
+                                         entry.n_pad, entry.p_pad)
+            nbytes = sum(np.asarray(getattr(padded, f.name)).nbytes
+                         for f in dataclasses.fields(padded))
+            dev = jax.tree_util.tree_map(jnp.asarray, padded)
+            events.labels(event="rehydrate").inc()
+            if 0 < self.max_bytes < nbytes:
+                # one snapshot larger than the entire budget: serve it
+                # transiently (this launch works; nothing goes resident)
+                events.labels(event="uncacheable").inc()
+                entry.last_touch = time.monotonic()
+                return dev
+            entry.dev = dev
+            entry.device_bytes = int(nbytes)
+            entry.last_touch = time.monotonic()
+        self.evict_overflow(keep=entry.digest)
+        self._gauges()
+        return dev
+
+    def evict_overflow(self, keep: str = "") -> int:
+        """Drop device arrays LRU-first until the byte budget holds
+        (never ``keep``'s, never an entry another thread is mid-touch on
+        — ``try_hold`` skips busy victims; they are recently used by
+        definition and a blocking acquire here is the AB-BA deadlock)."""
+        _, _, _, events, _ = _resident_metrics()
+        evicted = 0
+        busy: set = set()
+        while True:
+            with self._guard:
+                total = sum(e.device_bytes for e in self._entries.values()
+                            if e.resident)
+                victims = sorted(
+                    (e.last_touch, d) for d, e in self._entries.items()
+                    if e.resident and d != keep and d not in busy)
+                if self.max_bytes <= 0 or total <= self.max_bytes \
+                        or not victims:
+                    return evicted
+                _, victim = victims[0]
+                entry = self._entries[victim]
+            with self._mutex.try_hold(victim) as got:
+                if got:
+                    entry.dev = None
+                    entry.device_bytes = 0
+                    events.labels(event="eviction").inc()
+                    evicted += 1
+                else:
+                    busy.add(victim)
+            self._gauges()
+
+    def drop_all(self) -> None:
+        """Release every entry (drain/tests); gauges drain to 0."""
+        with self._guard:
+            for e in self._entries.values():
+                e.dev = None
+                e.device_bytes = 0
+            self._entries.clear()
+        self._gauges()
+
+
+# ---- deltas --------------------------------------------------------------
+
+
+_DELTA_FIELDS = ("add_nodes", "remove_nodes", "remove_pods", "add_apps")
+
+
+@dataclass(frozen=True)
+class Delta:
+    """A parsed pod/node diff against a base snapshot."""
+
+    add_nodes: int = 0
+    remove_nodes: Tuple[str, ...] = ()
+    remove_pods: Tuple[str, ...] = ()
+    add_apps: Tuple[Tuple[str, str], ...] = ()   # (name, yaml)
+
+    @property
+    def empty(self) -> bool:
+        return not (self.add_nodes or self.remove_nodes or self.remove_pods
+                    or self.add_apps)
+
+    @property
+    def mask_only(self) -> bool:
+        """True when the diff touches only node activation — the
+        coalescible class (the forced column stays the base's)."""
+        return not (self.remove_pods or self.remove_nodes or self.add_apps)
+
+
+def _bad(field_name: str, msg: str, hint: str = "") -> SimulationError:
+    return SimulationError(
+        msg, code="E_BAD_REQUEST", ref="request", field=field_name,
+        hint=hint or 'e.g. {"delta": {"add_nodes": 2, "remove_nodes": '
+                     '["n3"], "remove_pods": ["default/web-0"]}}')
+
+
+def parse_delta(raw: Any) -> Delta:
+    """Validate a request's ``delta`` object into a ``Delta``. Every
+    malformed shape — wrong container types, negative quantities,
+    truncated/unknown diff keys — is the CLIENT's error: a structured
+    400 naming the field, never a 500."""
+    if raw is None:
+        return Delta()
+    if not isinstance(raw, dict):
+        raise _bad("delta", f"delta must be an object, got "
+                            f"{type(raw).__name__}")
+    unknown = sorted(set(raw) - set(_DELTA_FIELDS))
+    if unknown:
+        raise _bad(f"delta.{unknown[0]}",
+                   f"unknown delta field(s) {unknown} (truncated or "
+                   f"misspelled diff?)",
+                   hint=f"known diffs: {list(_DELTA_FIELDS)}")
+    raw_add = raw.get("add_nodes", 0)
+    if isinstance(raw_add, bool) or not isinstance(raw_add, int):
+        raise _bad("delta.add_nodes",
+                   f"add_nodes must be an integer, got {raw_add!r}")
+    if raw_add < 0:
+        raise _bad("delta.add_nodes",
+                   f"add_nodes must be non-negative, got {raw_add}")
+
+    def str_list(name: str) -> Tuple[str, ...]:
+        v = raw.get(name)
+        if v is None:
+            return ()
+        if not isinstance(v, list) or not all(
+                isinstance(x, str) and x for x in v):
+            raise _bad(f"delta.{name}",
+                       f"{name} must be a list of non-empty strings, "
+                       f"got {v!r}")
+        return tuple(v)
+
+    raw_apps = raw.get("add_apps")
+    apps: List[Tuple[str, str]] = []
+    if raw_apps is not None:
+        if not isinstance(raw_apps, list):
+            raise _bad("delta.add_apps",
+                       f"add_apps must be a list, got "
+                       f"{type(raw_apps).__name__}")
+        for i, a in enumerate(raw_apps):
+            if not isinstance(a, dict) or not isinstance(
+                    a.get("yaml"), str) or not a.get("yaml"):
+                raise _bad(f"delta.add_apps[{i}].yaml",
+                           "each add_apps entry needs a non-empty "
+                           "\"yaml\" manifest",
+                           hint='{"add_apps": [{"name": "a", "yaml": '
+                                '"<k8s yaml>"}]}')
+            apps.append((str(a.get("name") or f"app{i}"), a["yaml"]))
+    return Delta(add_nodes=int(raw_add),
+                 remove_nodes=str_list("remove_nodes"),
+                 remove_pods=str_list("remove_pods"),
+                 add_apps=tuple(apps))
+
+
+@dataclass
+class DeltaView:
+    """The host-side overlay a delta resolves to: per-node activation
+    and (when pods were removed) a rewritten forced column. These are
+    the SAME two levers chaos / replay / the capacity sweep pull, so
+    scheduling under the overlay is bit-identical to a cold re-encode
+    of the diffed cluster (placement digests match by name)."""
+
+    active: np.ndarray                 # [N] bool, real axis
+    forced: Optional[np.ndarray]       # [P] i32 overlay, None = base column
+    free_slots: List[int] = field(default_factory=list)  # still-inactive
+    #                                     padded template slots (capacity)
+
+
+def apply_delta(entry: ResidentEntry, delta: Delta) -> DeltaView:
+    """Resolve a (pre-parsed) delta against the base snapshot. Dangling
+    references — nodes not in the snapshot or not active, pod keys the
+    universe never contained, more add_nodes than padded slots — are
+    structured 400s; the cache is never mutated (overlays are
+    per-request copies)."""
+    snap = entry.snapshot
+    arrs = snap.arrays
+    active = np.array(np.asarray(arrs.active), dtype=bool, copy=True)
+    forced: Optional[np.ndarray] = None
+    base_forced = np.asarray(arrs.forced_node)
+
+    if delta.remove_nodes:
+        index = {n: i for i, n in enumerate(snap.node_names)}
+        removed = []
+        for name in delta.remove_nodes:
+            i = index.get(name)
+            if i is None or not active[i]:
+                raise _bad(
+                    "delta.remove_nodes",
+                    f"node {name!r} is not an active node of snapshot "
+                    f"{entry.digest} (dangling node ref)",
+                    hint="remove_nodes names nodes of the base snapshot; "
+                         "template slots activate via add_nodes only")
+            active[i] = False
+            removed.append(i)
+        # pods pinned to a removed node: the cold re-encode of the shrunk
+        # cluster gives them forced -2 ("node not found") — match it
+        gone = np.isin(base_forced, np.asarray(removed, dtype=base_forced.dtype))
+        if bool(np.any(gone)):
+            forced = np.array(base_forced, dtype=np.int32, copy=True)
+            forced[gone] = NODE_GONE
+
+    if delta.remove_pods:
+        key_to_idx: Dict[str, int] = {}
+        for i, p in enumerate(snap.pods):
+            key_to_idx.setdefault(p.key, i)
+        if forced is None:
+            forced = np.array(base_forced, dtype=np.int32, copy=True)
+        for key in delta.remove_pods:
+            i = key_to_idx.get(key)
+            if i is None:
+                raise _bad(
+                    "delta.remove_pods",
+                    f"pod {key!r} is not in snapshot {entry.digest} "
+                    f"(dangling pod ref)",
+                    hint="remove_pods names ns/name keys of the base "
+                         "snapshot's pod universe")
+            forced[i] = SENTINEL
+
+    n_real = snap.n_real_nodes
+    free = [i for i in range(n_real, snap.n_nodes) if not active[i]]
+    if delta.add_nodes:
+        if delta.add_nodes > len(free):
+            raise _bad(
+                "delta.add_nodes",
+                f"add_nodes {delta.add_nodes} exceeds the snapshot's "
+                f"{len(free)} free new-node slot(s)",
+                hint="re-POST the full cluster with a larger "
+                     "max_new_nodes (the slots are encoded up front)")
+        take = free[: delta.add_nodes]
+        for i in take:
+            active[i] = True
+        free = free[delta.add_nodes:]
+    return DeltaView(active=active, forced=forced, free_slots=free)
+
+
+def derive_with_apps(entry: ResidentEntry, delta: Delta) -> ResidentEntry:
+    """The one diff that needs pod rows the base never encoded:
+    ``add_apps`` re-encodes host-side from the entry's OWN stored
+    objects (real nodes + pod universe + new batches) into a derived
+    entry under its own digest (the caller admits it once the rest of
+    the delta validates) — the byte the client saves is the whole
+    cluster re-upload; the server saves the YAML re-parse of everything
+    but the new apps."""
+    import yaml as _yaml
+
+    from open_simulator_tpu.core import AppResource, _priority_sort
+    from open_simulator_tpu.encode.snapshot import (
+        EncodeOptions,
+        encode_cluster,
+    )
+    from open_simulator_tpu.k8s.loader import (
+        ClusterResources,
+        demux_object,
+        parse_yaml_documents,
+    )
+    from open_simulator_tpu.models.expand import expand_app_resources
+
+    snap = entry.snapshot
+    real_nodes = snap.nodes[: snap.n_real_nodes]
+    apps: List[AppResource] = []
+    for name, yaml_text in delta.add_apps:
+        res = ClusterResources()
+        try:
+            for doc in parse_yaml_documents(yaml_text):
+                demux_object(doc, res)
+        except _yaml.YAMLError as e:
+            raise SimulationError(
+                f"add_apps {name!r} has invalid YAML: {e}", code="E_SPEC",
+                ref="request", field="delta.add_apps[].yaml") from None
+        apps.append(AppResource(name=name, resources=res))
+    pods = list(snap.pods)
+    for app in apps:
+        pods.extend(_priority_sort(
+            expand_app_resources(app.resources, real_nodes, app.name)))
+    opts = entry.encode_opts or EncodeOptions()
+    opts = dataclasses.replace(
+        opts,
+        pvcs=list(opts.pvcs) + [p for a in apps for p in a.resources.pvcs],
+        pvs=list(opts.pvs) + [p for a in apps for p in a.resources.pvs],
+        storage_classes=(list(opts.storage_classes)
+                         + [s for a in apps
+                            for s in a.resources.storage_classes]))
+    snapshot = encode_cluster(real_nodes, pods, opts)
+    return entry_from_snapshot(snapshot, opts)
+
+
+# ---- digests -------------------------------------------------------------
+
+
+def live_mask(entry: ResidentEntry,
+              forced: Optional[np.ndarray]) -> np.ndarray:
+    """Which pods of the universe EXIST for this request: everything but
+    the bind-nothing sentinels (bucketing pads, pre-reason rows, and
+    delta-removed pods). Digests and placed/unplaced counts cover live
+    pods only, so a delta-removed pod and a cold re-encode without it
+    report identically."""
+    col = (np.asarray(entry.snapshot.arrays.forced_node)
+           if forced is None else forced)
+    return col != SENTINEL
+
+
+def placement_digest(entry: ResidentEntry, nodes_row: np.ndarray,
+                     live: np.ndarray) -> str:
+    """Name-based placement digest: pod key -> node NAME (or "!"),
+    hashed in universe order over live pods. Index-free by design, so
+    an overlay run (node inactive) and a cold re-encode (node absent)
+    of the same question digest identically — and a coalesced lane
+    digests identically to its singleton run."""
+    names = entry.snapshot.node_names
+    h = hashlib.sha256()
+    for i in np.nonzero(live)[0]:
+        ni = int(nodes_row[i])
+        h.update(f"{entry.snapshot.pods[i].key}->"
+                 f"{names[ni] if ni >= 0 else '!'};".encode())
+    return h.hexdigest()[:16]
+
+
+# ---- prepared lane requests ---------------------------------------------
+
+
+@dataclass
+class PreparedLanes:
+    """One request's device question, fully resolved host-side: lane
+    masks against a resident snapshot, an optional forced-column
+    overlay, and the decode that turns its lane slice back into an HTTP
+    payload. ``coalesce_key`` is non-None exactly when a sibling with
+    the same key can share the launch (same digest + base forced
+    column; the cfg and bucket are functions of the digest)."""
+
+    kind: str
+    entry: ResidentEntry
+    cache: ResidentSnapshotCache
+    masks: np.ndarray                     # [k, N] real-axis lane masks
+    forced: Optional[np.ndarray]          # [P] overlay, None = base
+    decode: Callable[["LaneResult"], Tuple[int, Dict[str, Any]]]
+    coalesce_key: Optional[Tuple] = None
+
+
+@dataclass
+class LaneResult:
+    """One member's hosted slice of a (possibly coalesced) launch."""
+
+    nodes: np.ndarray          # [k, P] assignments, real pod axis
+    headroom: np.ndarray       # [k, N_pad, R]
+    vg_used: np.ndarray        # [k, N_pad, V]
+    masks_pad: np.ndarray      # [k, N_pad]
+    coalesced_members: int     # members sharing the launch (1 = alone)
+
+
+def _pad_masks(masks: np.ndarray, n_pad: int) -> np.ndarray:
+    s, n = masks.shape
+    if n == n_pad:
+        return masks
+    out = np.zeros((s, n_pad), dtype=bool)
+    out[:, :n] = masks
+    return out
+
+
+def execute_group(jobs: List[Any]) -> None:
+    """The queue's group executor: ONE batched launch answers every
+    member (``jobs[i].payload`` is a ``PreparedLanes``; same digest +
+    base forced column by key construction). Per-member fault isolation:
+    a member whose token cancelled mid-launch gets its own 504, a
+    decode/audit failure its own structured error — siblings are
+    answered normally, from the same hosted tensors their singleton
+    runs would produce."""
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.engine.exec_cache import run_batched_cached
+    from open_simulator_tpu.resilience.retry import run_with_retries
+    from open_simulator_tpu.telemetry.spans import span
+
+    members: List[PreparedLanes] = [j.payload for j in jobs]
+    lead = members[0]
+    entry, cache = lead.entry, lead.cache
+    _, _, _, _, launches = _resident_metrics()
+    launches.labels(
+        kind="coalesced" if len(members) > 1 else "singleton").inc()
+
+    masks_pad = _pad_masks(
+        np.concatenate([m.masks for m in members], axis=0), entry.n_pad)
+    # bucket the LANE axis too: the lane count is part of the compile
+    # cache key (exec_cache), and coalesced group sizes vary with queue
+    # timing — launching 2, 3, 5, ... lanes raw would compile a fresh
+    # executable per size (a compile storm under load). Padding to the
+    # next power of two bounds compiles at log2; filler lanes repeat
+    # lane 0 and their rows are never decoded.
+    lanes = int(masks_pad.shape[0])
+    bucket = 1 << (lanes - 1).bit_length()
+    masks_launch = masks_pad
+    if bucket > lanes:
+        masks_launch = np.concatenate(
+            [masks_pad, np.repeat(masks_pad[:1], bucket - lanes, axis=0)],
+            axis=0)
+
+    try:
+        arrs = cache.device_arrays(entry)
+        if lead.forced is not None:
+            # forced-column overlay (pod deltas): same shapes + cfg as the
+            # base launch, so the AOT executable is REUSED — overlays are
+            # data, not programs. Overlay groups are singletons by key.
+            pad = np.full(entry.p_pad, SENTINEL, dtype=np.int32)
+            pad[: entry.n_pods] = lead.forced
+            arrs = dataclasses.replace(arrs, forced_node=jnp.asarray(pad))
+
+        with span("serving.launch", members=len(members), lanes=lanes,
+                  launch_lanes=bucket):
+            out = run_with_retries(
+                lambda: run_batched_cached(arrs, jnp.asarray(masks_launch),
+                                           entry.cfg,
+                                           fn_name="serving_lanes"),
+                retries=2, backoff_s=0.05)
+            nodes = np.asarray(out.node)[:lanes, : entry.n_pods]
+            headroom = np.asarray(out.state.headroom)[:lanes]
+            vg_used = np.asarray(out.state.vg_used)[:lanes]
+    except SimulationError as e:
+        # a whole-launch failure with taxonomy (retries exhausted,
+        # rehydration OOM): every member gets the STRUCTURED body —
+        # letting it escape would render as a bare 500 upstream
+        for job in jobs:
+            if job.result is None:
+                job.result = (status_for(e), error_payload(e))
+        return
+
+    offset = 0
+    for job, m in zip(jobs, members):
+        k = m.masks.shape[0]
+        sl = slice(offset, offset + k)
+        offset += k
+        if job.token is not None and job.token.cancelled:
+            err = job.token.error("coalesced launch decode")
+            job.result = (status_for(err), error_payload(err))
+            continue
+        try:
+            res = LaneResult(nodes=nodes[sl], headroom=headroom[sl],
+                             vg_used=vg_used[sl], masks_pad=masks_pad[sl],
+                             coalesced_members=len(members))
+            job.result = m.decode(res)
+        except SimulationError as e:
+            job.result = (status_for(e), error_payload(e))
+        except Exception as e:  # noqa: BLE001 — one member's decode bug
+            # must not poison its siblings' responses
+            job.result = (500, {"error": f"{type(e).__name__}: {e}"})
+
+
+def audit_lane(entry: ResidentEntry, nodes_row: np.ndarray,
+               active: np.ndarray, live: np.ndarray,
+               forced: Optional[np.ndarray] = None) -> None:
+    """Run the PR-8 placement invariant auditor over one lane's result,
+    against the OVERLAY view of the snapshot (the forced/active the lane
+    actually ran under — auditing a delta lane against the base arrays
+    would flag the delta itself: a pod the delta unpinned from a removed
+    node still carries its base pin there). Raises ``AuditError``
+    (E_AUDIT)."""
+    from open_simulator_tpu.campaign.audit import AuditError, audit_result
+    from open_simulator_tpu.core import decode_result
+
+    snap = entry.snapshot
+    col = (np.asarray(snap.arrays.forced_node) if forced is None
+           else np.asarray(forced))
+    forced_view = np.where(live, col, np.int32(SENTINEL)).astype(np.int32)
+    # pods the overlay unpinned from a removed node audit as free
+    forced_view = np.where(forced_view == NODE_GONE, np.int32(SENTINEL),
+                           forced_view)
+    arrs_view = dataclasses.replace(snap.arrays, forced_node=forced_view,
+                                    active=np.asarray(active, dtype=bool))
+    snap_view = dataclasses.replace(snap, arrays=arrs_view)
+    fail = np.zeros((entry.n_pods, entry.cfg.n_ops), dtype=np.int32)
+    shown = np.where(live, nodes_row, np.int32(SENTINEL)).astype(np.int32)
+    result = decode_result(snap_view, shown, fail,
+                           np.asarray(active, dtype=bool))
+    report = audit_result(result)
+    if not report.ok:
+        raise AuditError(report, ref=f"snapshot/{entry.digest}")
+
+
+# ---- request preparation (the handler-thread half) -----------------------
+
+
+def _req_int(body: Dict[str, Any], field_name: str, default: int,
+             minimum: int = 0,
+             maximum: Optional[int] = None) -> int:
+    raw = body.get(field_name, default)
+    try:
+        if isinstance(raw, bool):
+            raise ValueError
+        v = int(raw)
+    except (TypeError, ValueError):
+        raise _bad(field_name,
+                   f"{field_name} must be an integer, got {raw!r}",
+                   hint=f'e.g. {{"{field_name}": {default}}}') from None
+    if v < minimum:
+        raise _bad(field_name,
+                   f"{field_name} must be >= {minimum}, got {v}")
+    if maximum is not None and v > maximum:
+        raise SimulationError(
+            f"{field_name} {v} exceeds the server cap {maximum}",
+            code="E_BAD_REQUEST", ref="request", field=field_name,
+            hint="ask a smaller what-if, or run simon-tpu apply locally "
+                 "with --max-new-nodes")
+    return v
+
+
+def resolve_entry(server, body: Dict[str, Any],
+                  require_template: bool = False,
+                  default_max_new: int = 0,
+                  max_new_cap: int = 4096) -> Tuple[ResidentEntry, bool]:
+    """Resolve the request's snapshot: ``base`` looks up the resident
+    cache (unknown digest = structured 400 — the cache is bounded, a
+    re-POST restores it); otherwise encode the full body host-side.
+    Validation (body shape, admission pass, template caps) happens HERE,
+    on the handler thread, before anything is queued. Returns
+    ``(entry, fresh)`` — a fresh (full-body) entry is NOT yet cached;
+    the caller admits it after the delta validates, so a rejected
+    request never mutates the cache."""
+    import yaml as _yaml
+
+    cache = server._snapshots
+    base = body.get("base")
+    if base is not None:
+        if not isinstance(base, str) or not base:
+            raise _bad("base", f"base must be a snapshot digest string, "
+                               f"got {base!r}")
+        for clash in ("cluster", "apps", "new_node"):
+            if body.get(clash):
+                raise _bad(
+                    clash,
+                    f"{clash} and base are mutually exclusive: the base "
+                    f"snapshot already encodes its cluster, pod sequence "
+                    f"and new-node template",
+                    hint="express changes as {\"delta\": {...}} diffs")
+        return cache.require(base), False
+
+    from open_simulator_tpu.core import (
+        build_pod_sequence,
+        with_volume_objects,
+    )
+    from open_simulator_tpu.encode.snapshot import (
+        EncodeOptions,
+        encode_cluster,
+    )
+    from open_simulator_tpu.k8s.loader import make_valid_node
+    from open_simulator_tpu.k8s.objects import Node
+    from open_simulator_tpu.resilience.admission import admit
+
+    max_new = _req_int(body, "max_new_nodes", default_max_new,
+                       maximum=max_new_cap)
+    new_node = body.get("new_node") or {}
+    if not isinstance(new_node, dict):
+        raise _bad("new_node", f"new_node must be an object, got "
+                               f"{type(new_node).__name__}",
+                   hint='{"new_node": {"spec_yaml": "<Node yaml>"}}')
+    template = None
+    if new_node.get("spec_yaml"):
+        try:
+            template = make_valid_node(Node.from_dict(
+                _yaml.safe_load(new_node["spec_yaml"])))
+        except _yaml.YAMLError as e:
+            raise SimulationError(
+                f"new_node.spec_yaml is invalid YAML: {e}", code="E_SPEC",
+                ref="request", field="new_node.spec_yaml") from None
+    if require_template and template is None:
+        raise SimulationError(
+            "capacity planning needs a new-node template",
+            code="E_BAD_REQUEST", ref="request", field="new_node",
+            hint='include {"new_node": {"spec_yaml": "<Node yaml>"}}')
+    if template is None:
+        max_new = 0
+    cluster = server.base_cluster(body.get("cluster"))
+    cluster.nodes = [make_valid_node(n) for n in cluster.nodes]
+    apps = server._request_apps(body)
+    admit(cluster, apps)
+    pods = build_pod_sequence(cluster, apps)
+    # deterministic slot names (sim-new-NNN): the cache is
+    # content-addressed, so two POSTs of the same cluster must land on
+    # the same digest — random clone names would feed the hostname label
+    # into the topology vocab differently every encode
+    opts = with_volume_objects(
+        EncodeOptions(max_new_nodes=max_new, new_node_template=template,
+                      deterministic_new_nodes=True),
+        cluster, apps)
+    snapshot = encode_cluster(cluster.nodes, pods, opts)
+    return entry_from_snapshot(snapshot, opts), True
+
+
+def _resolve_view(server, body: Dict[str, Any], **resolve_kw
+                  ) -> Tuple[ResidentEntry, Delta, DeltaView]:
+    entry, fresh = resolve_entry(server, body, **resolve_kw)
+    delta = parse_delta(body.get("delta"))
+    if delta.add_apps:
+        entry = derive_with_apps(entry, delta)
+        fresh = True
+        delta = dataclasses.replace(delta, add_apps=())
+    view = apply_delta(entry, delta)
+    if fresh:
+        # admit only now, with the whole request validated: a rejected
+        # delta must leave the cache (and its LRU order) untouched
+        entry = server._snapshots.admit_entry(entry)
+    return entry, delta, view
+
+
+def _probe_decode(server, entry: ResidentEntry, live: np.ndarray,
+                  active: np.ndarray, forced: Optional[np.ndarray],
+                  want_placements: bool, audit: bool):
+    def decode(res: LaneResult) -> Tuple[int, Dict[str, Any]]:
+        row = res.nodes[0]
+        if audit:
+            audit_lane(entry, row, active, live, forced=forced)
+        placed_mask = live & (row >= 0)
+        placed = int(np.sum(placed_mask))
+        payload: Dict[str, Any] = {
+            "snapshot_digest": entry.digest,
+            "digest": placement_digest(entry, row, live),
+            "placed": placed,
+            "unplaced": int(np.sum(live)) - placed,
+            "active_nodes": int(np.sum(active)),
+            "coalesced_members": res.coalesced_members,
+        }
+        if want_placements:
+            snap = entry.snapshot
+            placements: Dict[str, List[str]] = {}
+            for i in np.nonzero(placed_mask)[0]:
+                placements.setdefault(
+                    snap.node_names[int(row[i])], []).append(
+                    snap.pods[i].key)
+            payload["placements"] = placements
+            payload["unscheduled_pods"] = sorted(
+                snap.pods[i].key
+                for i in np.nonzero(live & (row < 0))[0])
+        server._stats["simulations"] += 1
+        return (200, payload)
+
+    return decode
+
+
+def prepare_simulate(server, body: Dict[str, Any]) -> PreparedLanes:
+    """POST /api/simulate: one probe lane against a resident snapshot.
+
+    Body: {"base": "<digest>"} | {"cluster", "apps", "new_node"?,
+           "max_new_nodes"?}, optional {"delta": {...}},
+          "placements": true?, "audit": true?, "deadline_s"?.
+    """
+    server._stats["requests"] += 1
+    entry, delta, view = _resolve_view(server, body)
+    live = live_mask(entry, view.forced)
+    decode = _probe_decode(server, entry, live, view.active, view.forced,
+                           bool(body.get("placements")),
+                           bool(body.get("audit")))
+    key = ((entry.digest, "lanes") if view.forced is None else None)
+    return PreparedLanes(kind="simulate", entry=entry,
+                         cache=server._snapshots,
+                         masks=view.active[None, :].copy(),
+                         forced=view.forced, decode=decode,
+                         coalesce_key=key)
+
+
+def _capacity_decode(server, entry: ResidentEntry, live: np.ndarray,
+                     forced: Optional[np.ndarray], counts: List[int],
+                     thresholds, audit: bool):
+    from open_simulator_tpu.parallel.sweep import _lane_stats
+
+    snap = entry.snapshot
+    arrs = snap.arrays
+    cpu_i = snap.resources.index("cpu")
+    mem_i = snap.resources.index("memory")
+
+    def decode(res: LaneResult) -> Tuple[int, Dict[str, Any]]:
+        n_pad = res.headroom.shape[1]
+        alloc = np.zeros((n_pad, arrs.alloc.shape[1]), dtype=np.float32)
+        alloc[: entry.n_nodes] = np.asarray(arrs.alloc)
+        vg = np.asarray(arrs.vg_cap)
+        vg_cap = np.zeros((n_pad, vg.shape[1]), dtype=np.float32)
+        vg_cap[: entry.n_nodes] = vg
+        has_storage = bool(np.any(vg_cap > 0))
+        stats, lane_digests = [], []
+        for i, c in enumerate(counts):
+            if audit:
+                audit_lane(entry, res.nodes[i],
+                           res.masks_pad[i][: entry.n_nodes], live,
+                           forced=forced)
+            stats.append(_lane_stats(
+                alloc, cpu_i, mem_i, vg_cap, has_storage,
+                res.masks_pad[i], res.nodes[i][live], res.headroom[i],
+                res.vg_used[i], None, thresholds))
+            lane_digests.append(placement_digest(entry, res.nodes[i], live))
+        best = next((c for c, s in zip(counts, stats) if s.satisfied), None)
+        h = hashlib.sha256()
+        h.update(repr((list(counts),
+                       [s.satisfied for s in stats])).encode())
+        for d in lane_digests:
+            h.update(d.encode())
+        server._stats["simulations"] += 1
+        return (200, {
+            "best_count": best,
+            "mode": "exhaustive",
+            "max_new_nodes": max(counts) if counts else 0,
+            "counts": list(counts),
+            "all_scheduled": [s.all_scheduled for s in stats],
+            "satisfied": [s.satisfied for s in stats],
+            "cpu_occupancy_pct": [round(s.cpu_pct, 2) for s in stats],
+            "mem_occupancy_pct": [round(s.mem_pct, 2) for s in stats],
+            "trial_errors": {},
+            "sweep_id": None,
+            "resumed_rounds": 0,
+            "snapshot_digest": entry.digest,
+            "digest": h.hexdigest()[:16],
+            "lane_digests": lane_digests,
+            "coalesced_members": res.coalesced_members,
+        })
+
+    return decode
+
+
+def prepare_capacity(server, body: Dict[str, Any], max_new_cap: int):
+    """POST /api/capacity, the serving path: full bodies encode + admit,
+    ``base`` bodies reuse the resident snapshot, ``delta`` applies
+    host-side. Returns a ``PreparedLanes`` (exhaustive mode — one
+    launch, coalescible when mask-only) or a plain callable (bisect —
+    multi-round, runs as a classic singleton job through the journaled
+    ``capacity_bisect`` path)."""
+    from open_simulator_tpu.parallel.sweep import SweepThresholds
+
+    server._stats["requests"] += 1
+    mode = body.get("sweep_mode", "bisect")
+    if mode not in ("bisect", "exhaustive"):
+        raise _bad("sweep_mode", f"unknown sweep_mode {mode!r}",
+                   hint='use "bisect" (default) or "exhaustive"')
+    resume = body.get("resume") or None
+    if resume is not None and mode != "bisect":
+        raise _bad("resume",
+                   "resume requires sweep_mode \"bisect\" (only bisection "
+                   "rounds are checkpointed)",
+                   hint='drop "sweep_mode" or set it to "bisect"')
+    th = body.get("thresholds") or {}
+    if not isinstance(th, dict):
+        raise _bad("thresholds", f"thresholds must be an object, got "
+                                 f"{type(th).__name__}")
+
+    def th_float(name: str) -> float:
+        raw = th.get(name, 100.0)
+        try:
+            if isinstance(raw, bool):
+                raise ValueError
+            return float(raw)
+        except (TypeError, ValueError):
+            raise _bad(f"thresholds.{name}",
+                       f"thresholds.{name} must be a number, got "
+                       f"{raw!r}") from None
+
+    thresholds = SweepThresholds(max_cpu_pct=th_float("max_cpu_pct"),
+                                 max_memory_pct=th_float("max_memory_pct"),
+                                 max_vg_pct=th_float("max_vg_pct"))
+    if mode == "bisect" and not parse_delta(body.get("delta")).empty:
+        # checked BEFORE resolving: a full-body bisect request with a
+        # delta must be rejected without admitting its snapshot
+        raise _bad(
+            "sweep_mode",
+            "delta probes need sweep_mode \"exhaustive\" (bisection "
+            "re-derives lane masks from the base snapshot and would "
+            "discard the delta)",
+            hint='{"sweep_mode": "exhaustive"} coalesces with '
+                 'sibling probes of the same snapshot')
+    entry, delta, view = _resolve_view(
+        server, body, require_template=body.get("base") is None,
+        default_max_new=64, max_new_cap=max_new_cap)
+    slots = view.free_slots
+    if body.get("base") is not None:
+        max_new = _req_int(body, "max_new_nodes", len(slots),
+                           maximum=max_new_cap)
+        if max_new > len(slots):
+            raise _bad(
+                "max_new_nodes",
+                f"max_new_nodes {max_new} exceeds the snapshot's "
+                f"{len(slots)} free new-node slot(s)",
+                hint="the template slots were sized by the original "
+                     "POST's max_new_nodes; re-POST to grow them")
+    else:
+        max_new = min(_req_int(body, "max_new_nodes", 64,
+                               maximum=max_new_cap), len(slots))
+
+    if mode == "bisect":
+        def run_bisect() -> Dict[str, Any]:
+            from open_simulator_tpu.engine.scheduler import make_config
+            from open_simulator_tpu.parallel.sweep import capacity_bisect
+
+            # the journal fingerprint hashes the EngineConfig it is given:
+            # use the stock config (not the lean serving one) so sweeps
+            # journaled by `simon-tpu apply` stay resumable here and back
+            plan = capacity_bisect(entry.snapshot,
+                                   make_config(entry.snapshot), max_new,
+                                   thresholds, resume=resume)
+            server._stats["simulations"] += 1
+            return {
+                "best_count": plan.best_count,
+                "mode": "bisect",
+                "max_new_nodes": max_new,
+                "counts": list(plan.counts),
+                "all_scheduled": list(plan.all_scheduled),
+                "satisfied": list(plan.satisfied),
+                "cpu_occupancy_pct": [round(v, 2)
+                                      for v in plan.cpu_occupancy_pct],
+                "mem_occupancy_pct": [round(v, 2)
+                                      for v in plan.mem_occupancy_pct],
+                "trial_errors": {str(k): v
+                                 for k, v in plan.trial_errors.items()},
+                "sweep_id": plan.sweep_id,
+                "resumed_rounds": plan.resumed_rounds,
+                "snapshot_digest": entry.digest,
+            }
+
+        return run_bisect
+
+    counts = list(range(max_new + 1))
+    masks = np.zeros((len(counts), entry.n_nodes), dtype=bool)
+    for i, c in enumerate(counts):
+        masks[i] = view.active
+        for s in slots[:c]:
+            masks[i, s] = True
+    live = live_mask(entry, view.forced)
+    decode = _capacity_decode(server, entry, live, view.forced, counts,
+                              thresholds, bool(body.get("audit")))
+    key = ((entry.digest, "lanes") if view.forced is None else None)
+    return PreparedLanes(kind="capacity", entry=entry,
+                         cache=server._snapshots, masks=masks,
+                         forced=view.forced, decode=decode,
+                         coalesce_key=key)

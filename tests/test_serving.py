@@ -1,0 +1,891 @@
+"""Inference-grade serving tests (server/serving.py, ARCHITECTURE.md §16).
+
+Covers the four tentpole contracts of ISSUE 12:
+
+* resident snapshot cache: content-addressed admission, LRU +
+  byte-budget eviction that DEGRADES (re-transfer / transient serve,
+  never a 500), concurrent eviction vs touch without deadlock;
+* delta requests: structured 400s for every malformed diff (incl. a
+  ~50-seed mutation fuzz over both endpoints) and bit-identical
+  placement digests between a delta-applied overlay and a cold full
+  re-encode of the diffed cluster;
+* fault-isolated coalescing: concurrent probes of one snapshot merge
+  into one launch whose per-lane digests equal their singleton runs,
+  a poisoned lane (deadline, audit) fails ALONE;
+* the multi-worker queue: member-counted Retry-After accounting,
+  crashed-worker replacement, long jobs not starving short ones.
+"""
+
+import json
+import random
+import textwrap
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from open_simulator_tpu import telemetry
+from open_simulator_tpu.errors import SimulationError
+from open_simulator_tpu.resilience import lifecycle
+from open_simulator_tpu.server import serving
+from open_simulator_tpu.server.rest import SimulationServer, _make_handler
+
+CLUSTER_YAML = textwrap.dedent("""
+    apiVersion: v1
+    kind: Node
+    metadata: {name: s0, labels: {topology.kubernetes.io/zone: z0}}
+    status:
+      allocatable: {cpu: "8", memory: 16Gi, pods: "110"}
+    ---
+    apiVersion: v1
+    kind: Node
+    metadata: {name: s1, labels: {topology.kubernetes.io/zone: z0}}
+    status:
+      allocatable: {cpu: "8", memory: 16Gi, pods: "110"}
+    ---
+    apiVersion: v1
+    kind: Node
+    metadata: {name: s2, labels: {topology.kubernetes.io/zone: z1}}
+    status:
+      allocatable: {cpu: "4", memory: 8Gi, pods: "110"}
+    ---
+    apiVersion: apps/v1
+    kind: Deployment
+    metadata: {name: existing, namespace: default}
+    spec:
+      replicas: 4
+      selector: {matchLabels: {app: existing}}
+      template:
+        metadata: {labels: {app: existing}}
+        spec:
+          containers:
+            - name: c
+              image: registry.local/e:1
+              resources: {requests: {cpu: "2", memory: 2Gi}}
+""")
+
+NODE_SPEC_YAML = textwrap.dedent("""
+    apiVersion: v1
+    kind: Node
+    metadata: {name: template}
+    status:
+      allocatable: {cpu: "8", memory: 16Gi, pods: "110"}
+""")
+
+
+def _mini_server(**kw):
+    srv = SimulationServer(**kw)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _make_handler(srv))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return srv, httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def _post(url, payload):
+    """POST returning (status, body) without raising."""
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture(scope="module")
+def box():
+    srv, httpd, url = _mini_server()
+    yield srv, url
+    httpd.shutdown()
+
+
+@pytest.fixture(scope="module")
+def base_digest(box):
+    """The shared cluster admitted once; most tests probe this digest."""
+    _, url = box
+    status, out = _post(url + "/api/simulate",
+                        {"cluster": {"yaml": CLUSTER_YAML}})
+    assert status == 200, out
+    return out["snapshot_digest"]
+
+
+# ---- delta validation (unit) ---------------------------------------------
+
+
+def test_parse_delta_validation():
+    ok = serving.parse_delta({"add_nodes": 2, "remove_nodes": ["n1"],
+                              "remove_pods": ["default/a-0"]})
+    assert ok.add_nodes == 2 and ok.remove_nodes == ("n1",)
+    assert not ok.mask_only      # pod diffs rewrite the forced column
+    assert serving.parse_delta(None).empty
+    assert serving.parse_delta({"add_nodes": 1}).mask_only
+    for raw, field in [
+        (["x"], "delta"),                                # wrong container
+        ({"add_nodes": -1}, "delta.add_nodes"),          # negative quantity
+        ({"add_nodes": True}, "delta.add_nodes"),        # bool masquerade
+        ({"add_nodes": "2"}, "delta.add_nodes"),         # stringly int
+        ({"remove_nodes": "n1"}, "delta.remove_nodes"),  # not a list
+        ({"remove_nodes": [""]}, "delta.remove_nodes"),  # empty name
+        ({"remove_pods": [3]}, "delta.remove_pods"),     # wrong item type
+        ({"remove_node": ["n"]}, "delta.remove_node"),   # truncated key
+        ({"add_apps": "yaml"}, "delta.add_apps"),        # not a list
+        ({"add_apps": [{"name": "a"}]}, "delta.add_apps[0].yaml"),
+    ]:
+        with pytest.raises(SimulationError) as ei:
+            serving.parse_delta(raw)
+        assert ei.value.code == "E_BAD_REQUEST"
+        assert ei.value.field == field, (raw, ei.value.field)
+
+
+# ---- resident cache ------------------------------------------------------
+
+
+def test_content_addressed_admission(box, base_digest):
+    """Identical full POSTs land on ONE digest (deterministic template
+    clone names included) and later base probes are cache hits."""
+    srv, url = box
+    hits0 = telemetry.counter("simon_resident_total", labelnames=("event",)).value(event="hit")
+    s, again = _post(url + "/api/simulate", {"cluster": {"yaml": CLUSTER_YAML}})
+    assert s == 200 and again["snapshot_digest"] == base_digest
+    s2, probe = _post(url + "/api/simulate", {"base": base_digest,
+                                              "placements": True})
+    assert s2 == 200
+    assert probe["digest"] == again["digest"]
+    assert probe["placements"]           # full table on request
+    assert telemetry.counter("simon_resident_total", labelnames=("event",)).value(
+        event="hit") > hits0
+    assert srv._snapshots.stats()["resident"] >= 1
+
+
+def test_base_and_cluster_mutually_exclusive(box, base_digest):
+    _, url = box
+    s, out = _post(url + "/api/simulate",
+                   {"base": base_digest, "cluster": {"yaml": CLUSTER_YAML}})
+    assert s == 400 and out["field"] == "cluster"
+
+
+def test_unknown_base_digest_400(box):
+    _, url = box
+    s, out = _post(url + "/api/simulate", {"base": "feedbeef00000000"})
+    assert s == 400 and out["field"] == "base"
+    assert "re-POST" in out["hint"]
+
+
+# ---- delta == cold re-encode ---------------------------------------------
+
+
+def test_delta_remove_node_matches_cold_reencode(box, base_digest):
+    """Deactivating s2 via delta must place exactly like a cold full
+    re-encode of the cluster WITHOUT s2 (the index-free digest)."""
+    _, url = box
+    s, hot = _post(url + "/api/simulate",
+                   {"base": base_digest, "delta": {"remove_nodes": ["s2"]},
+                    "audit": True})
+    assert s == 200, hot
+    cold_yaml = "\n---\n".join(
+        doc for doc in CLUSTER_YAML.split("---")
+        if "name: s2" not in doc)
+    s2, cold = _post(url + "/api/simulate", {"cluster": {"yaml": cold_yaml}})
+    assert s2 == 200, cold
+    assert hot["digest"] == cold["digest"]
+    assert hot["placed"] == cold["placed"]
+    assert hot["active_nodes"] == cold["active_nodes"] == 2
+
+
+def test_delta_remove_pods_matches_cold_reencode(box, base_digest):
+    """Sentinelling default/existing-3 out must digest like a cold
+    re-encode with replicas: 3 (same first three pod keys)."""
+    _, url = box
+    s, hot = _post(url + "/api/simulate",
+                   {"base": base_digest,
+                    "delta": {"remove_pods": ["default/existing-3"]}})
+    assert s == 200, hot
+    s2, cold = _post(url + "/api/simulate",
+                     {"cluster": {"yaml": CLUSTER_YAML.replace(
+                         "replicas: 4", "replicas: 3")}})
+    assert s2 == 200, cold
+    assert hot["digest"] == cold["digest"]
+    assert hot["placed"] == cold["placed"] == 3
+
+
+def test_delta_add_nodes_matches_cold_real_node(box):
+    """Activating template slot sim-new-000 must place exactly like a
+    cold encode where the SAME node is a real cluster member (the
+    engine never reads is_new_node — slots are just inactive nodes)."""
+    _, url = box
+    body = {"cluster": {"yaml": CLUSTER_YAML.replace(
+                "replicas: 4", "replicas: 9")},
+            "new_node": {"spec_yaml": NODE_SPEC_YAML}, "max_new_nodes": 2}
+    s, base = _post(url + "/api/simulate", body)
+    assert s == 200, base
+    s1, hot = _post(url + "/api/simulate",
+                    {"base": base["snapshot_digest"],
+                     "delta": {"add_nodes": 1}, "audit": True})
+    assert s1 == 200, hot
+    assert hot["active_nodes"] == 4
+    cold_node = textwrap.dedent("""
+        apiVersion: v1
+        kind: Node
+        metadata:
+          name: sim-new-000
+          labels:
+            simon.tpu/new-node: "true"
+            kubernetes.io/hostname: sim-new-000
+        status:
+          allocatable: {cpu: "8", memory: 16Gi, pods: "110"}
+    """)
+    s2, cold = _post(url + "/api/simulate", {"cluster": {"yaml": (
+        CLUSTER_YAML.replace("replicas: 4", "replicas: 9")
+        + "\n---\n" + cold_node)}})
+    assert s2 == 200, cold
+    assert hot["digest"] == cold["digest"]
+    assert hot["placed"] == cold["placed"]
+
+
+def _cache_state(srv):
+    """Canonical cache state: an LRU touch (rejected requests still look
+    their base up) reorders the listing but mutates nothing."""
+    st = srv._snapshots.stats()
+    st["snapshots"] = sorted(st["snapshots"], key=lambda e: e["digest"])
+    return st
+
+
+def test_delta_dangling_refs_are_400s(box, base_digest):
+    srv, url = box
+    before = _cache_state(srv)
+    cases = [
+        ({"remove_nodes": ["ghost"]}, "delta.remove_nodes"),
+        ({"remove_pods": ["default/ghost-0"]}, "delta.remove_pods"),
+        ({"add_nodes": 5}, "delta.add_nodes"),   # no free slots encoded
+    ]
+    for delta, field in cases:
+        s, out = _post(url + "/api/simulate",
+                       {"base": base_digest, "delta": delta})
+        assert s == 400 and out["field"] == field, (delta, out)
+    assert _cache_state(srv) == before   # rejections never mutate
+
+
+# ---- mutation fuzz (ISSUE 12 satellite) ----------------------------------
+
+
+def _mutate_body(rng: random.Random, digest: str):
+    """One seeded mutation of a valid delta request body."""
+    body = {"base": digest,
+            "delta": {"add_nodes": 0, "remove_nodes": ["s1"],
+                      "remove_pods": ["default/existing-0"]}}
+    kind = rng.randrange(10)
+    if kind == 0:                                    # bogus base digest
+        body["base"] = "".join(rng.choice("0123456789abcdef")
+                               for _ in range(16))
+    elif kind == 1:                                  # wrong base type
+        body["base"] = rng.choice([17, [], {"d": 1}, True, ""])
+    elif kind == 2:                                  # dangling node ref
+        body["delta"]["remove_nodes"] = [f"ghost-{rng.randrange(99)}"]
+    elif kind == 3:                                  # dangling pod ref
+        body["delta"]["remove_pods"] = [f"ns/ghost-{rng.randrange(99)}"]
+    elif kind == 4:                                  # negative / huge adds
+        body["delta"]["add_nodes"] = rng.choice([-1, -17, 10**9])
+    elif kind == 5:                                  # wrong quantity types
+        body["delta"]["add_nodes"] = rng.choice(
+            ["2", 1.5, None, True, [1]])
+    elif kind == 6:                                  # truncated diff keys
+        body["delta"] = {rng.choice(["remove_node", "add_node", "rm",
+                                     "remove_podz"]): ["x"]}
+    elif kind == 7:                                  # wrong container types
+        body["delta"] = rng.choice(["remove_nodes", 42, ["s1"], True])
+    elif kind == 8:                                  # malformed add_apps
+        body["delta"] = {"add_apps": rng.choice(
+            ["app", [{"name": "a"}], [{"yaml": ""}], [42],
+             [{"name": "a", "yaml": "{{not yaml"}]])}
+    else:                                            # item-type poison
+        body["delta"]["remove_nodes"] = rng.choice(
+            [[None], [3], [["s1"]], "s1", [""]])
+    return body
+
+
+def test_fuzz_delta_bodies_never_500(box, base_digest):
+    """~50 seeded mutations against BOTH serving endpoints: structured
+    4xx, never a 500, resident cache state untouched by rejections."""
+    srv, url = box
+    before = _cache_state(srv)
+    statuses = set()
+    for seed in range(50):
+        rng = random.Random(seed)
+        body = _mutate_body(rng, base_digest)
+        path = rng.choice(["/api/simulate", "/api/capacity"])
+        if path == "/api/capacity":
+            body["sweep_mode"] = "exhaustive"
+        s, out = _post(url + path, body)
+        statuses.add(s)
+        assert s != 500, (seed, path, body, out)
+        if s >= 400:
+            assert out.get("code"), (seed, path, body, out)
+            assert _cache_state(srv) == before, (seed, path, body)
+    assert statuses >= {400}   # the corpus actually exercised rejections
+
+
+# ---- coalescing ----------------------------------------------------------
+
+
+def _wait_queued(srv, n, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if srv._queue.stats()["queued"] >= n:
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"queue never reached {n}: {srv._queue.stats()}")
+
+
+def test_coalesced_digests_equal_singleton(base_digest):
+    """Three concurrent base probes + one capacity sweep against one
+    snapshot merge into ONE launch; every caller's digest equals its
+    singleton run (the capacity count-0 lane IS the plain probe)."""
+    srv, httpd, url = _mini_server()
+    try:
+        s, out = _post(url + "/api/simulate",
+                       {"cluster": {"yaml": CLUSTER_YAML}})
+        assert s == 200
+        digest = out["snapshot_digest"]
+        singleton = out["digest"]
+
+        release = threading.Event()
+        srv.deploy_apps = lambda body: (release.wait(10.0), {})[1]
+        results = []
+        lock = threading.Lock()
+
+        def probe(payload, path="/api/simulate"):
+            r = _post(url + path, payload)
+            with lock:
+                results.append((path, r))
+
+        blocker = threading.Thread(
+            target=probe, args=({"apps": []}, "/api/deploy-apps"))
+        blocker.start()
+        # wait for the blocker to be IN FLIGHT so the probes queue behind
+        deadline = time.monotonic() + 5.0
+        while srv._queue.stats()["in_flight"] < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        threads = [threading.Thread(target=probe, args=({"base": digest},))
+                   for _ in range(3)]
+        threads.append(threading.Thread(
+            target=probe,
+            args=({"base": digest, "sweep_mode": "exhaustive"},
+                  "/api/capacity")))
+        for t in threads:
+            t.start()
+        _wait_queued(srv, 4)
+        release.set()
+        blocker.join(15.0)
+        for t in threads:
+            t.join(15.0)
+        assert len(results) == 5
+        members = []
+        for path, (status, body) in results:
+            if path == "/api/deploy-apps":
+                continue
+            assert status == 200, body
+            if path == "/api/simulate":
+                assert body["digest"] == singleton
+            else:
+                # the capacity lane for count 0 is exactly the base probe
+                assert body["counts"] == [0]
+                assert body["lane_digests"] == [singleton]
+            members.append(body["coalesced_members"])
+        assert max(members) == 4, members   # one merged launch took all 4
+    finally:
+        httpd.shutdown()
+
+
+def test_poisoned_lane_fails_alone():
+    """One member blows its deadline while queued, another trips the
+    placement auditor — each answers its OWN structured error while the
+    sibling lanes return 200 with singleton-identical digests."""
+    srv, httpd, url = _mini_server()
+    real_audit = serving.audit_lane
+    try:
+        s, out = _post(url + "/api/simulate",
+                       {"cluster": {"yaml": CLUSTER_YAML}})
+        assert s == 200
+        digest, singleton = out["snapshot_digest"], out["digest"]
+
+        # auditor poison: only lanes that ASKED for an audit go through
+        # audit_lane; make it reject deterministically
+        def exploding_audit(entry, nodes_row, active, live, forced=None):
+            raise SimulationError("injected audit violation",
+                                  code="E_AUDIT", ref="test")
+
+        serving.audit_lane = exploding_audit
+        release = threading.Event()
+        srv.deploy_apps = lambda body: (release.wait(10.0), {})[1]
+        results = []
+        lock = threading.Lock()
+
+        def probe(payload):
+            r = _post(url + "/api/simulate", payload)
+            with lock:
+                results.append((payload, r))
+
+        blocker = threading.Thread(
+            target=lambda: _post(url + "/api/deploy-apps", {"apps": []}))
+        blocker.start()
+        deadline = time.monotonic() + 5.0
+        while srv._queue.stats()["in_flight"] < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        payloads = [{"base": digest},
+                    {"base": digest, "deadline_s": 0.2},   # dies queued
+                    {"base": digest, "audit": True},       # dies at audit
+                    {"base": digest}]
+        threads = [threading.Thread(target=probe, args=(p,))
+                   for p in payloads]
+        for t in threads:
+            t.start()
+        _wait_queued(srv, 4)
+        time.sleep(0.3)          # the deadline_s member expires in queue
+        release.set()
+        blocker.join(15.0)
+        for t in threads:
+            t.join(15.0)
+        serving.audit_lane = real_audit
+
+        by_kind = {}
+        for payload, (status, body) in results:
+            if "deadline_s" in payload:
+                by_kind["deadline"] = (status, body)
+            elif payload.get("audit"):
+                by_kind["audit"] = (status, body)
+            else:
+                by_kind.setdefault("ok", []).append((status, body))
+        status, body = by_kind["deadline"]
+        assert status == 504 and body["code"] == "E_DEADLINE"
+        status, body = by_kind["audit"]
+        assert status == 500 and body["code"] == "E_AUDIT"
+        assert len(by_kind["ok"]) == 2
+        for status, body in by_kind["ok"]:
+            assert status == 200
+            assert body["digest"] == singleton   # siblings unharmed
+    finally:
+        serving.audit_lane = real_audit
+        httpd.shutdown()
+
+
+# ---- eviction ------------------------------------------------------------
+
+
+def _tiny_snapshot(n_pods=2, name="t"):
+    from open_simulator_tpu.core import build_pod_sequence
+    from open_simulator_tpu.encode.snapshot import encode_cluster
+    from open_simulator_tpu.k8s.loader import (
+        ClusterResources,
+        demux_object,
+        parse_yaml_documents,
+    )
+
+    docs = textwrap.dedent(f"""
+        apiVersion: v1
+        kind: Node
+        metadata: {{name: {name}-n0}}
+        status:
+          allocatable: {{cpu: "8", memory: 16Gi, pods: "110"}}
+        ---
+        apiVersion: apps/v1
+        kind: Deployment
+        metadata: {{name: {name}, namespace: default}}
+        spec:
+          replicas: {n_pods}
+          selector: {{matchLabels: {{app: {name}}}}}
+          template:
+            metadata: {{labels: {{app: {name}}}}}
+            spec:
+              containers:
+                - name: c
+                  resources: {{requests: {{cpu: "1", memory: 1Gi}}}}
+    """)
+    res = ClusterResources()
+    for doc in parse_yaml_documents(docs):
+        demux_object(doc, res)
+    return encode_cluster(res.nodes, build_pod_sequence(res, []), None)
+
+
+def test_byte_budget_eviction_degrades_never_500():
+    """A 1-byte budget makes EVERY snapshot transient: probes still
+    answer 200 (transient device arrays), nothing stays resident."""
+    srv, httpd, url = _mini_server(max_resident_bytes=1)
+    try:
+        s, out = _post(url + "/api/simulate",
+                       {"cluster": {"yaml": CLUSTER_YAML}})
+        assert s == 200, out
+        for _ in range(3):
+            s2, probe = _post(url + "/api/simulate",
+                              {"base": out["snapshot_digest"]})
+            assert s2 == 200, probe
+            assert probe["digest"] == out["digest"]
+        stats = srv._snapshots.stats()
+        assert stats["resident"] == 0       # over-budget: nothing cached
+        assert stats["entries"] >= 1        # the host snapshot remains
+        assert telemetry.counter("simon_resident_total", labelnames=("event",)).value(
+            event="uncacheable") >= 3
+    finally:
+        httpd.shutdown()
+
+
+def test_lru_eviction_keeps_budget_and_rehydrates():
+    """Two snapshots, budget for one: the LRU victim drops its device
+    arrays; touching it again rehydrates transparently and evicts the
+    other — no request ever fails."""
+    cache = serving.ResidentSnapshotCache(max_bytes=0)   # measure first
+    a = cache.admit(_tiny_snapshot(2, "a"))
+    cache.max_bytes = 10**9
+    cache.device_arrays(a)
+    one_entry = a.device_bytes
+    assert one_entry > 0
+    cache = serving.ResidentSnapshotCache(max_bytes=int(one_entry * 1.5))
+    ea = cache.admit(_tiny_snapshot(2, "a"))
+    eb = cache.admit(_tiny_snapshot(2, "b"))
+    assert ea.digest != eb.digest
+    cache.device_arrays(ea)
+    cache.device_arrays(eb)                  # must evict ea
+    assert eb.resident and not ea.resident
+    cache.device_arrays(ea)                  # rehydrates, evicts eb
+    assert ea.resident and not eb.resident
+    assert telemetry.counter("simon_resident_total", labelnames=("event",)).value(
+        event="eviction") >= 2
+    cache.drop_all()
+    assert telemetry.gauge("simon_resident_bytes").value() == 0
+    assert telemetry.gauge("simon_resident_snapshots").value() == 0
+
+
+def test_concurrent_eviction_hammer_no_deadlock():
+    """N threads share two digests under a one-entry budget: every
+    touch either finds, rehydrates, or serves transiently; eviction
+    mid-touch skips busy victims (try_hold) — no deadlock, and the
+    gauges drain to 0 afterwards."""
+    cache = serving.ResidentSnapshotCache(max_bytes=0)
+    ea = cache.admit(_tiny_snapshot(2, "a"))
+    eb = cache.admit(_tiny_snapshot(3, "b"))
+    cache.max_bytes = 10**9
+    cache.device_arrays(ea)
+    cache.max_bytes = int(ea.device_bytes * 1.5)
+    errors = []
+
+    def hammer(entry):
+        try:
+            for _ in range(25):
+                dev = cache.device_arrays(entry)
+                assert dev is not None
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(e,), daemon=True)
+               for e in (ea, eb, ea, eb, ea, eb)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60.0)
+    assert not any(t.is_alive() for t in threads), "eviction deadlocked"
+    assert not errors, errors
+    cache.drop_all()
+    assert telemetry.gauge("simon_resident_bytes").value() == 0
+    assert telemetry.gauge("simon_resident_snapshots").value() == 0
+    assert telemetry.gauge("simon_resident_entries").value() == 0
+
+
+# ---- queue accounting / workers (resilience/lifecycle.py) ----------------
+
+
+def test_retry_after_counts_coalesced_members():
+    """The EWMA records launch-time / members and in-flight counts
+    MEMBERS: a merged launch of 4 callers must not look like one fast
+    job to the Retry-After estimate (the regression: 429 hints went
+    k-fold optimistic under coalescing)."""
+    q = lifecycle.AdmissionQueue(depth=16, initial_service_s=0.05)
+    release = threading.Event()
+    seen = {}
+
+    def blocker_fn():
+        release.wait(10.0)
+        return "ok"
+
+    blocker = q.submit(blocker_fn, label="blocker")
+
+    def group_fn(jobs):
+        seen["in_flight"] = q.stats()["in_flight"]
+        time.sleep(0.4)
+        for j in jobs:
+            j.result = (200, {})
+
+    jobs = [q.submit(None, label=f"m{i}", group_key=("d", "lanes"),
+                     group_fn=group_fn) for i in range(4)]
+    release.set()
+    assert blocker.wait(10.0)
+    for j in jobs:
+        assert j.wait(10.0)
+        assert j.error is None and j.result == (200, {})
+    assert seen["in_flight"] == 4            # members, not launches
+    # per-member service: 0.4s/4 -> ewma = 0.2*0.1 + 0.8*prior(<=0.05ish)
+    # vs the regression's 0.2*0.4 + ... >= 0.105
+    assert q.stats()["ewma_service_s"] < 0.1, q.stats()
+    h = telemetry.REGISTRY.histogram("simon_queue_coalesce_members")
+    count, total = h.child_stats()
+    assert count >= 1 and total >= 4         # one launch of 4 members
+
+
+def test_group_pop_only_merges_same_key():
+    """Different keys never share a launch; None keys never group."""
+    q = lifecycle.AdmissionQueue(depth=16)
+    release = threading.Event()
+    launches = []
+
+    def group_fn(jobs):
+        launches.append(sorted(j.label for j in jobs))
+        for j in jobs:
+            j.result = "ok"
+
+    b = q.submit(lambda: release.wait(10.0), label="blocker")
+    jobs = [
+        q.submit(None, label="a1", group_key="A", group_fn=group_fn),
+        q.submit(None, label="a2", group_key="A", group_fn=group_fn),
+        q.submit(None, label="b1", group_key="B", group_fn=group_fn),
+        q.submit(None, label="n1", group_key=None, group_fn=group_fn),
+        q.submit(None, label="a3", group_key="A", group_fn=group_fn),
+    ]
+    release.set()
+    for j in [b] + jobs:
+        assert j.wait(10.0)
+    assert ["a1", "a2", "a3"] in launches    # one merged A launch
+    assert ["b1"] in launches and ["n1"] in launches
+    assert len(launches) == 3
+
+
+def test_crashed_worker_replaced_without_losing_jobs():
+    """A crash of the worker LOOP (not a job) spawns a replacement that
+    drains the jobs already queued."""
+    q = lifecycle.AdmissionQueue(depth=16)
+
+    def boom():
+        raise MemoryError("injected worker crash")
+
+    # prime the worker so the crash hits an already-running loop with
+    # jobs waiting behind it
+    first = q.submit(lambda: "warm", label="warm")
+    assert first.wait(10.0) and first.result == "warm"
+    q._fault_hook = boom
+    jobs = [q.submit(lambda i=i: i, label=f"j{i}") for i in range(3)]
+    for i, j in enumerate(jobs):
+        assert j.wait(10.0), "queued job lost to the worker crash"
+        assert j.error is None and j.result == i
+    assert q.stats()["workers"] == 1         # the corpse was replaced
+
+
+def test_multi_worker_short_jobs_pass_long_ones():
+    """--workers 2: a deadline-sensitive singleton is not starved by a
+    long-running job occupying the other worker."""
+    q = lifecycle.AdmissionQueue(depth=16, workers=2)
+    release = threading.Event()
+    order = []
+    long_job = q.submit(
+        lambda: (release.wait(10.0), order.append("long"))[1],
+        label="long")
+    time.sleep(0.05)
+    short = q.submit(lambda: order.append("short"), label="short")
+    assert short.wait(5.0), "short job starved behind the long one"
+    assert order == ["short"]
+    release.set()
+    assert long_job.wait(5.0)
+    assert q.stats()["workers"] == 2
+
+
+def test_drain_drops_resident_snapshots():
+    srv, httpd, url = _mini_server()
+    try:
+        s, out = _post(url + "/api/simulate",
+                       {"cluster": {"yaml": CLUSTER_YAML}})
+        assert s == 200
+        assert srv._snapshots.stats()["entries"] == 1
+        info = srv.begin_drain()
+        assert info["draining"] is True
+        assert srv._snapshots.stats()["entries"] == 0
+        assert telemetry.gauge("simon_resident_bytes").value() == 0
+        s2, body = _post(url + "/api/simulate",
+                         {"base": out["snapshot_digest"]})
+        assert s2 == 503 and body["code"] == "E_BUSY"
+    finally:
+        httpd.shutdown()
+
+
+# ---- capacity-specific serving paths -------------------------------------
+
+
+def test_capacity_base_respects_encoded_slots(box):
+    """A base digest encoded with 2 template slots serves capacity
+    questions up to 2; asking for more is a structured 400 naming the
+    re-POST remedy."""
+    _, url = box
+    body = {"cluster": {"yaml": CLUSTER_YAML},
+            "new_node": {"spec_yaml": NODE_SPEC_YAML}, "max_new_nodes": 2}
+    s, out = _post(url + "/api/capacity", {**body,
+                                           "sweep_mode": "exhaustive"})
+    assert s == 200, out
+    assert out["counts"] == [0, 1, 2]
+    assert len(out["lane_digests"]) == 3
+    s2, more = _post(url + "/api/capacity",
+                     {"base": out["snapshot_digest"], "max_new_nodes": 5,
+                      "sweep_mode": "exhaustive"})
+    assert s2 == 400 and more["field"] == "max_new_nodes"
+    s3, same = _post(url + "/api/capacity",
+                     {"base": out["snapshot_digest"],
+                      "sweep_mode": "exhaustive"})
+    assert s3 == 200
+    assert same["digest"] == out["digest"]   # resident replay, same sweep
+
+
+def test_capacity_delta_requires_exhaustive(box, base_digest):
+    _, url = box
+    s, out = _post(url + "/api/capacity",
+                   {"base": base_digest,
+                    "delta": {"remove_nodes": ["s1"]}})
+    assert s == 400 and out["field"] == "sweep_mode"
+
+
+def test_pod_delta_runs_singleton_but_reuses_executable(box, base_digest):
+    """A forced-column overlay (pod delta) must NOT coalesce with base
+    probes (different data question) — but it reuses the same cached
+    executable: zero new compiles after the base probe warmed it."""
+    srv, url = box
+    s, warm = _post(url + "/api/simulate", {"base": base_digest})
+    assert s == 200
+    misses0 = telemetry.counter("simon_compile_cache_total", labelnames=("fn", "event")).value(
+        fn="serving_lanes", event="miss")
+    s2, out = _post(url + "/api/simulate",
+                    {"base": base_digest,
+                     "delta": {"remove_pods": ["default/existing-1"]}})
+    assert s2 == 200, out
+    assert out["coalesced_members"] == 1
+    misses1 = telemetry.counter("simon_compile_cache_total", labelnames=("fn", "event")).value(
+        fn="serving_lanes", event="miss")
+    assert misses1 == misses0, "pod-delta overlay recompiled"
+
+
+# ---- review-hardening regressions ----------------------------------------
+
+
+PINNED_POD_YAML = textwrap.dedent("""
+    apiVersion: v1
+    kind: Pod
+    metadata: {name: pinned-0, namespace: default, labels: {app: pinned}}
+    spec:
+      nodeName: s2
+      containers:
+        - name: c
+          image: registry.local/p:1
+          resources: {requests: {cpu: "1", memory: 1Gi}}
+""")
+
+
+def test_delta_remove_pinned_node_audits_clean(box):
+    """Removing a node a pod is BOUND to, with audit:true, must 200:
+    the auditor gets the overlay forced column (pin rewritten to
+    NODE_GONE -> free), not the base pin — auditing against the base
+    would flag the valid delta itself as a forced-bind violation."""
+    _, url = box
+    yaml_text = CLUSTER_YAML + "\n---\n" + PINNED_POD_YAML
+    s, out = _post(url + "/api/simulate", {"cluster": {"yaml": yaml_text}})
+    assert s == 200, out
+    s1, hot = _post(url + "/api/simulate",
+                    {"base": out["snapshot_digest"],
+                     "delta": {"remove_nodes": ["s2"]}, "audit": True})
+    assert s1 == 200, hot
+    # and the overlay still digests like a cold re-encode of the shrunk
+    # cluster (the pinned pod keeps nodeName: s2 -> "node not found")
+    cold_yaml = "\n---\n".join(
+        doc for doc in yaml_text.split("---")
+        if not ("kind: Node" in doc and "name: s2" in doc))
+    s2c, cold = _post(url + "/api/simulate", {"cluster": {"yaml": cold_yaml}})
+    assert s2c == 200, cold
+    assert hot["digest"] == cold["digest"]
+    assert hot["placed"] == cold["placed"]
+
+
+def test_rejected_fullbody_delta_never_admits():
+    """A full-body request whose delta is rejected must not admit its
+    snapshot: admission after a 400 would churn another client's entry
+    out of the bounded LRU table."""
+    srv, httpd, url = _mini_server()
+    try:
+        s, _ = _post(url + "/api/simulate", {"cluster": {"yaml": CLUSTER_YAML}})
+        assert s == 200
+        before = _cache_state(srv)
+        smaller = CLUSTER_YAML.replace("replicas: 4", "replicas: 2")
+        s1, body = _post(url + "/api/simulate",
+                         {"cluster": {"yaml": smaller},
+                          "delta": {"remove_nodes": ["ghost"]}})
+        assert s1 == 400 and body["field"] == "delta.remove_nodes"
+        assert _cache_state(srv) == before
+        # full-body bisect + delta rejects before resolving, too
+        s2, body2 = _post(url + "/api/capacity",
+                          {"cluster": {"yaml": smaller},
+                           "new_node": {"spec_yaml": NODE_SPEC_YAML},
+                           "delta": {"add_nodes": 1}})
+        assert s2 == 400 and body2["field"] == "sweep_mode"
+        assert _cache_state(srv) == before
+    finally:
+        httpd.shutdown()
+
+
+class _FakeJob:
+    """The slice of lifecycle.Job the group executor reads."""
+
+    def __init__(self, payload):
+        self.payload = payload
+        self.token = None
+        self.result = None
+        self.error = None
+
+
+def test_lane_bucketing_bounds_compiles(box, base_digest):
+    """Coalesced group sizes vary with queue timing; the launch pads the
+    lane axis to a power-of-two bucket so a 3-member and a 4-member
+    group share ONE executable instead of compiling per size."""
+    srv, _ = box
+    cc = telemetry.counter("simon_compile_cache_total",
+                           labelnames=("fn", "event"))
+
+    def group(n):
+        return [_FakeJob(serving.prepare_simulate(srv, {"base": base_digest}))
+                for _ in range(n)]
+
+    g3 = group(3)
+    serving.execute_group(g3)                  # buckets to 4 lanes
+    m0 = cc.value(fn="serving_lanes", event="miss")
+    g4 = group(4)
+    serving.execute_group(g4)                  # same bucket: cache hit
+    m1 = cc.value(fn="serving_lanes", event="miss")
+    assert m1 == m0, "group sizes 3 and 4 compiled separately"
+    assert all(j.result[0] == 200 for j in g3 + g4)
+    digests = {j.result[1]["digest"] for j in g3 + g4}
+    assert len(digests) == 1                   # filler lanes never decoded
+
+
+def test_launch_failure_answers_structured(box, base_digest):
+    """A SimulationError out of the whole launch (retries exhausted,
+    rehydration failure) must reach every member as its STRUCTURED
+    code/status, not an opaque 500."""
+    srv, url = box
+    real = serving.ResidentSnapshotCache.device_arrays
+
+    def boom(self, entry):
+        raise SimulationError("injected transfer failure",
+                              code="E_TIMEOUT", ref="test",
+                              hint="try again")
+
+    serving.ResidentSnapshotCache.device_arrays = boom
+    try:
+        s, body = _post(url + "/api/simulate", {"base": base_digest})
+    finally:
+        serving.ResidentSnapshotCache.device_arrays = real
+    assert s == 504, body
+    assert body["code"] == "E_TIMEOUT" and body["hint"] == "try again"

@@ -144,6 +144,15 @@ if [ "$rc" -ne 0 ]; then
 fi
 
 echo
+echo "== inference serving (resident snapshot, delta == cold re-encode, poisoned lane, drain) =="
+make serve-smoke
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: serve-smoke exited $rc" >&2
+  exit "$rc"
+fi
+
+echo
 echo "== serving lifecycle (SIGTERM drain: readyz flip, 503s, in-flight finishes) =="
 make lifecycle-smoke
 rc=$?
