@@ -3,7 +3,8 @@
 # scenario end to end (tools/smoke.sh).
 
 .PHONY: test lint smoke bench bench-smoke bench-regress lifecycle-smoke \
-	multichip-smoke campaign-smoke replay-smoke session-smoke serve-smoke
+	multichip-smoke campaign-smoke replay-smoke session-smoke serve-smoke \
+	tune-smoke
 
 test:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -76,6 +77,14 @@ session-smoke:
 # gets its own 504); SIGTERM drain finishes the in-flight probe, exits 0
 serve-smoke:
 	env JAX_PLATFORMS=cpu python tools/serve_smoke.py
+
+# policy-search gate (tune/): a real server must answer a grid round's
+# Pareto set over (unplaced, cost, disruption), reproduce a seeded cem
+# digest, turn a lapsed deadline into a structured 504 and a bogus
+# weight into a 400, and run a 6-cluster 2-bucket fleet campaign in 2
+# launches (the fleet-lane witness: launches < clusters)
+tune-smoke:
+	env JAX_PLATFORMS=cpu python tools/tune_smoke.py
 
 # regression gate over the run ledger (SIMON_LEDGER_DIR or
 # BENCH_LEDGER_DIR=... make bench-regress): the newest bench record per

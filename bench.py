@@ -223,6 +223,12 @@ PRESETS = {
     # placement digest tagged so a regression in EITHER throughput or
     # determinism shows in the tracked line
     "serve": dict(nodes=12, requests=96, clients=6),
+    # scheduler-policy tuning (tune/): the whole weight-space search as
+    # lanes of ONE executable — variants/sec through the traced-weights
+    # engine at a fixed lane width, Pareto size + point digest tagged so
+    # a regression in EITHER search throughput or determinism shows in
+    # the tracked line
+    "tune": dict(nodes=16, pods=48, variants=8, rounds=8),
 }
 
 
@@ -361,6 +367,42 @@ def run_session_bench(n_sessions: int, n_nodes: int, n_batches: int,
     assert all(s.digest == sessions[0].digest for s in sessions), (
         "identical sessions fed identical events diverged")
     return dt, n_events, sessions[0].digest, label
+
+
+def run_tune_bench(n_nodes: int, n_pods: int, variants: int, rounds: int):
+    """Time the policy-search path: one synthetic workload, a seeded cem
+    search of ``variants`` lanes x ``rounds`` rounds through the
+    traced-weights executable (tune/search.py). The warm-up run compiles
+    the single batched program; the timed run measures the
+    compile-once-search-many rate in variants/sec. The Pareto size and
+    the point digest ride the tagged record so a regression in either
+    throughput or determinism shows in the tracked line."""
+    from open_simulator_tpu.replay import synthetic_replay_cluster
+    from open_simulator_tpu.telemetry import ledger
+    from open_simulator_tpu.tune import TuneOptions, tune_search
+
+    cluster = synthetic_replay_cluster(n_nodes=n_nodes,
+                                       n_initial_pods=n_pods)
+
+    def one_run(seed):
+        return tune_search(cluster, [], TuneOptions(
+            mode="cem", variants=variants, rounds=rounds, seed=seed))
+
+    with ledger.run_capture("bench") as lcap:
+        one_run(seed=1)  # warm-up: compiles the lane executable
+        t0 = time.perf_counter()
+        report = one_run(seed=0)
+        dt = time.perf_counter() - t0
+        n_variants = report["n_variants"]
+        label = f"tune{variants}w_x{rounds}r_{n_nodes}n"
+        _bench_gauge().labels(shape=label).set(dt)
+        lcap.tag("preset", "tune")
+        lcap.tag("shape", label)
+        lcap.tag("seconds", round(dt, 6))
+        lcap.tag("value", round(n_variants / dt, 3))
+        lcap.tag("pareto", len(report["pareto"]))
+        lcap.tag("tune_digest", report["digest"])
+    return dt, report, label
 
 
 def run_serve_bench(n_nodes: int, n_requests: int, n_clients: int):
@@ -541,6 +583,27 @@ def main():
             "events": n_events,
             "reuse_ratio": n_events // preset["sessions"],
             "trajectory_digest": digest,
+        }))
+        return
+    if args.preset == "tune":
+        # policy-search bench: variants/sec through the traced-weights
+        # lane executable; the Pareto size and point digest ride along
+        # so a regression in EITHER search throughput or determinism
+        # shows in the tracked line
+        dt, report, label = run_tune_bench(
+            args.nodes or preset["nodes"], args.pods or preset["pods"],
+            preset["variants"], preset["rounds"])
+        print(json.dumps({
+            "metric": f"tune_variants_per_sec@{label}",
+            "value": round(report["n_variants"] / dt, 3),
+            "unit": "variants/s",
+            "vs_baseline": 0.0,
+            "baseline": "none_tune_path",
+            "preset": "tune",
+            "variants": report["n_variants"],
+            "rounds": report["rounds_run"],
+            "pareto_points": len(report["pareto"]),
+            "tune_digest": report["digest"],
         }))
         return
     if args.preset == "serve":

@@ -76,11 +76,13 @@ def plan_migration(cluster: ClusterResources) -> MigrationPlan:
     app.pods = movable
     # Bin-packing profile: MostAllocated replaces LeastAllocated/Balanced so
     # re-placement consolidates instead of spreading (defrag is the point).
+    from open_simulator_tpu.engine.sched_config import MOST_ALLOCATED_OVERRIDES
+
     result = simulate(
         base,
         [AppResource(name="migration", resources=app)],
         use_greed=True,
-        config_overrides={"w_least": 0.0, "w_balanced": 0.0, "w_most": 1.0, "w_spread": 0.0},
+        config_overrides=dict(MOST_ALLOCATED_OVERRIDES),
     )
 
     placements = result.placements()

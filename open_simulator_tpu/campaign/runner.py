@@ -89,6 +89,12 @@ class CampaignOptions:
     checkpoint: Optional[bool] = None  # None = auto (on when a dir exists)
     audit: bool = True               # post-hoc invariant audit per cluster
     config_overrides: Dict[str, Any] = dc_field(default_factory=dict)
+    # fleet lanes (campaign/lanes.py): same-bucket clusters execute as
+    # lanes of ONE launch instead of one serial dispatch each; per-lane
+    # quarantine semantics are unchanged (bit-identical rows, asserted
+    # in tier-1). False restores the pure serial boundary.
+    fleet_lanes: bool = True
+    lane_width: int = 8              # clusters per batched launch
 
 
 # ---- journal -------------------------------------------------------------
@@ -328,6 +334,42 @@ def _top_rejects(result) -> List[List[Any]]:
     return pairs[:TOP_OPS]
 
 
+def cluster_row(entry: ClusterEntry, result, audit) -> Dict[str, Any]:
+    """The per-cluster report/journal row — ONE definition shared by the
+    serial boundary and the fleet-lane path, so both produce
+    byte-identical journal lines and report digests."""
+    from open_simulator_tpu.engine.exec_cache import bucket_shape
+
+    snap = result.snapshot
+    n, p = bucket_shape(snap.n_nodes, snap.n_pods)
+    return {
+        "cluster": entry.name,
+        "source": entry.digest,
+        "n_nodes": int(snap.n_real_nodes),
+        "n_pods": int(snap.n_pods),
+        "placed": len(result.scheduled_pods),
+        "unplaced": len(result.unscheduled_pods),
+        "cpu_pct": float(audit.cpu_pct),
+        "mem_pct": float(audit.mem_pct),
+        "bucket": [int(n), int(p)],
+        "top_rejects": _top_rejects(result),
+        "audit_ok": bool(audit.ok),
+    }
+
+
+def quarantine_row(entry: ClusterEntry, err: Dict[str, Any],
+                   attempts: int = 1) -> Dict[str, Any]:
+    """The quarantine record — shared shape between the serial boundary
+    and the fleet-lane path (per-lane quarantine semantics unchanged)."""
+    return {
+        "cluster": entry.name,
+        "source": entry.digest,
+        "error": err,
+        "attempts": int(attempts),
+        "transient_retries": max(0, int(attempts) - 1),
+    }
+
+
 def _run_one(entry: ClusterEntry, apps, opts: CampaignOptions,
              campaign_id: str) -> Tuple[str, Dict[str, Any],
                                         Dict[str, str]]:
@@ -337,7 +379,6 @@ def _run_one(entry: ClusterEntry, apps, opts: CampaignOptions,
     ("quarantine", quarantine_row, {}) on a final failure — this function
     never raises for per-cluster trouble (cancellation excepted: a
     CancelledError must stop the campaign, not quarantine a cluster)."""
-    from open_simulator_tpu.engine.exec_cache import bucket_shape
     from open_simulator_tpu.engine.scheduler import make_config
     from open_simulator_tpu.telemetry import ledger
 
@@ -369,21 +410,7 @@ def _run_one(entry: ClusterEntry, apps, opts: CampaignOptions,
         audit = audit_result(result)
         if opts.audit and not audit.ok:
             raise AuditError(audit, ref=f"cluster/{entry.name}")
-        snap = result.snapshot
-        n, p = bucket_shape(snap.n_nodes, snap.n_pods)
-        row = {
-            "cluster": entry.name,
-            "source": entry.digest,
-            "n_nodes": int(snap.n_real_nodes),
-            "n_pods": int(snap.n_pods),
-            "placed": len(result.scheduled_pods),
-            "unplaced": len(result.unscheduled_pods),
-            "cpu_pct": float(audit.cpu_pct),
-            "mem_pct": float(audit.mem_pct),
-            "bucket": [int(n), int(p)],
-            "top_rejects": _top_rejects(result),
-            "audit_ok": bool(audit.ok),
-        }
+        row = cluster_row(entry, result, audit)
         fingerprint = {"source": entry.digest,
                        "engine": ledger.engine_config_hash(cfg)}
         return row, fingerprint
@@ -409,13 +436,7 @@ def _run_one(entry: ClusterEntry, apps, opts: CampaignOptions,
     _log.warning("campaign %s: cluster %s quarantined [%s] after %d "
                  "attempt(s): %s", campaign_id, entry.name,
                  err.get("code"), attempts["n"], err.get("message"))
-    return "quarantine", {
-        "cluster": entry.name,
-        "source": entry.digest,
-        "error": err,
-        "attempts": int(attempts["n"]),
-        "transient_retries": max(0, int(attempts["n"]) - 1),
-    }, {}
+    return "quarantine", quarantine_row(entry, err, attempts["n"]), {}
 
 
 # ---- campaign ------------------------------------------------------------
@@ -471,14 +492,8 @@ def run_campaign(opts: CampaignOptions,
                 "clusters_total": len(entries),
                 "quarantined": sorted(q["cluster"] for q in quars)}
 
-    for entry in entries:
-        if entry.name in settled:
-            continue  # replayed from the journal: never re-run
-        # deadline/drain boundary: a cancelled campaign stops BETWEEN
-        # clusters with its journal intact (resume picks it back up)
-        lifecycle.check_current("campaign cluster boundary",
-                                partial=_partial)
-        kind, row, fingerprint = _run_one(entry, apps, opts, campaign_id)
+    def _settle(entry: ClusterEntry, kind: str, row: Dict[str, Any],
+                fingerprint: Dict[str, str]) -> None:
         if kind == "cluster":
             rows.append(row)
             if journal is not None:
@@ -488,9 +503,38 @@ def run_campaign(opts: CampaignOptions,
             if journal is not None:
                 journal.append_quarantine(entry.name, row)
 
+    pending = [e for e in entries if e.name not in settled]
+    launches = 0
+    if opts.fleet_lanes:
+        # fleet lanes (§13 bucket map cashed in): same-bucket clusters
+        # pack as lanes of one launch; everything the lane path cannot
+        # prove equivalent falls back to the serial boundary below
+        from open_simulator_tpu.campaign import lanes as fleet
+
+        launches = fleet.run_fleet(pending, apps, opts, campaign_id,
+                                   _settle, _partial)
+    else:
+        for entry in pending:
+            # deadline/drain boundary: a cancelled campaign stops BETWEEN
+            # clusters with its journal intact (resume picks it back up)
+            lifecycle.check_current("campaign cluster boundary",
+                                    partial=_partial)
+            kind, row, fingerprint = _run_one(entry, apps, opts,
+                                              campaign_id)
+            _settle(entry, kind, row, fingerprint)
+            launches += 1
+
     report = build_report(campaign_id, rows, quars,
                           wall_s=time.perf_counter() - t0,
                           resumed_clusters=resumed)
+    # the fleet-lane witness: DISPATCH BOUNDARIES this process paid —
+    # one per serial-boundary cluster (whatever retries happened inside
+    # it, and even if it failed before reaching the device), one per
+    # batched chunk. Same-bucket fleets batch, so launches < clusters is
+    # the witness; this is NOT a device-execution count. OUTSIDE the
+    # digested core, like wall_s — resumed runs replay rows without
+    # launching.
+    report["launches"] = int(launches)
     if journal is not None and journal.done is None:
         journal.finish(report["digest"], len(rows), len(quars))
     # one campaign-summary line in the run ledger (beside the per-cluster

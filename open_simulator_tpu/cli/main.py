@@ -460,6 +460,71 @@ def build_parser() -> argparse.ArgumentParser:
     sn_cl = sn_sub.add_parser("close", help="close a session")
     sn_cl.add_argument("session", metavar="SESSION_ID")
 
+    tn = sub.add_parser(
+        "tune",
+        help="scheduler-policy search on the lane axis: Pareto set over "
+             "score-plugin weight vectors",
+        description="Search the Score-plugin weight space (the "
+                    "KubeSchedulerConfiguration v1beta2 weight table) "
+                    "over ONE workload, executed as lanes of one AOT "
+                    "executable: the traced-weights engine mode turns "
+                    "the K weights into a traced [K] input, so W policy "
+                    "variants batch as a [W, K] lane matrix with zero "
+                    "recompiles across rounds. Each variant is scored "
+                    "on (unplaced, cost, disruption) — all minimized, "
+                    "disruption measured against the baseline vector's "
+                    "placements — and the report is the Pareto set "
+                    "under the frontier's dominance rule. "
+                    "ARCHITECTURE.md §17.")
+    tn.add_argument("--cluster-config", required=True,
+                    help="cluster YAML dir (the workload's initial state)")
+    tn.add_argument("--apps", default="", metavar="DIR",
+                    help="optional workload apps (manifest dir) deployed "
+                         "on top of the cluster's own pods")
+    tn.add_argument("--mode", choices=("grid", "cem"), default="grid",
+                    help="grid: coordinate grid around the baseline "
+                         "(deterministic, exhaustive over its grid); "
+                         "cem: cross-entropy-style mutation/selection "
+                         "rounds (seeded, deterministic)")
+    tn.add_argument("--variants", type=int, default=8,
+                    help="policy lanes per device round (W)")
+    tn.add_argument("--rounds", type=int, default=0,
+                    help="cem generations (0 = 4); for grid, a cap on "
+                         "the rounds (0 = the whole grid; a capped grid "
+                         "reports grid_truncated)")
+    tn.add_argument("--seed", type=int, default=0,
+                    help="cem sampling seed")
+    tn.add_argument("--grid-values", default="", metavar="V,V,...",
+                    help="comma list of grid weight values "
+                         "(default 0,0.5,1,2,4)")
+    tn.add_argument("--elite-frac", type=float, default=0.25,
+                    help="cem selection fraction")
+    tn.add_argument("--sigma", type=float, default=0.75,
+                    help="cem initial mutation scale")
+    tn.add_argument("--max-weight", type=float, default=8.0,
+                    help="weight-space clip ceiling")
+    tn.add_argument("--scheduler-config", default="", metavar="FILE",
+                    help="KubeSchedulerConfiguration file: its score "
+                         "weights become the search center and the "
+                         "disruption baseline; filter disables apply as "
+                         "static engine gates")
+    tn.add_argument("--json", action="store_true",
+                    help="emit the full report (points, Pareto set) as "
+                         "JSON")
+    tn.add_argument("--output-file", default="")
+    tn.add_argument("--ledger-dir", default="",
+                    help="run-ledger directory: one RunRecord per tune "
+                         "round + a summary event (also honors "
+                         "SIMON_LEDGER_DIR)")
+    tn.add_argument("--compile-cache-dir", default="",
+                    help="opt-in jax persistent compilation cache")
+    tn.add_argument("--no-waves", action="store_true",
+                    help="accepted for symmetry: tune rounds run the "
+                         "batched scan (no wave plans apply)")
+    tn.add_argument("--trace-out", default="",
+                    help="write a Chrome-trace JSON timeline of the "
+                         "search's phases")
+
     mg = sub.add_parser("migrate", help="plan a defragmentation migration of placed pods")
     mg.add_argument("--cluster-config", required=True, help="cluster YAML dir (with placed pods)")
     mg.add_argument("--output-file", default="")
@@ -718,6 +783,58 @@ def _replay_main(args) -> int:
         return 1
 
 
+def _tune_main(args) -> int:
+    """simon-tpu tune: scheduler-policy search (tune/search.py). Every
+    malformed knob or scheduler-config is a structured `error:` exit
+    (the same E_SPEC/E_BAD_REQUEST taxonomy the REST surface maps to
+    400), never a traceback."""
+    import json as _json
+
+    from open_simulator_tpu.k8s.loader import load_resources_from_directory
+
+    if args.compile_cache_dir:
+        from open_simulator_tpu.engine.exec_cache import (
+            enable_persistent_cache,
+        )
+
+        enable_persistent_cache(args.compile_cache_dir)
+    body = {"mode": args.mode, "variants": args.variants,
+            "rounds": args.rounds, "seed": args.seed,
+            "elite_frac": args.elite_frac, "sigma": args.sigma,
+            "max_weight": args.max_weight}
+    if args.grid_values:
+        body["grid_values"] = [v.strip()
+                               for v in args.grid_values.split(",")
+                               if v.strip()]
+    try:
+        if args.scheduler_config:
+            with open(args.scheduler_config, "r", encoding="utf-8") as f:
+                body["scheduler_config"] = f.read()
+        with _trace_capture(args.trace_out):
+            from open_simulator_tpu.tune import (
+                TuneOptions,
+                format_tune,
+                tune_search,
+            )
+
+            opts = TuneOptions.from_body(body)
+            cluster = load_resources_from_directory(args.cluster_config)
+            apps = []
+            if args.apps:
+                from open_simulator_tpu.core import AppResource
+
+                apps = [AppResource(
+                    name="tune",
+                    resources=load_resources_from_directory(args.apps))]
+            report = tune_search(cluster, apps, opts)
+        _emit(_json.dumps(report, indent=2) if args.json
+              else format_tune(report), args.output_file)
+        return 0
+    except (SimulationError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
 def _session_main(args) -> int:
     """simon-tpu session {create, list, status, events, fork, close}:
     the digital-twin client — thin HTTP over the server's /api/session
@@ -842,6 +959,9 @@ def main(argv=None) -> int:
 
     if args.command == "session":
         return _session_main(args)
+
+    if args.command == "tune":
+        return _tune_main(args)
 
     if args.command == "lint":
         # analysis/ is pure-AST stdlib: linting never imports jax or the

@@ -331,7 +331,8 @@ def _zeros_carry_batch(arrs, cfg, lanes: int):
 
 
 def run_batched_cached(arrs, masks, cfg, carry=None,
-                       fn_name: str = "batched_schedule", waves=None):
+                       fn_name: str = "batched_schedule", waves=None,
+                       weights=None):
     """Run the vmapped scan over scenario lanes through the AOT cache.
 
     `masks` is the [S, N] per-lane active matrix. `carry` is an optional
@@ -342,34 +343,138 @@ def run_batched_cached(arrs, masks, cfg, carry=None,
     same either way). `waves` is an optional static WavePlan
     (engine/waves.py): it joins the cache key — wave count/width are part
     of the compiled program — so same-plan reruns stay zero-recompile
-    and a plan change never aliases a stale executable."""
+    and a plan change never aliases a stale executable.
+
+    `weights` is the per-lane [S, K] traced score-weight matrix
+    (scheduler.WEIGHT_FIELDS order) under ``cfg.traced_weights`` — the
+    tune subsystem's lane axis: W policy variants share THIS one
+    executable. Omitted under a traced config, every lane runs the
+    config's own ``weight_vector`` (so the capacity sweeps work
+    unchanged under a traced config, digest-identical to constant mode);
+    passing weights with ``traced_weights`` off is an error."""
     import jax
     import jax.numpy as jnp
 
-    from open_simulator_tpu.engine.scheduler import schedule_pods
+    from open_simulator_tpu.engine.scheduler import (
+        WEIGHT_FIELDS,
+        schedule_pods,
+        weight_vector,
+    )
 
     masks = jnp.asarray(masks)
     lanes = int(masks.shape[0])
+    if cfg.traced_weights and weights is None:
+        weights = np.tile(weight_vector(cfg), (lanes, 1))
+    if weights is not None:
+        if not cfg.traced_weights:
+            raise ValueError(
+                "per-lane weights need cfg.traced_weights (the constant "
+                "engine bakes its weights into the executable)")
+        weights = jnp.asarray(weights, jnp.float32)
+        if weights.shape != (lanes, len(WEIGHT_FIELDS)):
+            raise ValueError(
+                f"weights must be [{lanes}, {len(WEIGHT_FIELDS)}] "
+                f"(lanes x WEIGHT_FIELDS), got {tuple(weights.shape)}")
     if carry is None:
         carry = _zeros_carry_batch(arrs, cfg, lanes)
     key = (fn_name, cfg, _shape_sig(arrs), (lanes,) + tuple(masks.shape[1:]),
            str(masks.dtype), waves,
+           None if weights is None else tuple(weights.shape),
            tuple(str(d) for d in jax.devices()))
 
     def build():
-        def fn(a, m, c):
-            def lane(mask_row, carry_row):
+        if weights is None:
+            def fn(a, m, c):
+                def lane(mask_row, carry_row):
+                    return schedule_pods(a, mask_row, cfg,
+                                         state=_fresh_lane_state(carry_row, a),
+                                         state_is_fresh=True, waves=waves)
+
+                return jax.vmap(lane)(m, c)
+
+            return jax.jit(fn, donate_argnums=(2,)).lower(
+                arrs, masks, carry).compile()
+
+        def fnw(a, m, c, w):
+            def lane(mask_row, carry_row, w_row):
                 return schedule_pods(a, mask_row, cfg,
                                      state=_fresh_lane_state(carry_row, a),
-                                     state_is_fresh=True, waves=waves)
+                                     state_is_fresh=True, waves=waves,
+                                     weights=w_row)
 
-            return jax.vmap(lane)(m, c)
+            return jax.vmap(lane)(m, c, w)
 
-        return jax.jit(fn, donate_argnums=(2,)).lower(
-            arrs, masks, carry).compile()
+        return jax.jit(fnw, donate_argnums=(2,)).lower(
+            arrs, masks, carry, weights).compile()
 
     compiled = EXEC_CACHE.get_or_compile(key, fn_name, build)
-    return compiled(arrs, masks, carry)
+    if weights is None:
+        return compiled(arrs, masks, carry)
+    return compiled(arrs, masks, carry, weights)
+
+
+def stack_fleet_arrays(arrs_list):
+    """Stack same-shape SnapshotArrays along a NEW leading lane axis —
+    the fleet-lane batch (campaign/lanes.py). Every field must already
+    agree in shape (same node/pod bucket AND the same vocab widths);
+    callers group by the full `_shape_sig` before stacking."""
+    first = arrs_list[0]
+    sig = _shape_sig(first)
+    for a in arrs_list[1:]:
+        if _shape_sig(a) != sig:
+            raise ValueError(
+                "fleet lanes need shape-identical snapshots; group by "
+                "the full shape signature before stacking")
+    out = {}
+    for f in dataclasses.fields(first):
+        out[f.name] = np.stack(
+            [np.asarray(getattr(a, f.name)) for a in arrs_list])
+    return type(first)(**out)
+
+
+def run_fleet_batched(arrs_batch, masks, cfg,
+                      fn_name: str = "fleet_schedule"):
+    """Run schedule_pods vmapped over PER-LANE SnapshotArrays: same-bucket
+    fleet clusters (the §13 bucket-map witness) execute as lanes of ONE
+    launch instead of one dispatch each. Where the scenario sweep
+    lane-varies only the active mask, here the WHOLE snapshot batch is
+    the vmapped input — `arrs_batch` is a SnapshotArrays whose every
+    field carries a leading lane axis (stack_fleet_arrays), `masks` is
+    the per-lane [S, N] active matrix. Each lane's outputs are
+    bit-identical to running that cluster alone (the vmap adds no
+    cross-lane ops; asserted in test_tune.py). Cached like every other
+    executable; the key is the batch's own shape signature + cfg."""
+    import jax
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.engine.scheduler import init_state, schedule_pods
+
+    arrs_batch = jax.tree_util.tree_map(jnp.asarray, arrs_batch)
+    masks = jnp.asarray(masks)
+    lanes = int(masks.shape[0])
+    proto = init_state(
+        jax.tree_util.tree_map(lambda x: x[0], arrs_batch), cfg)
+    carry = jax.tree_util.tree_map(
+        lambda x: jnp.zeros((lanes,) + x.shape, x.dtype), proto)
+    key = (fn_name, cfg, _shape_sig(arrs_batch),
+           (lanes,) + tuple(masks.shape[1:]), str(masks.dtype),
+           tuple(str(d) for d in jax.devices()))
+
+    def build():
+        def fn(ab, m, c):
+            def lane(a_row, mask_row, carry_row):
+                return schedule_pods(
+                    a_row, mask_row, cfg,
+                    state=_fresh_lane_state(carry_row, a_row),
+                    state_is_fresh=True)
+
+            return jax.vmap(lane)(ab, m, c)
+
+        return jax.jit(fn, donate_argnums=(2,)).lower(
+            arrs_batch, masks, carry).compile()
+
+    compiled = EXEC_CACHE.get_or_compile(key, fn_name, build)
+    return compiled(arrs_batch, masks, carry)
 
 
 # ---- persistent compilation cache --------------------------------------

@@ -30,6 +30,24 @@ from open_simulator_tpu.encode.snapshot import (
 )
 from open_simulator_tpu.ops import filters, gpu_share, scores, storage
 
+# The K score-plugin weights, in the order the traced-weight vector
+# (EngineConfig.traced_weights) threads them through the step — the
+# v1beta2 plugin weight table as one [K] axis (SURVEY §L2/§L3a), which is
+# what lets the tune subsystem batch POLICY variants as lanes of one
+# executable (tune/search.py).
+WEIGHT_FIELDS: Tuple[str, ...] = (
+    "w_balanced", "w_least", "w_most", "w_node_aff", "w_taint",
+    "w_interpod", "w_spread", "w_simon", "w_gpu")
+
+
+def weight_vector(cfg: "EngineConfig") -> np.ndarray:
+    """The config's own weights as the [K] f32 vector the traced-weights
+    mode consumes (WEIGHT_FIELDS order). Contract: a traced run at this
+    vector is ledger-digest-identical to the constant-weight run of the
+    same config (tested across the workload matrix in test_tune.py)."""
+    return np.asarray([getattr(cfg, f) for f in WEIGHT_FIELDS],
+                      dtype=np.float32)
+
 
 class EngineConfig(NamedTuple):
     """Static (hashable) engine configuration — the analog of the
@@ -160,6 +178,16 @@ class EngineConfig(NamedTuple):
     # escape hatch (make_config folds it in here so the ledger
     # fingerprint records which mode ran).
     wave_scheduling: bool = True
+    # Traced score weights (tune/): the K WEIGHT_FIELDS become a traced
+    # [K] input of the step instead of compile-time constants, so W
+    # policy variants run as lanes of ONE executable. Enable flags stay
+    # static; no branch ever reads a traced weight (every weight-gated
+    # score row is kept live and a zero weight contributes an exact
+    # +0.0) — at the config's own weight_vector() the traced path is
+    # ledger-digest-identical to the constant path. The flag is part of
+    # the EngineConfig, so it joins the exec-cache key and the ledger
+    # fingerprint: tuned and constant runs never share an executable.
+    traced_weights: bool = False
 
     @property
     def enable_spread(self) -> bool:
@@ -550,26 +578,27 @@ def _const_outputs(arrs: SnapshotArrays, cfg: EngineConfig,
             jnp.zeros((c, c_parts, k_top), jnp.float32))
 
 
-def _grid_step(arrs, active, cfg, hoisted, inv_alloc, gcr_seg, state, xw):
+def _grid_step(arrs, active, cfg, hoisted, inv_alloc, gcr_seg, wvec, state,
+               xw):
     """One macro-step of a GRID segment: batched filter+score for the
     whole wave against the wave-start carry, then one merged bind."""
     step = functools.partial(_step, arrs, active, cfg, hoisted, inv_alloc,
-                             gcr_seg)
+                             gcr_seg, wvec)
     ys = jax.vmap(lambda xx: step(state, xx)[1])(xw)
     new_state = _wave_merge(arrs, cfg, state, xw, ys[0],
                             ys[3] if cfg.enable_gpu else None)
     return new_state, ys
 
 
-def _run_wave_plan(arrs, active, cfg, hoisted, inv_alloc, gcr_seg, state,
-                   xs, waves, k):
+def _run_wave_plan(arrs, active, cfg, hoisted, inv_alloc, gcr_seg, wvec,
+                   state, xs, waves, k):
     """Execute a WavePlan: scan segments ride the unchanged sequential
     step; batched segments evaluate their pods against the wave-start
     state (provably equal to scan order) and merge their claims once."""
     from open_simulator_tpu.engine import waves as wave_mod
 
     step = functools.partial(_step, arrs, active, cfg, hoisted, inv_alloc,
-                             gcr_seg)
+                             gcr_seg, wvec)
     outs = []
     for lo, hi, kind, w in waves.segments:
         a0, a1 = lo - k, hi - k
@@ -589,7 +618,7 @@ def _run_wave_plan(arrs, active, cfg, hoisted, inv_alloc, gcr_seg, state,
                                     sub["forced_node"], None)
         elif kind == wave_mod.GRID:
             gstep = functools.partial(_grid_step, arrs, active, cfg,
-                                      hoisted, inv_alloc, gcr_seg)
+                                      hoisted, inv_alloc, gcr_seg, wvec)
             xg = {name: v.reshape((c // w, w) + v.shape[1:])
                   for name, v in xseg.items()}
             state, ysg = _scan_xs(gstep, state, xg, 1)
@@ -650,8 +679,10 @@ def _live_xs_names(cfg: EngineConfig, has_disabled: bool,
     live = {"req", "forced_node"}
     if (cfg.enable_class_aff or cfg.enable_class_taint
             or cfg.enable_spread_hard  # hoisted eligibility rows are per-class
-            or (cfg.w_node_aff and cfg.enable_node_aff_score)
-            or (cfg.w_taint and cfg.enable_taint_score)):
+            or ((cfg.w_node_aff or cfg.traced_weights)
+                and cfg.enable_node_aff_score)
+            or ((cfg.w_taint or cfg.traced_weights)
+                and cfg.enable_taint_score)):
         live.add("class_id")
     if cfg.tie_break_seed:
         live.add("_pod_index")
@@ -712,10 +743,11 @@ def _gcr_segments(cfg: EngineConfig, arrs: SnapshotArrays) -> "dict | None":
 
 
 def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
-          hoisted, inv_alloc, gcr_seg, state: SimState, x):
+          hoisted, inv_alloc, gcr_seg, wvec, state: SimState, x):
     # graftlint: static=cfg,gcr_seg (hashable EngineConfig + host dict of
     # int column segments — Python control flow on them is gate selection,
-    # not a trace-time host sync)
+    # not a trace-time host sync; wvec is the TRACED [K] weight vector and
+    # is only ever multiplied, never branched on)
     n_nodes = arrs.alloc.shape[0]
     f32 = jnp.float32
     true_v = jnp.ones((n_nodes,), dtype=bool)  # identity-compared below
@@ -1059,9 +1091,37 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
             part_rows.append(row)
         return row
 
+    # ---- weight resolution --------------------------------------------
+    # Constant mode: the EngineConfig floats are baked into the trace
+    # (XLA folds them) and a zero weight compiles its plugin out. Traced
+    # mode (cfg.traced_weights): the K weights ride the wvec [K] input in
+    # WEIGHT_FIELDS order, so ONE executable serves every weight variant;
+    # gates stay static (the enable flags plus the traced_weights flag
+    # itself — never a traced value), every weight-gated row stays live,
+    # and a zero traced weight contributes an exact +0.0. At the config's
+    # own weight_vector() both modes are bit-identical: same rows, same
+    # add order, and w*x with the same f32 w is the same multiply.
+    tw = cfg.traced_weights
+    if tw:
+        if wvec is None:  # not assert: must survive python -O
+            raise AssertionError(
+                "cfg.traced_weights is on but no weight vector reached "
+                "_step — schedule_pods and the wave runner disagree")
+        (w_bal, w_lst, w_mst, w_na, w_tt, w_ip, w_sp, w_si, w_gp) = (
+            wvec[i] for i in range(len(WEIGHT_FIELDS)))
+    else:
+        w_bal, w_lst, w_mst = cfg.w_balanced, cfg.w_least, cfg.w_most
+        w_na, w_tt, w_ip = cfg.w_node_aff, cfg.w_taint, cfg.w_interpod
+        w_sp, w_si, w_gp = cfg.w_spread, cfg.w_simon, cfg.w_gpu
+    use_na = bool(tw or cfg.w_node_aff) and cfg.enable_node_aff_score
+    use_tt = bool(tw or cfg.w_taint) and cfg.enable_taint_score
+    use_ip = bool(tw or cfg.w_interpod) and cfg.enable_pref
+    use_sp = bool(tw or cfg.w_spread) and cfg.enable_spread_soft
+    use_si = bool(tw or cfg.w_simon)
+
     score = _part(scores.resource_scores_fused(
         state.headroom, inv_alloc, x["req"], cfg.cpu_mem_idx,
-        cfg.w_balanced, cfg.w_least, cfg.w_most))
+        w_bal, w_lst, w_mst, always_on=tw))
 
     # selectHost below is two monoid reduces (max + min-index-among-
     # maxima); a (max, index) tuple-reduce was measured ~2.4x a plain
@@ -1075,13 +1135,13 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
         red_rows.append(vec)
         return len(red_rows) - 1
 
-    if cfg.w_node_aff and cfg.enable_node_aff_score:
+    if use_na:
         raw_na = arrs.class_node_aff_score[_cid()]
         i_na = add_row(jnp.where(mask, -raw_na, 0.0))    # -max(where(m, raw, 0))
-    if cfg.w_taint and cfg.enable_taint_score:
+    if use_tt:
         raw_tt = arrs.class_taint_prefer[_cid()]
         i_tt = add_row(jnp.where(mask, -raw_tt, 0.0))
-    if cfg.w_interpod and cfg.enable_pref:
+    if use_ip:
         # existing pods' preferred (anti-)affinity toward this pod: one
         # mat-vec against the weighted domain paint (interpodaffinity/
         # scoring.go's "existing pod" direction)
@@ -1092,11 +1152,11 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
             extra_raw=existing_pref_raw)
         i_ip_lo = add_row(jnp.where(mask, raw_ip, big))
         i_ip_hi = add_row(jnp.where(mask, -raw_ip, big))
-    if cfg.w_spread and cfg.enable_spread_soft:
+    if use_sp:
         sp_scored = mask & spread_node_ok
         i_sp_lo = add_row(jnp.where(sp_scored, spread_raw, big))
         i_sp_hi = add_row(jnp.where(sp_scored, -spread_raw, big))
-    if cfg.w_simon:
+    if use_si:
         # static-alloc score: compute the share table per distinct node
         # spec ([U, R], U = few) and gather — identical floats to the
         # per-node form, minus ~R*8 full-width ops per step
@@ -1133,23 +1193,23 @@ def _step(arrs: SnapshotArrays, active: jnp.ndarray, cfg: EngineConfig,
             (0,),
         )
 
-    if cfg.w_node_aff and cfg.enable_node_aff_score:
-        score += _part(cfg.w_node_aff * scores.max_apply(raw_na, -reds[i_na]))
-    if cfg.w_taint and cfg.enable_taint_score:
+    if use_na:
+        score += _part(w_na * scores.max_apply(raw_na, -reds[i_na]))
+    if use_tt:
         score += _part(
-            cfg.w_taint * scores.max_apply(raw_tt, -reds[i_tt], reverse=True))
-    if cfg.w_interpod and cfg.enable_pref:
-        score += _part(cfg.w_interpod * scores.minmax_apply(
+            w_tt * scores.max_apply(raw_tt, -reds[i_tt], reverse=True))
+    if use_ip:
+        score += _part(w_ip * scores.minmax_apply(
             raw_ip, reds[i_ip_lo], -reds[i_ip_hi]))
-    if cfg.w_spread and cfg.enable_spread_soft:
-        score += _part(cfg.w_spread * scores.spread_apply(
+    if use_sp:
+        score += _part(w_sp * scores.spread_apply(
             spread_raw, reds[i_sp_lo], -reds[i_sp_hi], spread_node_ok, any_soft))
-    if cfg.w_simon:
-        score += _part(cfg.w_simon * scores.minmax_apply(
+    if use_si:
+        score += _part(w_si * scores.minmax_apply(
             raw_si, reds[i_si_lo], -reds[i_si_hi]))
     if cfg.enable_gpu:
         # cnt==0 pods score 0 on the GPU dimension (scalar factor)
-        score += _part((cfg.w_gpu * (x["gpu_cnt"] > 0)) * scores.minmax_apply(
+        score += _part((w_gp * (x["gpu_cnt"] > 0)) * scores.minmax_apply(
             raw_gp, reds[i_gp_lo], -reds[i_gp_hi]))
     for ext, raw_e, lo_i, hi_i in ext_scores:
         if lo_i is not None:
@@ -1403,6 +1463,7 @@ def schedule_pods(
     state_is_fresh: bool = False,
     waves=None,
     hoist_forced: bool = False,
+    weights: jnp.ndarray | None = None,
 ) -> ScheduleOutput:
     """Scan the pod sequence, return assignments + reason counts + final state.
 
@@ -1422,8 +1483,28 @@ def schedule_pods(
     dropped (full scan) whenever its exactness preconditions fail:
     preemption columns present, extension ops registered, or a resumed
     (non-fresh) state whose prefix bookkeeping the plan cannot see.
+
+    `weights` is the traced [K] score-weight vector (WEIGHT_FIELDS
+    order), required-and-only-valid when ``cfg.traced_weights``; omitted
+    under a traced config, the config's own ``weight_vector(cfg)`` is
+    baked in — digest-identical to the constant path either way.
     """
     n_pods = arrs.req.shape[0]
+    if cfg.traced_weights:
+        if weights is None:
+            # trace-time constant fallback (still the traced-mode program
+            # shape, so score_part_names etc. agree with the lane runs)
+            weights = jnp.asarray(weight_vector(cfg))
+        weights = jnp.asarray(weights, jnp.float32)
+        if weights.shape != (len(WEIGHT_FIELDS),):
+            raise ValueError(
+                f"weights must be a [{len(WEIGHT_FIELDS)}] vector in "
+                f"WEIGHT_FIELDS order, got shape {tuple(weights.shape)}")
+    elif weights is not None:
+        raise ValueError(
+            "weights passed but cfg.traced_weights is off — enable the "
+            "traced mode (make_config(..., traced_weights=True)) or drop "
+            "the vector")
     if waves is not None and (
             disabled is not None or nominated is not None or cfg.extensions
             or (state is not None and not state_is_fresh)
@@ -1518,8 +1599,9 @@ def schedule_pods(
             [jnp.asarray(scan_arrs.aff_key, jnp.int32),
              jnp.asarray(scan_arrs.anti_key, jnp.int32),
              jnp.asarray(scan_arrs.spread_key, jnp.int32)], axis=1)
+    wvec = weights if cfg.traced_weights else None
     step = functools.partial(_step, scan_arrs, active, cfg, hoisted, inv_alloc,
-                             gcr_seg)
+                             gcr_seg, wvec)
     if waves is None:
         final_state, (nodes, fail_counts, feasible, gpu_pick, vol_pick,
                       topk_node, topk_score, topk_parts) = jax.lax.scan(
@@ -1528,8 +1610,8 @@ def schedule_pods(
     else:
         final_state, (nodes, fail_counts, feasible, gpu_pick, vol_pick,
                       topk_node, topk_score, topk_parts) = _run_wave_plan(
-            scan_arrs, active, cfg, hoisted, inv_alloc, gcr_seg, state, xs,
-            waves, k)
+            scan_arrs, active, cfg, hoisted, inv_alloc, gcr_seg, wvec,
+            state, xs, waves, k)
     if k:
         # prepend the prefix's (predetermined) outputs
         nodes = jnp.concatenate([arrs.forced_node[:k].astype(jnp.int32), nodes])
@@ -1582,16 +1664,17 @@ def score_part_names(cfg: EngineConfig) -> Tuple[str, ...]:
     explain_topk, in exactly the order the rows are stacked (the
     topk_parts row axis). The gate conditions MUST mirror the _part()
     call sites in _step — extend both together."""
+    tw = cfg.traced_weights  # traced mode keeps every enabled row live
     names = ["NodeResources"]
-    if cfg.w_node_aff and cfg.enable_node_aff_score:
+    if bool(tw or cfg.w_node_aff) and cfg.enable_node_aff_score:
         names.append("NodeAffinity")
-    if cfg.w_taint and cfg.enable_taint_score:
+    if bool(tw or cfg.w_taint) and cfg.enable_taint_score:
         names.append("TaintToleration")
-    if cfg.w_interpod and cfg.enable_pref:
+    if bool(tw or cfg.w_interpod) and cfg.enable_pref:
         names.append("InterPodAffinity")
-    if cfg.w_spread and cfg.enable_spread_soft:
+    if bool(tw or cfg.w_spread) and cfg.enable_spread_soft:
         names.append("PodTopologySpread")
-    if cfg.w_simon:
+    if tw or cfg.w_simon:
         names.append("Simon")
     if cfg.enable_gpu:
         names.append("Open-Gpu-Share")

@@ -247,7 +247,11 @@ def _pod_model(arrs, cfg) -> _PodModel:
         _slot_union(read_groups, a("anti_group"), a("anti_valid"))
     if cfg.enable_spread:
         _slot_union(read_groups, a("spread_group"), a("spread_valid"))
-    pref_live = bool(cfg.enable_pref and cfg.w_interpod)
+    # traced weights keep every enabled score row live (a lane's variant
+    # may weight preferences even when the config's constant is 0), so
+    # the plan must treat the preference channel as read/written
+    pref_live = bool(cfg.enable_pref
+                     and (cfg.w_interpod or cfg.traced_weights))
     pv = a("pref_valid") & (a("pref_weight") != 0)
     if pref_live:
         _slot_union(read_groups, a("pref_group"), pv)

@@ -87,9 +87,10 @@ def resource_scores_fused(
     inv_alloc: jnp.ndarray,   # [N, R] = 1/alloc where alloc > 0 else 0
     req_p: jnp.ndarray,       # [R]
     cpu_mem_idx,
-    w_balanced: float,
-    w_least: float,
-    w_most: float,
+    w_balanced,
+    w_least,
+    w_most,
+    always_on: bool = False,
 ) -> jnp.ndarray:
     """Balanced + Least(+Most)Allocated in one pass over shared FREE
     fractions h = (headroom - req) * inv_alloc — the scan engine's
@@ -103,18 +104,24 @@ def resource_scores_fused(
     h is 0 there, which Least/Balanced read as 0% free (score 0 — matches
     the reference), and Most would read as 100% used (full score); the
     (inv_alloc > 0) mask keeps Most at 0 like mostRequestedScore's
-    capacity==0 early-out (most_allocated.go:49-51)."""
+    capacity==0 early-out (most_allocated.go:49-51).
+
+    ``always_on`` is the traced-weights mode (EngineConfig.traced_weights):
+    the weights are traced f32 scalars — never branched on — and every
+    term is computed unconditionally. A zero traced weight contributes an
+    exact ``+0.0`` (the terms are finite and nonnegative), so the traced
+    path at the constant path's weight values is bit-identical to it."""
     ci, mi = cpu_mem_idx
     h_c = (headroom[:, ci] - req_p[ci]) * inv_alloc[:, ci]
     h_m = (headroom[:, mi] - req_p[mi]) * inv_alloc[:, mi]
     out = jnp.zeros(headroom.shape[:1], dtype=jnp.float32)
-    if w_balanced:
+    if always_on or w_balanced:
         out = out + w_balanced * ((1.0 - jnp.abs(h_c - h_m) * 0.5) * MAX_SCORE)
-    if w_least:
+    if always_on or w_least:
         out = out + w_least * (
             (jnp.maximum(h_c, 0.0) + jnp.maximum(h_m, 0.0)) * (MAX_SCORE / 2.0)
         )
-    if w_most:
+    if always_on or w_most:
         # mostRequestedScore returns 0 when capacity == 0
         # (most_allocated.go:49-51): h is 0 there (inv_alloc == 0), which
         # would read as "fully used" = full score — mask those resources out

@@ -137,6 +137,7 @@ def batched_schedule(
     mesh: Optional[Mesh] = None,
     carry: Optional[object] = None,
     waves=None,
+    weights=None,
 ) -> ScheduleOutput:
     """vmap the scan over scenario lanes; shard lanes over the mesh.
 
@@ -158,10 +159,20 @@ def batched_schedule(
     computed activation-agnostic, so one plan serves every lane). Both
     the AOT path (plan in the cache key) and the mesh-sharded path
     (plan closed over the jitted lane fn) honor it.
+
+    `weights` is the per-lane [S, K] traced score-weight matrix under
+    ``cfg.traced_weights`` (the tune subsystem's policy-variant lanes;
+    AOT path only). A traced cfg with no explicit weights runs every
+    lane at the config's own vector — digest-identical to constant mode
+    — so the capacity sweeps accept traced configs unchanged.
     """
     if mesh is None or mesh.empty:
         return run_batched_cached(arrs, active_batch, cfg, carry=carry,
-                                  waves=waves)
+                                  waves=waves, weights=weights)
+    if weights is not None:
+        raise ValueError(
+            "per-lane weights require mesh=None (the AOT path); a traced "
+            "cfg without explicit weights runs at its own vector")
     if carry is not None:
         raise ValueError("carry donation requires mesh=None (the AOT path)")
     fn = jax.vmap(lambda a: schedule_pods(arrs, a, cfg, waves=waves))

@@ -80,11 +80,13 @@ REPLAY_JOURNAL_SUFFIX = ".replay.jsonl"
 # the bind-nothing sentinel (engine/exec_cache.py pads with the same):
 # departed and not-yet-arrived pods take zero scan work and zero carry
 SENTINEL = -4
-# score profile of the descheduler's defrag pass — migrate.py's
-# bin-packing overrides as an EngineConfig replace (one extra executable,
-# compiled once, reused by every defrag step)
-DEFRAG_OVERRIDES = {"w_least": 0.0, "w_balanced": 0.0, "w_most": 1.0,
-                    "w_spread": 0.0}
+# score profile of the descheduler's defrag pass — the shared
+# bin-packing overrides (ONE definition, engine/sched_config.py, also
+# used by the migration planner) as an EngineConfig replace: one extra
+# executable, compiled once, reused by every defrag step
+from open_simulator_tpu.engine.sched_config import MOST_ALLOCATED_OVERRIDES
+
+DEFRAG_OVERRIDES = dict(MOST_ALLOCATED_OVERRIDES)
 
 
 @dataclass

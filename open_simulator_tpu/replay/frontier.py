@@ -152,20 +152,46 @@ def enumerate_mixes(specs: List[NodeSpec],
     return mixes
 
 
+def dominates_on(a: Dict[str, Any], b: Dict[str, Any],
+                 minimize: Tuple[str, ...] = (),
+                 maximize: Tuple[str, ...] = ()) -> bool:
+    """Generic dominance over named objective keys: ``a`` dominates
+    ``b`` iff it is no worse on every objective and strictly better on
+    at least one. The node-mix frontier below instantiates it with
+    (cost, unplaced | util_pct); the scheduler-policy tune search
+    (tune/search.py) reuses it with (unplaced, cost, disruption)."""
+    if not all(a[k] <= b[k] for k in minimize):
+        return False
+    if not all(a[k] >= b[k] for k in maximize):
+        return False
+    return (any(a[k] < b[k] for k in minimize)
+            or any(a[k] > b[k] for k in maximize))
+
+
+def pareto_front(points: List[Dict[str, Any]],
+                 minimize: Tuple[str, ...] = (),
+                 maximize: Tuple[str, ...] = (),
+                 sort_key=None) -> List[Dict[str, Any]]:
+    """The non-dominated subset under ``dominates_on`` (O(W^2), the same
+    brute-force definition the tier-1 tests re-verify independently)."""
+    front = [p for p in points
+             if not any(dominates_on(q, p, minimize, maximize)
+                        for q in points)]
+    return sorted(front, key=sort_key) if sort_key is not None else front
+
+
 def dominates(a: Dict[str, Any], b: Dict[str, Any]) -> bool:
     """The frontier dominance rule (cheaper, no more disruption, at
     least as utilized — with something strictly better)."""
-    return (a["cost"] <= b["cost"] and a["unplaced"] <= b["unplaced"]
-            and a["util_pct"] >= b["util_pct"]
-            and (a["cost"] < b["cost"] or a["unplaced"] < b["unplaced"]
-                 or a["util_pct"] > b["util_pct"]))
+    return dominates_on(a, b, minimize=("cost", "unplaced"),
+                        maximize=("util_pct",))
 
 
 def pareto_set(points: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
-    front = [p for p in points
-             if not any(dominates(q, p) for q in points)]
-    return sorted(front, key=lambda p: (p["cost"], p["unplaced"],
-                                        -p["util_pct"], p["counts"]))
+    return pareto_front(
+        points, minimize=("cost", "unplaced"), maximize=("util_pct",),
+        sort_key=lambda p: (p["cost"], p["unplaced"], -p["util_pct"],
+                            p["counts"]))
 
 
 def capacity_frontier(cluster, apps, specs: List[NodeSpec],

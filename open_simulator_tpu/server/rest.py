@@ -92,6 +92,15 @@ Endpoint parity with the reference (pkg/server/server.go:148-314):
                              (partial trajectories on deadline) and
                              journal resume; "frontier" switches to the
                              heterogeneous node-mix Pareto question
+  POST /api/tune          -> scheduler-policy search (tune/search.py):
+                             {"cluster"?, "apps"?, "mode": "grid"|"cem",
+                              "variants", "rounds", "weights"?,
+                              "scheduler_config"?} — W weight variants
+                             run as lanes of ONE executable per round;
+                             cancellation observed at ROUND boundaries
+                             (partial points on deadline); the response
+                             carries every point + the (unplaced, cost,
+                             disruption) Pareto set
 
 Survivable serving (resilience/lifecycle.py, ARCHITECTURE.md §11):
 
@@ -182,7 +191,7 @@ _KNOWN_PATHS = frozenset({
     "/debug/profile",
     "/api/explain", "/api/deploy-apps", "/api/scale-apps", "/api/chaos",
     "/api/capacity", "/api/simulate", "/api/campaign", "/api/replay",
-    "/api/runs", "/api/trace", "/api/session",
+    "/api/runs", "/api/trace", "/api/session", "/api/tune",
 })
 
 
@@ -477,6 +486,37 @@ class SimulationServer:
             audit=bool(body.get("audit", True)),
         ), entries=entries)
         self._stats["simulations"] += report["totals"]["completed"]
+        return report
+
+    def tune(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Scheduler-policy search as a service (POST /api/tune).
+
+        Body: {"cluster": {"yaml": ...}?, "apps": [{"name", "yaml"}]?,
+               "mode": "grid"|"cem", "variants": W, "rounds": R,
+               "seed": N, "grid_values": [..]?, "elite_frac": f?,
+               "sigma": f?, "max_weight": f?,
+               "weights": {"w_spread": 0.0, ...}?,
+               "scheduler_config": "<KubeSchedulerConfiguration yaml>"
+                                   | {...}?,
+               "deadline_s": 30?}
+
+        Runs on the admission queue like every POST; the search observes
+        the deadline/drain CancelToken at every ROUND boundary, so a 504
+        carries {rounds_done, variants_done, pareto_so_far} partials.
+        Every malformed knob — unknown weight field, negative weight,
+        bogus grid value, malformed scheduler_config — is a structured
+        400 (E_BAD_REQUEST / E_SPEC), never a 500 (the tune fuzz suite
+        holds this). The response carries every evaluated point plus the
+        (unplaced, cost, disruption) Pareto set; one executable serves
+        all W x R variants (the traced-weights lane axis, §17)."""
+        from open_simulator_tpu.tune import TuneOptions, tune_search
+
+        self._stats["requests"] += 1
+        opts = TuneOptions.from_body(body)
+        cluster = self.base_cluster(body.get("cluster"))
+        apps = self._request_apps(body)
+        report = tune_search(cluster, apps, opts)
+        self._stats["simulations"] += report["rounds_run"]
         return report
 
     def replay(self, body: Dict[str, Any]) -> Dict[str, Any]:
@@ -1077,6 +1117,7 @@ def _make_handler(server: SimulationServer):
                       "/api/simulate": _SERVING_ROUTE,
                       "/api/campaign": server.campaign,
                       "/api/replay": server.replay,
+                      "/api/tune": server.tune,
                       "/api/chaos": server.chaos,
                       "/api/session": server.session_create}
             fn = routes.get(self.path)

@@ -67,7 +67,7 @@ SCHEMA_VERSION = 1
 # canonical phase ordering for reports/diffs (unknown names follow, sorted)
 PHASE_ORDER = ("admit", "expand", "encode", "transfer", "schedule",
                "compile", "decode", "sweep", "chaos.baseline", "chaos.event",
-               "replay.step", "frontier")
+               "replay.step", "frontier", "tune.round", "fleet.launch")
 
 # SnapshotArrays fields whose CONTENT feeds the workload digest (the
 # discriminative cheap core: capacities, requests, pins, activation,

@@ -1,0 +1,12 @@
+"""Policy tuning on the lane axis (ARCHITECTURE.md §17): traced score
+weights, grid / CEM-style Pareto search, one executable for W variants."""
+
+from open_simulator_tpu.tune.search import (  # noqa: F401
+    DEFAULT_GRID_VALUES,
+    TUNE_OBJECTIVES,
+    TuneOptions,
+    brute_force_pareto,
+    format_tune,
+    pareto_points,
+    tune_search,
+)

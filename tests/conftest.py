@@ -10,10 +10,29 @@ reliable way to select the CPU backend.
 """
 
 import os
+import tempfile
 
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
+
+# One on-disk XLA compilation cache for the whole suite — including every
+# SERVER SUBPROCESS the lifecycle/serving/session/tune tests spawn, which
+# otherwise each cold-compile programs an earlier child (or the parent)
+# already built. Keyed by HLO hash, so identical programs dedupe and
+# bit-identical contracts are untouched; env vars so children inherit it.
+# (Unlike JAX_PLATFORMS, the cache env vars ARE honored by this build —
+# tests/test_exec_cache.py::test_persistent_cache_writes_executables
+# exercises the same machinery.)
+_XLA_CACHE_DIR = os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    # per-user: a world-shared fixed path breaks on multi-user hosts
+    # (first user owns the dir, every later user's cache writes fail)
+    os.path.join(tempfile.gettempdir(),
+                 f"simon-tpu-test-xla-cache-{os.getuid()}"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+os.makedirs(_XLA_CACHE_DIR, exist_ok=True)
 
 import jax  # noqa: E402
 

@@ -1,0 +1,73 @@
+"""GL4 fixture (clean): the SAFE traced-score-weights pattern
+(companion to gl4_waves_ok.py; the tune subsystem's engine shape).
+
+The traced-weights mode (EngineConfig.traced_weights, ARCHITECTURE.md
+§17) turns the K score-plugin weights into a traced ``[K]`` input of the
+step so W policy variants run as lanes of ONE executable. The sanctioned
+shape, which this file pins GL4-clean:
+
+* gate selection is Python control flow on STATIC config — the enable
+  flags and the ``traced`` mode flag itself (hashable EngineConfig
+  fields baked into the trace), never on a weight value in traced mode;
+* the traced weights are only ever SLICED and MULTIPLIED — ``w = wvec[i]``
+  then ``score += w * term`` — a zero weight contributes an exact +0.0
+  instead of compiling its plugin out, which is what keeps the traced
+  path bit-identical to the constant path at the same vector;
+* the constant mode may still branch on its (static float) weights —
+  that is compile-time dead-code elimination, not a host sync.
+
+Branching on a traced weight (``if wvec[0]:`` inside the trace) is the
+GL4 violation this pattern exists to avoid; the negative example lives
+in gl4_trace.py.
+"""
+
+import jax
+import jax.numpy as jnp
+
+WEIGHT_FIELDS = ("w_balanced", "w_least", "w_spread")
+
+
+def run_step(alloc, req, wvec_host, *, traced, enable_spread,
+             w_balanced, w_least, w_spread):
+    # static gates: Python bools/floats off the hashable config — in
+    # traced mode every enabled row stays live (`traced or weight`),
+    # in constant mode a zero weight compiles its row out
+    use_bal = bool(traced or w_balanced)
+    use_least = bool(traced or w_least)
+    use_spread = bool(traced or w_spread) and enable_spread
+
+    @jax.jit
+    def step(headroom, req_p, wvec):
+        if traced:  # static mode flag, not a traced value
+            # traced weights: slice the [K] input; multiply, never branch
+            w_bal, w_lst, w_sp = (wvec[i] for i in range(len(WEIGHT_FIELDS)))
+        else:
+            # constant mode: static floats folded into the trace
+            w_bal, w_lst, w_sp = w_balanced, w_least, w_spread
+        h = (headroom - req_p) / jnp.maximum(headroom, 1.0)
+        score = jnp.zeros(headroom.shape[:1], jnp.float32)
+        if use_bal:
+            score = score + w_bal * (1.0 - jnp.abs(h[:, 0] - h[:, 1]))
+        if use_least:
+            score = score + w_lst * jnp.maximum(h, 0.0).sum(axis=1)
+        if use_spread:
+            score = score + w_sp * (h[:, 0] * 0.5)
+        return jnp.argmax(score)
+
+    return step(jnp.asarray(alloc), jnp.asarray(req),
+                jnp.asarray(wvec_host, jnp.float32))
+
+
+def run_lanes(alloc, req, weight_matrix_host, cfg_flags):
+    # the tune lane axis: vmap over a [W, K] weight matrix — one
+    # executable, W policy variants; weights enter ONLY as traced input
+    @jax.jit
+    def lanes(headroom, req_p, wmat):
+        def lane(wvec):
+            score = wvec[0] * headroom[:, 0] + wvec[1] * req_p[0]
+            return jnp.argmax(score)
+
+        return jax.vmap(lane)(wmat)
+
+    return lanes(jnp.asarray(alloc), jnp.asarray(req),
+                 jnp.asarray(weight_matrix_host, jnp.float32))

@@ -173,7 +173,14 @@ def _campaign_child():
             os.kill(os.getpid(), signal.SIGKILL)
 
     campaign_runner.CampaignJournal._append = kamikaze
-    run_campaign(CampaignOptions(fleet=os.environ["TEST_FLEET"]))
+    # serial boundary on purpose: this test pins the SERIAL settlement
+    # order (first journal line = first cluster's row); the fleet-lane
+    # path settles prepass quarantines before batched rows and has its
+    # own journal/report coverage in test_tune.py. The PARENT resume
+    # below runs the default (lane) mode, so serial-journal -> lane-mode
+    # resume compatibility is exactly what this test now also proves.
+    run_campaign(CampaignOptions(fleet=os.environ["TEST_FLEET"],
+                                 fleet_lanes=False))
     raise SystemExit("unreachable")
 
 

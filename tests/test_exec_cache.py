@@ -230,10 +230,12 @@ def test_persistent_cache_writes_executables(tmp_path):
         names = os.listdir(tmp_path)
         assert any("schedule_pods" in n for n in names), names[:5]
     finally:
-        # restore: later tests must not inherit the tmp dir
+        # restore: later tests must not inherit the tmp dir (they go back
+        # to the suite-wide cache conftest configures, if any)
         import jax
 
-        jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("JAX_COMPILATION_CACHE_DIR"))
         exec_cache._persistent_dir = None
 
 

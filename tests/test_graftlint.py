@@ -148,6 +148,17 @@ def test_gl4_waves_safe_pattern_is_clean():
     assert lint_fixture("gl4_waves_ok.py") == []
 
 
+def test_gl4_tune_safe_pattern_is_clean():
+    """The traced-score-weights pattern (tune subsystem, ARCHITECTURE
+    §17) — weights sliced from a traced [K] input and only multiplied,
+    gate selection on STATIC enable flags plus the static traced-mode
+    flag (`traced or weight`), a vmapped [W, K] lane matrix — the
+    pattern scheduler._step + tune/search.py follow, must not trip GL4
+    (or any rule). Branching on a traced weight is the violation this
+    shape exists to avoid (gl4_trace.py's step-if covers the negative)."""
+    assert lint_fixture("gl4_tune_ok.py") == []
+
+
 def test_gl4_ledger_safe_pattern_is_clean():
     """Host-side run-ledger writes next to jit scope — fingerprints from
     static shape metadata, digests over np.asarray'd outputs, JSON file

@@ -1,0 +1,785 @@
+"""Policy tuning on the lane axis (tune/, ARCHITECTURE.md §17).
+
+Covers the tentpole contracts of ISSUE 13:
+
+* **traced-weights equivalence**: the traced path at the config's own
+  weight vector is BIT-IDENTICAL (outputs, final state, ledger digest,
+  explain topk_parts) to the constant-weight path — across the easy /
+  rich / pools / gpu workloads, waves on and off, singleton and lane
+  execution, and both capacity-sweep modes (exhaustive + bisect) under
+  a traced config;
+* **one executable**: a whole tune run (W variants x R rounds, grid and
+  cem) compiles exactly one new batched executable, asserted via the
+  `simon_compile_cache_total` miss delta;
+* **Pareto honesty**: the report's Pareto set equals a brute-force
+  O(W^2) dominance sweep AND one-variant-at-a-time enumeration of the
+  same vectors;
+* **scheduler-config fuzz**: ~50-seed mutation fuzz of
+  KubeSchedulerConfiguration parsing — every malformation is a
+  structured E_SPEC (CLI `error:` exit, REST 400), never a traceback;
+* **fleet lanes**: same-bucket campaign clusters execute in FEWER
+  launches than clusters with a report digest bit-identical to the
+  serial boundary, and per-lane quarantine isolates one poisoned lane
+  while its siblings settle.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import random
+import threading
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import numpy as np
+import pytest
+import yaml
+
+import jax.numpy as jnp
+
+from open_simulator_tpu.encode.snapshot import encode_cluster
+from open_simulator_tpu.engine.scheduler import (
+    WEIGHT_FIELDS,
+    device_arrays,
+    make_config,
+    schedule_pods,
+    score_part_names,
+    weight_vector,
+)
+from open_simulator_tpu.engine.sched_config import (
+    MOST_ALLOCATED_OVERRIDES,
+    SchedulerConfigError,
+    weight_overrides_from_file,
+    weight_overrides_from_text,
+)
+from open_simulator_tpu.errors import SimulationError
+from open_simulator_tpu.telemetry.ledger import array_result_digest
+from open_simulator_tpu.testing.synthetic import synthetic_snapshot
+from tests.conftest import make_node, make_pod
+
+OUT_FIELDS = ("node", "fail_counts", "feasible", "gpu_pick", "vol_pick",
+              "topk_node", "topk_score", "topk_parts")
+
+
+def _gpu_snapshot(n_nodes=6, n_pods=18):
+    from open_simulator_tpu.k8s.objects import (
+        ANNO_GPU_COUNT,
+        ANNO_GPU_MEM,
+        RES_GPU_COUNT,
+        RES_GPU_MEM,
+    )
+
+    nodes = [make_node(f"g{i}", cpu_m=16000, mem_mib=65536,
+                       extra_alloc={RES_GPU_COUNT: 2, RES_GPU_MEM: 32},
+                       labels={"topology.kubernetes.io/zone": f"z{i % 2}"})
+             for i in range(n_nodes)]
+    pods = [make_pod(f"p{i}", cpu="500m",
+                     annotations={ANNO_GPU_MEM: str(4 + i % 3),
+                                  ANNO_GPU_COUNT: "1"})
+            for i in range(n_pods)]
+    return encode_cluster(nodes, pods)
+
+
+def _snapshot(name):
+    if name == "easy":
+        return synthetic_snapshot(10, 40, 0)
+    if name == "rich":
+        return synthetic_snapshot(10, 40, 0, rich=True)
+    if name == "pools":
+        return synthetic_snapshot(12, 48, 0, pools=4)
+    if name == "gpu":
+        return _gpu_snapshot()
+    raise AssertionError(name)
+
+
+def _assert_outputs_identical(out_a, out_b, what=""):
+    for name in OUT_FIELDS:
+        a = np.asarray(getattr(out_a, name))
+        b = np.asarray(getattr(out_b, name))
+        assert np.array_equal(a, b), f"{what}: {name} diverged"
+    for name, a in out_a.state._asdict().items():
+        b = getattr(out_b.state, name)
+        assert np.array_equal(np.asarray(a), np.asarray(b)), (
+            f"{what}: state.{name} diverged")
+    assert (array_result_digest(np.asarray(out_a.node))
+            == array_result_digest(np.asarray(out_b.node))), (
+        f"{what}: ledger digest diverged")
+
+
+# ---- traced-weights equivalence (the bit-identical contract) -------------
+
+
+@pytest.mark.parametrize("waves", [False, True], ids=["scan", "waves"])
+@pytest.mark.parametrize("name", ["easy", "rich", "pools", "gpu"])
+def test_traced_default_vector_is_digest_identical(name, waves):
+    """Constant path vs traced path at the config's own weight_vector():
+    every output tensor, every carry leaf, the ledger result digest, and
+    the explain topk_parts rows must be bit-identical. explain_topk runs
+    on the rich workload only — it is the one whose part table holds
+    every score row, and compiling the topk machinery into all four
+    workloads is pure tier-1 wall time."""
+    from open_simulator_tpu.engine.waves import waves_for
+
+    snap = _snapshot(name)
+    cfg = make_config(snap, explain_topk=2 if name == "rich" else 0)
+    arrs = device_arrays(snap)
+    cfg_t = cfg._replace(traced_weights=True)
+    plan_c = waves_for(snap.arrays, cfg) if waves else None
+    plan_t = waves_for(snap.arrays, cfg_t) if waves else None
+    out_c = schedule_pods(arrs, arrs.active, cfg, waves=plan_c)
+    out_t = schedule_pods(arrs, arrs.active, cfg_t, waves=plan_t,
+                          weights=jnp.asarray(weight_vector(cfg)))
+    # identical part-row vocabulary first (topk_parts rows must agree)
+    assert score_part_names(cfg) == score_part_names(cfg_t)
+    _assert_outputs_identical(out_c, out_t, f"{name}/waves={waves}")
+
+
+def test_traced_config_without_explicit_weights_bakes_own_vector():
+    """Omitting `weights` under a traced config runs the config's own
+    vector — still digest-identical to the constant path (the capacity
+    sweeps rely on this to accept traced configs unchanged)."""
+    snap = _snapshot("easy")
+    cfg = make_config(snap)
+    arrs = device_arrays(snap)
+    out_c = schedule_pods(arrs, arrs.active, cfg)
+    out_t = schedule_pods(arrs, arrs.active,
+                          cfg._replace(traced_weights=True))
+    _assert_outputs_identical(out_c, out_t, "baked-default")
+
+
+def test_traced_weights_shape_and_mode_validation():
+    snap = _snapshot("easy")
+    cfg = make_config(snap)
+    arrs = device_arrays(snap)
+    with pytest.raises(ValueError, match="traced_weights is off"):
+        schedule_pods(arrs, arrs.active, cfg,
+                      weights=jnp.zeros(len(WEIGHT_FIELDS)))
+    with pytest.raises(ValueError, match="WEIGHT_FIELDS"):
+        schedule_pods(arrs, arrs.active,
+                      cfg._replace(traced_weights=True),
+                      weights=jnp.zeros(3))
+
+
+def test_traced_nondefault_vector_matches_constant_config():
+    """A traced run at a NON-default vector answers the same question as
+    a constant config built with those weights — the semantic contract
+    that makes a tune lane a real policy variant. (Assignments equal;
+    score parts are not compared: a zero constant weight compiles its
+    row out while the traced path keeps it live at +0.0.)"""
+    snap = _snapshot("easy")
+    arrs = device_arrays(snap)
+    variant = dict(MOST_ALLOCATED_OVERRIDES)  # the bin-packing profile
+    cfg_c = make_config(snap, **variant)
+    cfg_t = make_config(snap, **variant)._replace(traced_weights=True)
+    out_c = schedule_pods(arrs, arrs.active, cfg_c)
+    out_t = schedule_pods(arrs, arrs.active, cfg_t,
+                          weights=jnp.asarray(weight_vector(cfg_c)))
+    assert np.array_equal(np.asarray(out_c.node), np.asarray(out_t.node))
+    assert np.array_equal(np.asarray(out_c.fail_counts),
+                          np.asarray(out_t.fail_counts))
+
+
+def test_traced_lanes_match_singleton_runs():
+    """[W, K] lane execution: each lane of one batched traced launch is
+    bit-identical to its singleton traced run (the vmap adds no
+    cross-lane ops) — the property every tune round leans on."""
+    from open_simulator_tpu.engine import exec_cache
+
+    snap = _snapshot("easy")
+    cfg = make_config(snap)._replace(traced_weights=True,
+                                     fail_reasons=False)
+    arrs, _, n_pods = exec_cache.bucketed_device_arrays(snap.arrays)
+    base = weight_vector(cfg)
+    variants = [base,
+                np.asarray([1, 0, 1, 2, 0, 1, 0, 1, 1], np.float32),
+                np.asarray([0, 2, 0, 0, 1, 0, 4, 0, 1], np.float32)]
+    wmat = np.stack(variants)
+    masks = np.tile(np.asarray(arrs.active), (len(variants), 1))
+    out = exec_cache.run_batched_cached(arrs, masks, cfg, weights=wmat)
+    for i, vec in enumerate(variants):
+        solo = schedule_pods(arrs, arrs.active, cfg,
+                             weights=jnp.asarray(vec))
+        assert np.array_equal(np.asarray(out.node)[i],
+                              np.asarray(solo.node)), f"lane {i}"
+
+
+@pytest.mark.parametrize("mode", ["exhaustive", "bisect"])
+def test_capacity_sweeps_accept_traced_config(mode):
+    """Both sweep modes under a traced config (no explicit weights) give
+    the same plan as the constant config — best_count and per-lane
+    assignments included."""
+    from open_simulator_tpu.parallel.sweep import (
+        capacity_bisect,
+        capacity_sweep,
+    )
+
+    snap = synthetic_snapshot(6, 24, max_new=4)
+    cfg_c = make_config(snap)._replace(fail_reasons=False)
+    cfg_t = cfg_c._replace(traced_weights=True)
+    if mode == "exhaustive":
+        plan_c = capacity_sweep(snap, cfg_c, [0, 2, 4])
+        plan_t = capacity_sweep(snap, cfg_t, [0, 2, 4])
+    else:
+        # lanes == len(counts) above so both modes share the two
+        # 3-lane executables (constant + traced) — one compile pair
+        # serves the whole parametrization
+        plan_c = capacity_bisect(snap, cfg_c, 4, lanes=3)
+        plan_t = capacity_bisect(snap, cfg_t, 4, lanes=3)
+    assert plan_c.best_count == plan_t.best_count
+    assert plan_c.counts == plan_t.counts
+    assert np.array_equal(np.asarray(plan_c.nodes_per_scenario),
+                          np.asarray(plan_t.nodes_per_scenario))
+
+
+def test_traced_mode_forks_the_exec_cache_key():
+    """Tuned and constant runs must never share an executable: the
+    traced_weights flag is part of EngineConfig, so it forks the AOT
+    cache key (a stale alias would answer with the wrong program)."""
+    from open_simulator_tpu import telemetry
+    from open_simulator_tpu.engine import exec_cache
+
+    snap = _snapshot("easy")
+    cfg = make_config(snap)._replace(fail_reasons=False)
+    arrs, _, _ = exec_cache.bucketed_device_arrays(snap.arrays)
+    masks = np.tile(np.asarray(arrs.active), (2, 1))
+    c = telemetry.counter("simon_compile_cache_total",
+                          labelnames=("fn", "event"))
+    exec_cache.run_batched_cached(arrs, masks, cfg)
+    m0 = c.value(fn="batched_schedule", event="miss")
+    exec_cache.run_batched_cached(arrs, masks,
+                                  cfg._replace(traced_weights=True))
+    m1 = c.value(fn="batched_schedule", event="miss")
+    assert m1 == m0 + 1, "traced config aliased the constant executable"
+
+
+# ---- the search (tune/search.py) -----------------------------------------
+
+
+def _tune_cluster(n_nodes=6, n_pods=18):
+    """A small cluster where weights actually matter: two node classes
+    (big/small), a soft zone spread, pods that fit everywhere."""
+    from open_simulator_tpu.k8s.loader import ClusterResources
+
+    cluster = ClusterResources()
+    for i in range(n_nodes):
+        cluster.nodes.append(make_node(
+            f"n{i}", cpu_m=16000 if i % 2 else 8000,
+            mem_mib=32768 if i % 2 else 16384,
+            labels={"topology.kubernetes.io/zone": f"z{i % 2}"}))
+    for i in range(n_pods):
+        cluster.pods.append(make_pod(
+            f"p{i}", cpu="900m", mem="900Mi",
+            labels={"app": f"a{i % 3}"},
+            spread=[{"maxSkew": 1,
+                     "topologyKey": "topology.kubernetes.io/zone",
+                     "whenUnsatisfiable": "ScheduleAnyway",
+                     "labelSelector": {"matchLabels":
+                                       {"app": f"a{i % 3}"}}}]))
+    return cluster
+
+
+def test_tune_grid_one_executable_and_brute_force_pareto():
+    from open_simulator_tpu import telemetry
+    from open_simulator_tpu.tune import (
+        TuneOptions,
+        brute_force_pareto,
+        tune_search,
+    )
+
+    cluster = _tune_cluster()
+    c = telemetry.counter("simon_compile_cache_total",
+                          labelnames=("fn", "event"))
+    m0 = c.value(fn="batched_schedule", event="miss")
+    rep = tune_search(cluster, [], TuneOptions(
+        mode="grid", variants=4, grid_values=(0.0, 2.0)))
+    m1 = c.value(fn="batched_schedule", event="miss")
+    assert m1 - m0 == 1, "a tune run must compile exactly ONE executable"
+    assert rep["rounds_run"] > 1          # several rounds, still 1 compile
+    assert rep["n_variants"] == len(rep["points"])
+    # lane one of round one is the baseline; disruption self-measures 0
+    assert rep["baseline"]["disruption"] == 0
+    bf = brute_force_pareto(rep["points"])
+    assert [p["vector"] for p in rep["pareto"]] == [p["vector"] for p in bf]
+    # a second search on the same bucket (cem, same lane count) reuses it
+    rep2 = tune_search(cluster, [], TuneOptions(
+        mode="cem", variants=4, rounds=2, seed=7))
+    m2 = c.value(fn="batched_schedule", event="miss")
+    assert m2 == m1, "cem rounds recompiled"
+    assert rep2["n_variants"] >= 4
+    bf2 = brute_force_pareto(rep2["points"])
+    assert [p["vector"] for p in rep2["pareto"]] == [p["vector"]
+                                                     for p in bf2]
+
+
+def test_tune_pareto_matches_single_variant_enumeration():
+    """Every reported point re-verified one variant at a time: a
+    singleton traced run of each vector must reproduce the point's
+    (unplaced, cost, disruption) exactly, and the Pareto set over the
+    re-derived points must equal the report's."""
+    from open_simulator_tpu.core import build_pod_sequence
+    from open_simulator_tpu.engine import exec_cache
+    from open_simulator_tpu.k8s.loader import make_valid_node
+    from open_simulator_tpu.tune import (
+        TuneOptions,
+        pareto_points,
+        tune_search,
+    )
+
+    cluster = _tune_cluster()
+    rep = tune_search(cluster, [], TuneOptions(mode="cem", variants=4,
+                                               rounds=2, seed=3))
+    nodes = [make_valid_node(n) for n in cluster.nodes]
+    pods = build_pod_sequence(cluster, [])
+    snap = encode_cluster(nodes, pods)
+    cfg = make_config(snap, traced_weights=True)._replace(
+        fail_reasons=False)
+    arrs, _, n_pods = exec_cache.bucketed_device_arrays(snap.arrays)
+    baseline_row = None
+    rederived = []
+    for p in rep["points"]:
+        out = schedule_pods(
+            arrs, arrs.active, cfg,
+            weights=jnp.asarray(np.asarray(p["vector"], np.float32)))
+        row = np.asarray(out.node)[:n_pods]
+        if baseline_row is None:
+            baseline_row = row
+        placed = row >= 0
+        rederived.append({
+            "vector": p["vector"],
+            "unplaced": int(np.sum(~placed)),
+            "cost": int(np.unique(row[placed]).size),
+            "disruption": int(np.sum(row != baseline_row)),
+        })
+        for k in ("unplaced", "cost", "disruption"):
+            assert rederived[-1][k] == p[k], (k, p)
+    assert ([p["vector"] for p in pareto_points(rederived)]
+            == [p["vector"] for p in rep["pareto"]])
+
+
+def test_tune_objectives_are_not_degenerate():
+    """The search must actually discriminate: on a cluster with slack, a
+    bin-packing-leaning variant occupies fewer nodes than the baseline
+    spread-leaning policy (cost objective moves), so the Pareto set has
+    more than one point."""
+    from open_simulator_tpu.tune import TuneOptions, tune_search
+
+    # same cluster shape and lane count as the searches above, so this
+    # reuses their [4, K] executable instead of compiling an 8-lane one
+    rep = tune_search(_tune_cluster(), [], TuneOptions(
+        mode="grid", variants=4, grid_values=(0.0, 4.0)))
+    costs = {p["cost"] for p in rep["points"]}
+    assert len(costs) > 1, "no weight vector changed the placement"
+
+
+def test_tune_options_validation_is_structured():
+    from open_simulator_tpu.tune import TuneOptions
+
+    for body, field in [
+        ({"mode": "anneal"}, "mode"),
+        ({"variants": 0}, "variants"),
+        ({"variants": 10_000}, "variants"),
+        ({"variants": 8.9}, "variants"),     # silent truncation is a lie
+        ({"variants": True}, "variants"),    # bools float() to 0/1
+        ({"sigma": True}, "sigma"),
+        ({"weights": {"w_spread": True}}, "weights.w_spread"),
+        ({"grid_values": [True]}, "grid_values[0]"),
+        ({"grid_values": [0.0] * 65}, "grid_values"),
+        ({"rounds": -1}, "rounds"),
+        ({"rounds": "many"}, "rounds"),
+        ({"grid_values": []}, "grid_values"),
+        ({"grid_values": [1, "x"]}, "grid_values[1]"),
+        ({"grid_values": [-1.0]}, "grid_values[0]"),
+        ({"elite_frac": 0.0}, "elite_frac"),
+        ({"sigma": float("nan")}, "sigma"),
+        ({"max_weight": -2}, "max_weight"),
+        ({"weights": ["w_spread"]}, "weights"),
+        ({"weights": {"w_bogus": 1}}, "weights.w_bogus"),
+        ({"weights": {"w_spread": -1}}, "weights.w_spread"),
+        ({"weights": {"w_spread": "heavy"}}, "weights.w_spread"),
+        # f64-finite but f32-inf: would NaN every score if accepted
+        ({"weights": {"w_spread": 1e39}}, "weights.w_spread"),
+        ({"grid_values": [1e39]}, "grid_values[0]"),
+    ]:
+        with pytest.raises(SimulationError) as ei:
+            TuneOptions.from_body(body)
+        assert ei.value.field == field, (body, ei.value.field)
+        assert ei.value.code in ("E_BAD_REQUEST", "E_SPEC")
+
+
+# ---- KubeSchedulerConfiguration fuzz -------------------------------------
+
+
+BASE_SCHED_DOC = {
+    "apiVersion": "kubescheduler.config.k8s.io/v1beta2",
+    "kind": "KubeSchedulerConfiguration",
+    "profiles": [{
+        "schedulerName": "default-scheduler",
+        "plugins": {
+            "score": {
+                "enabled": [
+                    {"name": "PodTopologySpread", "weight": 2},
+                    {"name": "NodeResourcesBalancedAllocation",
+                     "weight": 1},
+                ],
+                "disabled": [{"name": "TaintToleration"}],
+            },
+            "filter": {
+                "disabled": [{"name": "PodTopologySpread"}],
+            },
+        },
+        "pluginConfig": [{
+            "name": "NodeResourcesFit",
+            "args": {"scoringStrategy": {"type": "MostAllocated"}},
+        }],
+    }],
+}
+
+
+def _mutate_sched_doc(rng: random.Random):
+    """One random malformation of the valid base doc — the classes the
+    satellite task names: dropped keys, wrong types, negative weights,
+    unknown plugin names (plus a couple of structural smashes)."""
+    doc = copy.deepcopy(BASE_SCHED_DOC)
+    prof = doc["profiles"][0]
+    score = prof["plugins"]["score"]
+    mutation = rng.choice([
+        "kind", "profiles_type", "profile_type", "plugins_type",
+        "score_type", "enabled_type", "entry_type", "drop_name",
+        "name_type", "weight_type", "weight_negative", "weight_nan",
+        "unknown_score", "unknown_score_disabled", "filter_type",
+        "plugin_config_type", "entry_cfg_type", "args_type",
+        "strategy_type",
+    ])
+    if mutation == "kind":
+        doc["kind"] = rng.choice(["Deployment", "KubeScheduler", 42])
+    elif mutation == "profiles_type":
+        doc["profiles"] = rng.choice(["default", 1, {"a": 1}])
+    elif mutation == "profile_type":
+        doc["profiles"][0] = rng.choice(["p", 3, ["x"]])
+    elif mutation == "plugins_type":
+        prof["plugins"] = rng.choice([["score"], "score", 7])
+    elif mutation == "score_type":
+        prof["plugins"]["score"] = rng.choice([["e"], "on", 1])
+    elif mutation == "enabled_type":
+        score["enabled"] = rng.choice([{"name": "x"}, "all", 5])
+    elif mutation == "entry_type":
+        score["enabled"][0] = rng.choice(["PodTopologySpread", 9, ["n"]])
+    elif mutation == "drop_name":
+        del score["enabled"][0]["name"]
+    elif mutation == "name_type":
+        score["enabled"][0]["name"] = rng.choice([17, None, ["x"], ""])
+    elif mutation == "weight_type":
+        score["enabled"][0]["weight"] = rng.choice(["heavy", [2], {}])
+    elif mutation == "weight_negative":
+        score["enabled"][0]["weight"] = -rng.randint(1, 100)
+    elif mutation == "weight_nan":
+        # round-trips through yaml as float nan / inf
+        score["enabled"][0]["weight"] = rng.choice(
+            [float("nan"), float("inf")])
+    elif mutation == "unknown_score":
+        score["enabled"][0]["name"] = f"OutOfTreeScore{rng.randint(0, 9)}"
+    elif mutation == "unknown_score_disabled":
+        score["disabled"][0]["name"] = f"Mystery{rng.randint(0, 9)}"
+    elif mutation == "filter_type":
+        prof["plugins"]["filter"] = rng.choice([["d"], "off", 2])
+    elif mutation == "plugin_config_type":
+        prof["pluginConfig"] = rng.choice([{"name": "x"}, "cfg", 4])
+    elif mutation == "entry_cfg_type":
+        prof["pluginConfig"][0] = rng.choice(["NodeResourcesFit", 6])
+    elif mutation == "args_type":
+        prof["pluginConfig"][0]["args"] = rng.choice([["s"], "args", 8])
+    elif mutation == "strategy_type":
+        prof["pluginConfig"][0]["args"]["scoringStrategy"] = rng.choice(
+            [["t"], "MostAllocated", 3])
+    return mutation, doc
+
+
+def test_sched_config_base_doc_parses():
+    ov = weight_overrides_from_text(yaml.safe_dump(BASE_SCHED_DOC))
+    assert ov["w_spread"] == 2.0 and ov["w_balanced"] == 1.0
+    assert ov["w_taint"] == 0.0           # explicit disable
+    assert ov["w_most"] == 1.0            # MostAllocated strategy
+
+
+def test_fuzz_sched_config_mutations_are_structured_espec(tmp_path):
+    """~50 seeds: every mutated doc either still parses to a plain dict
+    or raises SchedulerConfigError (E_SPEC, offending field named) —
+    NOTHING else may escape (a KeyError/TypeError here would be a CLI
+    traceback and a REST 500)."""
+    rejected = 0
+    for seed in range(50):
+        mutation, doc = _mutate_sched_doc(random.Random(seed))
+        path = tmp_path / f"cfg_{seed}.yaml"
+        path.write_text(yaml.safe_dump(doc))
+        try:
+            ov = weight_overrides_from_file(str(path))
+            assert isinstance(ov, dict), mutation
+        except SchedulerConfigError as e:
+            rejected += 1
+            assert e.code == "E_SPEC", (mutation, e.code)
+            assert isinstance(e.to_dict(), dict)
+    # the fuzz must actually bite: most mutations are malformations
+    assert rejected >= 25, f"only {rejected}/50 mutations rejected"
+
+
+def test_sched_config_invalid_yaml_text_is_espec():
+    with pytest.raises(SchedulerConfigError) as ei:
+        weight_overrides_from_text("{unclosed: [")
+    assert ei.value.code == "E_SPEC"
+
+
+def test_cli_tune_bad_scheduler_config_is_error_exit(tmp_path, capsys):
+    """The CLI surface of the same boundary: `simon-tpu tune` with a
+    malformed scheduler config exits 1 with an `error:` line, never a
+    traceback."""
+    from open_simulator_tpu.cli.main import main
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text(yaml.safe_dump({
+        "kind": "KubeSchedulerConfiguration",
+        "profiles": [{"plugins": {"score": {
+            "enabled": [{"name": "NoSuchPlugin"}]}}}]}))
+    rc = main(["tune", "--cluster-config", "examples/cluster",
+               "--scheduler-config", str(bad)])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "error:" in err and "E_SPEC" in err and "NoSuchPlugin" in err
+
+
+# ---- REST surface --------------------------------------------------------
+
+
+def _post(url, payload, timeout=300):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture(scope="module")
+def tune_box():
+    from open_simulator_tpu.server.rest import (
+        SimulationServer,
+        _make_handler,
+    )
+
+    srv = SimulationServer()
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _make_handler(srv))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield srv, f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def _cluster_yaml():
+    cluster = _tune_cluster()
+    return yaml.safe_dump_all(
+        [{"apiVersion": "v1", "kind": "Node", **n.raw}
+         for n in cluster.nodes]
+        + [{"apiVersion": "v1", "kind": "Pod", **p.raw}
+           for p in cluster.pods])
+
+
+def test_rest_tune_grid_and_cem(tune_box):
+    _, url = tune_box
+    cy = _cluster_yaml()
+    s, out = _post(url + "/api/tune",
+                   {"cluster": {"yaml": cy}, "mode": "grid",
+                    "variants": 4, "grid_values": [0, 2]})
+    assert s == 200, out
+    assert out["pareto"] and out["n_variants"] == len(out["points"])
+    assert out["objectives"] == ["unplaced", "cost", "disruption"]
+    s2, out2 = _post(url + "/api/tune",
+                     {"cluster": {"yaml": cy}, "mode": "cem",
+                      "variants": 4, "rounds": 2, "seed": 5})
+    assert s2 == 200, out2
+    assert out2["rounds_run"] == 2
+    # determinism: the same seeded request reproduces its digest
+    s3, out3 = _post(url + "/api/tune",
+                     {"cluster": {"yaml": cy}, "mode": "cem",
+                      "variants": 4, "rounds": 2, "seed": 5})
+    assert s3 == 200 and out3["digest"] == out2["digest"]
+
+
+def test_rest_tune_structured_400s(tune_box):
+    _, url = tune_box
+    cy = _cluster_yaml()
+    for body, field in [
+        ({"mode": "magic"}, "mode"),
+        ({"variants": "lots"}, "variants"),
+        ({"weights": {"w_nope": 1}}, "weights.w_nope"),
+        ({"grid_values": [float("-1")]}, "grid_values[0]"),
+        ({"scheduler_config": {"kind": "Deployment"}}, "kind"),
+        ({"scheduler_config": "{broken: ["}, ""),
+    ]:
+        s, out = _post(url + "/api/tune", {"cluster": {"yaml": cy}, **body})
+        assert s == 400, (body, s, out)
+        assert out.get("field") == field, (body, out)
+    # fuzzed scheduler_config docs inline: 400 or 200, never a 500
+    for seed in range(12):
+        _, doc = _mutate_sched_doc(random.Random(seed))
+        s, out = _post(url + "/api/tune",
+                       {"cluster": {"yaml": cy}, "variants": 1,
+                        "rounds": 1, "scheduler_config": doc})
+        assert s in (200, 400), (seed, s, out)
+        if s == 400:
+            assert out.get("code") in ("E_SPEC", "E_BAD_REQUEST")
+
+
+def test_rest_tune_lapsed_deadline_is_504(tune_box):
+    """An already-lapsed deadline 504s (skipped in queue or cancelled at
+    the first round boundary) — never a 500, never device work burned."""
+    _, url = tune_box
+    s, out = _post(url + "/api/tune",
+                   {"cluster": {"yaml": _cluster_yaml()},
+                    "mode": "cem", "variants": 4, "rounds": 64,
+                    "deadline_s": 1e-4})
+    assert s == 504, out
+    assert out["code"] in ("E_DEADLINE", "E_CANCELLED")
+
+
+def test_tune_cancellation_at_round_boundary_carries_partial():
+    """Cancellation is observed BETWEEN rounds with the tune partial
+    shape (rounds_done / variants_done / pareto_so_far) — the payload a
+    504 body carries."""
+    from open_simulator_tpu.resilience import lifecycle
+    from open_simulator_tpu.tune import TuneOptions, tune_search
+
+    token = lifecycle.CancelToken(1e-6)
+    with lifecycle.cancel_scope(token):
+        with pytest.raises(lifecycle.CancelledError) as ei:
+            tune_search(_tune_cluster(), [],
+                        TuneOptions(mode="grid", variants=4))
+    partial = ei.value.partial
+    assert set(partial) >= {"tune_id", "rounds_done", "variants_done",
+                            "pareto_so_far"}
+    assert partial["rounds_done"] == 0
+
+
+# ---- fleet lanes (campaign/lanes.py) -------------------------------------
+
+
+def _write_fleet(tmp_path, n=4, poison_idx=None):
+    from open_simulator_tpu.replay import synthetic_replay_cluster
+
+    for i in range(n):
+        path = tmp_path / f"c{i}.yaml"
+        if i == poison_idx:
+            path.write_text("{not: [valid yaml")   # quarantine fodder
+            continue
+        cl = synthetic_replay_cluster(n_nodes=6, n_initial_pods=12,
+                                      cpu_m=4000 + 500 * i)
+        path.write_text(yaml.safe_dump_all(
+            [{"apiVersion": "v1", "kind": "Node", **n_.raw}
+             for n_ in cl.nodes]
+            + [{"apiVersion": "v1", "kind": "Pod", **p.raw}
+               for p in cl.pods]))
+    return str(tmp_path)
+
+
+def test_fleet_lanes_fewer_launches_same_digest(tmp_path):
+    """The §13 bucket map cashed in: 4 same-bucket clusters run as ONE
+    launch (launches < clusters, the acceptance witness) and the report
+    digest is bit-identical to the serial boundary's."""
+    from open_simulator_tpu.campaign import CampaignOptions, run_campaign
+
+    fleet = _write_fleet(tmp_path)
+    serial = run_campaign(CampaignOptions(
+        fleet=fleet, fleet_lanes=False, checkpoint=False))
+    lanes = run_campaign(CampaignOptions(
+        fleet=fleet, fleet_lanes=True, checkpoint=False))
+    assert serial["totals"]["completed"] == 4
+    assert lanes["digest"] == serial["digest"]
+    assert serial["launches"] == 4
+    assert lanes["launches"] < lanes["totals"]["clusters"]
+    assert lanes["launches"] == 1
+    assert len(lanes["buckets"]) == 1      # the bucket-map witness
+
+
+def test_fleet_lanes_quarantine_digest_identical(tmp_path):
+    """A poisoned cluster (unparseable dump) quarantines through the
+    serial fallback in BOTH modes; sibling lanes still batch and the
+    digests still match."""
+    from open_simulator_tpu.campaign import CampaignOptions, run_campaign
+
+    fleet = _write_fleet(tmp_path, poison_idx=1)
+    serial = run_campaign(CampaignOptions(
+        fleet=fleet, fleet_lanes=False, checkpoint=False))
+    lanes = run_campaign(CampaignOptions(
+        fleet=fleet, fleet_lanes=True, checkpoint=False))
+    assert serial["totals"]["quarantined"] == 1
+    assert lanes["digest"] == serial["digest"]
+    assert lanes["launches"] == 2          # 1 batched + 1 serial quarantine
+    code = lanes["quarantined"][0]["error"]["code"]
+    assert code == "E_SOURCE"
+
+
+def test_fleet_lane_poisoned_lane_is_isolated(tmp_path, monkeypatch):
+    """PER-LANE quarantine: one lane of a batched launch failing its
+    decode/audit quarantines ALONE — siblings from the same launch
+    settle normally and the launch still counts once."""
+    from open_simulator_tpu.campaign import (
+        CampaignOptions,
+        lanes as lanes_mod,
+        run_campaign,
+    )
+
+    fleet = _write_fleet(tmp_path)
+    real = lanes_mod._decode_lane
+
+    def poisoned(prep, out, lane, n_lanes, opts, campaign_id):
+        if prep.entry.name == "c2":
+            raise SimulationError("placement audit violated (injected)",
+                                  code="E_AUDIT", ref="cluster/c2")
+        return real(prep, out, lane, n_lanes, opts, campaign_id)
+
+    monkeypatch.setattr(lanes_mod, "_decode_lane", poisoned)
+    rep = run_campaign(CampaignOptions(
+        fleet=fleet, fleet_lanes=True, checkpoint=False))
+    assert rep["totals"]["completed"] == 3
+    assert rep["totals"]["quarantined"] == 1
+    assert rep["quarantined"][0]["cluster"] == "c2"
+    assert rep["quarantined"][0]["error"]["code"] == "E_AUDIT"
+    assert rep["launches"] == 1            # the launch itself succeeded
+
+
+def test_fleet_lane_mixed_buckets_group_by_shape(tmp_path):
+    """Clusters in DIFFERENT shape buckets must not share a launch:
+    two buckets -> two (or more) launches, each still batched."""
+    from open_simulator_tpu.campaign import CampaignOptions, run_campaign
+    from open_simulator_tpu.replay import synthetic_replay_cluster
+
+    for i in range(2):
+        cl = synthetic_replay_cluster(n_nodes=6, n_initial_pods=12)
+        (tmp_path / f"small{i}.yaml").write_text(yaml.safe_dump_all(
+            [{"apiVersion": "v1", "kind": "Node", **n.raw}
+             for n in cl.nodes]
+            + [{"apiVersion": "v1", "kind": "Pod", **p.raw}
+               for p in cl.pods]))
+    for i in range(2):
+        cl = synthetic_replay_cluster(n_nodes=40, n_initial_pods=80)
+        (tmp_path / f"big{i}.yaml").write_text(yaml.safe_dump_all(
+            [{"apiVersion": "v1", "kind": "Node", **n.raw}
+             for n in cl.nodes]
+            + [{"apiVersion": "v1", "kind": "Pod", **p.raw}
+               for p in cl.pods]))
+    rep = run_campaign(CampaignOptions(
+        fleet=str(tmp_path), fleet_lanes=True, checkpoint=False))
+    assert rep["totals"]["completed"] == 4
+    assert rep["launches"] == 2
+    assert len(rep["buckets"]) == 2
+
+
+def test_fleet_lane_width_caps_the_batch(tmp_path):
+    from open_simulator_tpu.campaign import CampaignOptions, run_campaign
+
+    fleet = _write_fleet(tmp_path)
+    rep = run_campaign(CampaignOptions(
+        fleet=fleet, fleet_lanes=True, lane_width=2, checkpoint=False))
+    assert rep["totals"]["completed"] == 4
+    assert rep["launches"] == 2

@@ -144,6 +144,15 @@ if [ "$rc" -ne 0 ]; then
 fi
 
 echo
+echo "== policy tuning (grid Pareto, seeded cem digest, cancellation 504/400, fleet lanes) =="
+make tune-smoke
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: tune-smoke exited $rc" >&2
+  exit "$rc"
+fi
+
+echo
 echo "== inference serving (resident snapshot, delta == cold re-encode, poisoned lane, drain) =="
 make serve-smoke
 rc=$?
