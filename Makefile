@@ -4,7 +4,7 @@
 
 .PHONY: test lint smoke bench bench-smoke bench-regress lifecycle-smoke \
 	multichip-smoke campaign-smoke replay-smoke session-smoke serve-smoke \
-	tune-smoke
+	tune-smoke fault-smoke
 
 test:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -85,6 +85,15 @@ serve-smoke:
 # launches (the fleet-lane witness: launches < clusters)
 tune-smoke:
 	env JAX_PLATFORMS=cpu python tools/tune_smoke.py
+
+# device-fault-domain gate (resilience/faults.py): a real server under
+# an injected SIMON_FAULT_PLAN must answer the poisoned launch with a
+# structured 5xx (taxonomy code, never a bare traceback) while siblings
+# answer 200; the OOM pair walks the cache_drop -> resident_drop ladder
+# and still returns the healthy digest; simon_fault_* counters match
+# the plan exactly; SIGTERM under the plan still exits 0
+fault-smoke:
+	env JAX_PLATFORMS=cpu python tools/fault_smoke.py
 
 # regression gate over the run ledger (SIMON_LEDGER_DIR or
 # BENCH_LEDGER_DIR=... make bench-regress): the newest bench record per

@@ -383,15 +383,24 @@ class Applier:
             lane_active_pad = exec_cache.pad_vector(
                 lane_active, arrs.alloc.shape[0], False)
 
+            import jax as _jax
+
+            from open_simulator_tpu.resilience import faults
+
             def schedule_fn(disabled, nominated):
-                return exec_cache.unpad_output(
-                    schedule_pods(
-                        arrs, lane_active_pad, cfg,
-                        disabled=exec_cache.pad_vector(
-                            disabled, arrs.req.shape[0], False),
-                        nominated=exec_cache.pad_vector(
-                            nominated, arrs.req.shape[0], -1)),
-                    n_pods)
+                # block inside the fault domain: async-dispatch faults
+                # must classify here, not at the preemption host reads
+                return faults.run_launch(
+                    "schedule_pods",
+                    lambda: _jax.block_until_ready(
+                        exec_cache.unpad_output(
+                            schedule_pods(
+                                arrs, lane_active_pad, cfg,
+                                disabled=exec_cache.pad_vector(
+                                    disabled, arrs.req.shape[0], False),
+                                nominated=exec_cache.pad_vector(
+                                    nominated, arrs.req.shape[0], -1)),
+                            n_pods)))
 
             t0 = time.perf_counter()
             out, pre = run_with_preemption(
@@ -417,15 +426,23 @@ class Applier:
             from open_simulator_tpu.engine import exec_cache
             from open_simulator_tpu.engine.scheduler import schedule_pods
 
+            import jax as _jax
+
+            from open_simulator_tpu.resilience import faults
+
             arrs, n_pods = self._device_arrays_for(snapshot)
-            out = exec_cache.unpad_output(
-                schedule_pods(
-                    arrs,
-                    exec_cache.pad_vector(
-                        np.asarray(masks[idx]), arrs.alloc.shape[0], False),
-                    cfg._replace(fail_reasons=True),
-                ),
-                n_pods)
+            out = faults.run_launch(
+                "schedule_pods",
+                lambda: _jax.block_until_ready(
+                    exec_cache.unpad_output(
+                        schedule_pods(
+                            arrs,
+                            exec_cache.pad_vector(
+                                np.asarray(masks[idx]), arrs.alloc.shape[0],
+                                False),
+                            cfg._replace(fail_reasons=True),
+                        ),
+                        n_pods)))
             return decode_result(
                 snapshot,
                 np.asarray(out.node),

@@ -24,9 +24,15 @@ Quarantine semantics are unchanged and PER LANE:
   serial `_run_one` boundary (full retry/quarantine machinery);
 * a lane whose decode or placement audit fails is quarantined alone —
   its siblings in the same launch settle normally;
-* a launch that fails as a whole (transient device trouble) re-runs its
-  members through the serial boundary, which retries with the
-  full-jitter schedule exactly as before.
+* a launch that fails with a DETERMINISTIC device fault
+  (resilience/faults.py classification) walks the batch-split rung:
+  the chunk halves and re-launches, isolating the poison down to one
+  cluster's own serial verdict while siblings stay batched — per-lane
+  rows are chunking-invariant, so the report digest is unchanged;
+* a launch that fails any other way (transient retries already spent
+  inside the launch's fault domain, or an unclassified lane-path bug)
+  re-runs its members through the serial boundary, whose
+  classifier-gated retry/quarantine machinery owns the verdict.
 
 Cancellation (REST deadline, drain) is observed BETWEEN launches with
 the campaign's own partial-result shape, so a 504 mid-fleet still names
@@ -232,13 +238,67 @@ def _run_chunk(chunk: List[_Prepared], apps, opts, campaign_id: str,
                 sync["gpu_pick"] = np.asarray(out.gpu_pick)
             if cfg.enable_pv_match:
                 sync["vol_pick"] = np.asarray(out.vol_pick)
+            # E_NUMERIC sentinel scan over the launch's float state: a
+            # NaN escaping a fused score must raise here (and walk the
+            # batch-split ladder down to the poisoned cluster's own
+            # quarantine), not settle into report rows undetected
+            from open_simulator_tpu.resilience import faults as _faults
+
+            _faults.check_finite(
+                "fleet_schedule",
+                headroom=np.asarray(out.state.headroom),
+                **({"topk_score": sync["topk_score"]}
+                   if cfg.explain_topk else {}))
             out = out._replace(**sync)
     except lifecycle.CancelledError:
         raise
-    except Exception as e:  # noqa: BLE001 — transient device trouble
-        # (or a lane-path bug): the serial boundary re-runs every member
-        # with its own retry/quarantine machinery, so no cluster's
-        # verdict depends on the batched path working
+    except Exception as e:  # noqa: BLE001 — classified below; the serial
+        # boundary stays the last line of defense either way
+        from open_simulator_tpu.resilience import faults
+
+        if (isinstance(e, faults.DeviceFault) and not e.transient
+                and len(chunk) > 1):
+            # batch-split rung: a deterministic device fault (a NaN in
+            # one lane, an OOM the exec-cache rung couldn't absorb)
+            # halves the chunk and re-launches each side — per-lane rows
+            # are chunking-invariant, so the report digest is identical;
+            # a single poisoned cluster degrades all the way down to its
+            # own verdict while siblings stay batched
+            faults.record_rung("fleet_schedule", "batch_split", e.code)
+            half = len(chunk) // 2
+            return (_run_chunk(chunk[:half], apps, opts, campaign_id,
+                               settle, partial, width=len(chunk[:half]))
+                    + _run_chunk(chunk[half:], apps, opts, campaign_id,
+                                 settle, partial,
+                                 width=len(chunk[half:])))
+        if (isinstance(e, faults.DeviceFault) and not e.transient
+                and e.code == faults.E_NUMERIC):
+            # the ladder bottom for a NaN: the serial boundary runs the
+            # same data through a scan with NO finite sentinel, so a
+            # fallback would settle NaN-derived placements as a
+            # completed row — the one outcome the sentinel exists to
+            # prevent. The launch verdict IS the verdict: quarantine
+            # the cluster with the structured E_NUMERIC.
+            prep = chunk[0]
+            runner._campaign_metrics()[0].labels(
+                outcome="quarantined").inc()
+            _log.warning(
+                "campaign %s: cluster %s quarantined [E_NUMERIC] by the "
+                "fleet-lane sentinel: %s", campaign_id, prep.entry.name, e)
+            settle(prep.entry, "quarantine",
+                   runner.quarantine_row(prep.entry, e.to_dict(),
+                                         attempts=1), {})
+            return 1
+        # transient retries already spent inside the launch's fault
+        # domain (or an unclassified lane-path bug): the serial boundary
+        # re-runs every member with its own retry/quarantine machinery,
+        # so no cluster's verdict depends on the batched path working —
+        # and because the classifier gates the serial retries too, a
+        # deterministic fault quarantines on attempt 1 there instead of
+        # being retried like a transient
+        faults.record_rung(
+            "fleet_schedule", "serial",
+            e.code if isinstance(e, faults.DeviceFault) else "")
         _log.warning(
             "fleet-lane launch of %d cluster(s) failed (%s: %s); "
             "falling back to the serial boundary",

@@ -10,10 +10,12 @@ Per-cluster fault boundary
     unparseable dumps, ``E_AUDIT`` for invariant violations, admission
     codes for bad specs, ``E_INTERNAL`` for anything else) and land in a
     **quarantine record** with the error and retry history; the campaign
-    continues. Transient device failures (OSError / RuntimeError, the
-    XlaRuntimeError base) retry with the full-jitter backoff schedule
-    from ``resilience/retry.py`` — a fleet of workers must not retry in
-    lockstep.
+    continues. Failures the device fault classifier
+    (``resilience/faults.py``) calls *transient* — transfer trouble,
+    bare OSErrors around dump IO — retry with the full-jitter backoff
+    schedule from ``resilience/retry.py`` (a fleet of workers must not
+    retry in lockstep); deterministic-classed faults quarantine on
+    attempt 1 instead of burning the budget reproducing themselves.
 
 Checkpoint / resume
     One fsynced journal line per settled cluster (completed OR
@@ -67,10 +69,6 @@ from open_simulator_tpu.resilience.retry import run_with_retries
 _log = logging.getLogger(__name__)
 
 CAMPAIGN_JOURNAL_SUFFIX = ".campaign.jsonl"
-# transient-by-construction failure classes around device execution; the
-# structured SimulationError taxonomy is deterministic and never retried
-# (jax surfaces device faults as RuntimeError/XlaRuntimeError)
-TRANSIENT_ERRORS = (OSError, RuntimeError)
 
 
 @dataclass
@@ -416,10 +414,17 @@ def _run_one(entry: ClusterEntry, apps, opts: CampaignOptions,
         return row, fingerprint
 
     try:
+        # retries are gated by the device fault classifier (the
+        # run_with_retries default): only transient-classed failures —
+        # transfer trouble, bare OSErrors around dump IO — spend the
+        # backoff budget. The old (OSError, RuntimeError) blanket
+        # retried deterministic bugs (an OOM, a NaN, a ValueError deep
+        # in decode surfaced as RuntimeError) three times each, wasting
+        # the budget and burying the root cause under attempt noise in
+        # the quarantine record's history.
         row, fingerprint = run_with_retries(
             attempt, retries=opts.retries, backoff_s=opts.backoff_s,
-            max_backoff_s=opts.max_backoff_s, jitter=True,
-            retry_on=TRANSIENT_ERRORS)
+            max_backoff_s=opts.max_backoff_s, jitter=True)
         clusters_total.labels(outcome="completed").inc()
         return "cluster", row, fingerprint
     except lifecycle.CancelledError:

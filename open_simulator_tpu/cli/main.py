@@ -177,6 +177,15 @@ def build_parser() -> argparse.ArgumentParser:
              "campaigns) interleave so neither starves the other's "
              "deadlines — a crashed worker is replaced without losing "
              "queued jobs")
+    sp.add_argument(
+        "--fault-plan", default="", metavar="PLAN",
+        help="deterministic device fault injection (test rigs only): "
+             "'fn=<launch>,exc=<oom|device_lost|transfer|numeric|"
+             "compile>[,launch=<k>][,times=<n>]' rules joined by ';' — "
+             "fail launch #k of that fn n times so every degradation "
+             "rung and retry schedule is reproducibly testable (also "
+             "honors SIMON_FAULT_PLAN; a malformed plan is a startup "
+             "error here, not a per-request surprise)")
 
     ch = sub.add_parser(
         "chaos",
@@ -1099,6 +1108,16 @@ def main(argv=None) -> int:
     if args.command == "server":
         from open_simulator_tpu.server.rest import serve
 
+        if args.fault_plan:
+            # parse eagerly: a typo'd plan must be a startup error with
+            # the structured E_SPEC, not a silently-ignored env string
+            from open_simulator_tpu.resilience import faults
+
+            try:
+                faults.install_plan(args.fault_plan)
+            except SimulationError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
         return serve(
             address=args.address,
             port=args.port,

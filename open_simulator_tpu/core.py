@@ -336,6 +336,19 @@ def simulate(
         preempted_by: Optional[Dict[int, int]] = None
         # schedule_phase counts compile-miss vs cache-hit off the jit-cache
         # delta and stamps a nested "compile" span on a miss
+        import jax as _jax
+
+        from open_simulator_tpu.resilience import faults
+
+        def _wave_scan(launch_with_plan):
+            """The shared waves -> scan rung (faults.run_wave_launch),
+            mutating the enclosing wave_plan so later preemption passes
+            and the wave decode below see the degraded mode."""
+            nonlocal wave_plan
+            out, wave_plan = faults.run_wave_launch(
+                "schedule_pods", launch_with_plan, wave_plan)
+            return out
+
         with telemetry.schedule_phase(schedule_pods):
             if preemption:
                 from open_simulator_tpu.engine.preemption import run_with_preemption
@@ -348,25 +361,44 @@ def simulate(
                     # Waves only on the column-free first pass: passing the
                     # (ignored) plan alongside preemption columns would key
                     # a second executable for the identical program.
-                    return exec_cache.unpad_output(
-                        schedule_pods(
-                            arrs, arrs.active, cfg,
-                            disabled=exec_cache.pad_vector(
-                                disabled, arrs.req.shape[0], False),
-                            nominated=exec_cache.pad_vector(
-                                nominated, arrs.req.shape[0], -1),
-                            waves=(wave_plan if disabled is None
-                                   and nominated is None else None)),
-                        n_pods)
+                    # Each pass is one device launch in the fault domain;
+                    # the wave-eligible first pass carries the scan rung.
+                    # block_until_ready keeps async-dispatch faults
+                    # INSIDE the wrapper (they would otherwise surface
+                    # at run_with_preemption's host reads, unclassified).
+                    def launch(wp):
+                        return _jax.block_until_ready(
+                            exec_cache.unpad_output(
+                                schedule_pods(
+                                    arrs, arrs.active, cfg,
+                                    disabled=exec_cache.pad_vector(
+                                        disabled, arrs.req.shape[0], False),
+                                    nominated=exec_cache.pad_vector(
+                                        nominated, arrs.req.shape[0], -1),
+                                    waves=(wp if disabled is None
+                                           and nominated is None else None)),
+                                n_pods))
+
+                    if disabled is None and nominated is None:
+                        return _wave_scan(launch)
+                    return faults.run_launch("schedule_pods",
+                                             lambda: launch(None))
 
                 out, pre = run_with_preemption(snapshot, active_np, schedule_fn, pdbs)
                 preempted_by = pre.preempted_by
+                node_assign = np.asarray(out.node)
+                fail_counts = np.asarray(out.fail_counts)
             else:
-                out = exec_cache.unpad_output(
-                    schedule_pods(arrs, arrs.active, cfg, waves=wave_plan),
-                    n_pods)
-            node_assign = np.asarray(out.node)  # blocks on device completion
-            fail_counts = np.asarray(out.fail_counts)
+                def scan(wp):
+                    # hosting inside the launch: device faults surface at
+                    # the blocking np.asarray, and the fault domain must
+                    # see them to classify
+                    o = exec_cache.unpad_output(
+                        schedule_pods(arrs, arrs.active, cfg, waves=wp),
+                        n_pods)
+                    return o, np.asarray(o.node), np.asarray(o.fail_counts)
+
+                out, node_assign, fail_counts = _wave_scan(scan)
         gpu_pick = np.asarray(out.gpu_pick) if cfg.enable_gpu else None
         elapsed = time.perf_counter() - t0
         with span("decode"):

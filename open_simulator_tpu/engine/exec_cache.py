@@ -273,8 +273,14 @@ class ExecutableCache:
         # compile OUTSIDE the lock: a cold north-star compile takes
         # minutes and must not block a concurrent cache hit
         self._count(fn_name, "miss")
+        from open_simulator_tpu.resilience import faults
         from open_simulator_tpu.telemetry.spans import span
 
+        # the compile boundary of the device fault domain: an injected
+        # (or real) compilation failure surfaces here — classified by
+        # the caller's launch wrapper, never retried (E_COMPILE is
+        # deterministic)
+        faults.maybe_inject("compile")
         t0 = time.perf_counter()
         with span("compile", fn=fn_name):
             compiled = build()
@@ -332,7 +338,8 @@ def _zeros_carry_batch(arrs, cfg, lanes: int):
 
 def run_batched_cached(arrs, masks, cfg, carry=None,
                        fn_name: str = "batched_schedule", waves=None,
-                       weights=None):
+                       weights=None, retries: int = 2,
+                       backoff_s: float = 0.05):
     """Run the vmapped scan over scenario lanes through the AOT cache.
 
     `masks` is the [S, N] per-lane active matrix. `carry` is an optional
@@ -407,10 +414,41 @@ def run_batched_cached(arrs, masks, cfg, carry=None,
         return jax.jit(fnw, donate_argnums=(2,)).lower(
             arrs, masks, carry, weights).compile()
 
-    compiled = EXEC_CACHE.get_or_compile(key, fn_name, build)
-    if weights is None:
-        return compiled(arrs, masks, carry)
-    return compiled(arrs, masks, carry, weights)
+    from open_simulator_tpu.resilience import faults
+
+    # The fault domain around the launch. The donated carry backs the
+    # FIRST attempt only: a launch that executed-and-failed consumed its
+    # buffers, so every re-attempt (transient retry or ladder rung) runs
+    # from a fresh zeros batch — value-identical, because the executable
+    # resets the carry to the init state on device either way.
+    holder = {"carry": carry}
+
+    def fire():
+        compiled = EXEC_CACHE.get_or_compile(key, fn_name, build)
+        c = holder.pop("carry", None)
+        if c is None:
+            c = _zeros_carry_batch(arrs, cfg, lanes)
+        out = (compiled(arrs, masks, c) if weights is None
+               else compiled(arrs, masks, c, weights))
+        # block INSIDE the fault domain: dispatch is async, so a real
+        # device fault otherwise surfaces at the caller's first host
+        # read — outside this wrapper, unclassified. Every caller hosts
+        # immediately after, so the sync costs no pipelining.
+        return jax.block_until_ready(out)
+
+    try:
+        return faults.run_launch(fn_name, fire, retries=retries,
+                                 backoff_s=backoff_s)
+    except faults.DeviceFault as f:
+        if f.transient or f.code != faults.E_DEVICE_OOM:
+            raise
+        # OOM rung: evict every cached executable (their buffers and
+        # scratch are what crowd the device) and re-compile + re-launch
+        # once from fresh buffers — bit-identical outputs, later
+        faults.record_rung(fn_name, "cache_drop", f.code)
+        EXEC_CACHE.clear()
+        return faults.run_launch(fn_name, fire, retries=retries,
+                                 backoff_s=backoff_s)
 
 
 def stack_fleet_arrays(arrs_list):
@@ -473,8 +511,23 @@ def run_fleet_batched(arrs_batch, masks, cfg,
         return jax.jit(fn, donate_argnums=(2,)).lower(
             arrs_batch, masks, carry).compile()
 
-    compiled = EXEC_CACHE.get_or_compile(key, fn_name, build)
-    return compiled(arrs_batch, masks, carry)
+    from open_simulator_tpu.resilience import faults
+
+    # first attempt donates the carry built above; re-attempts rebuild
+    # (an executed-but-failed launch consumed the donated buffers)
+    holder = {"carry": carry}
+
+    def fire():
+        compiled = EXEC_CACHE.get_or_compile(key, fn_name, build)
+        c = holder.pop("carry", None)
+        if c is None:
+            c = jax.tree_util.tree_map(
+                lambda x: jnp.zeros((lanes,) + x.shape, x.dtype), proto)
+        # block inside the fault domain (async dispatch would surface a
+        # real fault at the caller's host read, unclassified)
+        return jax.block_until_ready(compiled(arrs_batch, masks, c))
+
+    return faults.run_launch(fn_name, fire)
 
 
 # ---- persistent compilation cache --------------------------------------

@@ -53,6 +53,23 @@ Codes (the taxonomy table lives in ARCHITECTURE.md "Resilience layer"):
   E_INTERNAL           unexpected non-taxonomy failure inside a campaign's
                        per-cluster fault boundary (a bug): recorded in the
                        quarantine record so the fleet continues
+
+Device fault domain (resilience/faults.py, ARCHITECTURE.md §18) — raised
+as ``DeviceFault`` when a device launch fails and the degradation ladder
+could not absorb it; transient classes spent their retry budget first:
+
+  E_DEVICE_OOM         XLA RESOURCE_EXHAUSTED / allocation failure
+                       (deterministic: same shapes OOM again; the ladder
+                       drops resident snapshots + the exec cache)
+  E_DEVICE_LOST        device lost / TPU slice preempted (deterministic
+                       in-process; the ladder falls back mesh ->
+                       single-device)
+  E_TRANSFER           host<->device transfer trouble, DATA_LOSS, bare
+                       OSErrors (transient: retried with full jitter)
+  E_NUMERIC            NaN/inf detected in decoded outputs (the
+                       check_finite sentinel scan; deterministic)
+  E_COMPILE            XLA/MLIR compilation or lowering failure
+                       (deterministic)
 """
 
 from __future__ import annotations

@@ -138,6 +138,8 @@ def batched_schedule(
     carry: Optional[object] = None,
     waves=None,
     weights=None,
+    retries: int = 2,
+    backoff_s: float = 0.05,
 ) -> ScheduleOutput:
     """vmap the scan over scenario lanes; shard lanes over the mesh.
 
@@ -168,7 +170,8 @@ def batched_schedule(
     """
     if mesh is None or mesh.empty:
         return run_batched_cached(arrs, active_batch, cfg, carry=carry,
-                                  waves=waves, weights=weights)
+                                  waves=waves, weights=weights,
+                                  retries=retries, backoff_s=backoff_s)
     if weights is not None:
         raise ValueError(
             "per-lane weights require mesh=None (the AOT path); a traced "
@@ -187,8 +190,21 @@ def batched_schedule(
             state=jax.tree_util.tree_map(lambda _: lane, _state_proto(arrs)),
         ),
     )
-    active_batch = jax.device_put(active_batch, NamedSharding(mesh, P("scenario", None)))
-    return fn(active_batch)
+    from open_simulator_tpu.resilience import faults
+
+    def fire():
+        placed = jax.device_put(
+            active_batch, NamedSharding(mesh, P("scenario", None)))
+        # block inside the fault domain: GSPMD dispatch is async, and a
+        # chip lost mid-execution must classify HERE (the host read in
+        # _execute_sweep is outside this wrapper)
+        return jax.block_until_ready(fn(placed))
+
+    # the mesh-sharded launch boundary of the device fault domain; a
+    # deterministic E_DEVICE_LOST here is what the single-device rung in
+    # _execute_sweep catches (a lost chip takes the whole mesh with it)
+    return faults.run_launch("mesh_schedule", fire, retries=retries,
+                             backoff_s=backoff_s)
 
 
 def _state_proto(arrs):
@@ -318,9 +334,12 @@ def capacity_sweep(
     does). Pass fail_reasons=True to keep the accounting in every lane.
 
     Device execution is retried with exponential backoff (`retries`,
-    `backoff_s`); if the batched run still fails and `isolate_trials`,
-    each lane re-runs alone so one failing trial cannot kill the sweep —
-    failed lanes land in CapacityPlan.trial_errors instead.
+    `backoff_s`) — the knobs are threaded to the launch-layer fault
+    domain (resilience/faults.py), which retries only
+    transient-classified failures; if the batched run still fails and
+    `isolate_trials`, each lane re-runs alone so one failing trial
+    cannot kill the sweep — failed lanes land in
+    CapacityPlan.trial_errors instead.
 
     When feasibility alone is the question, `capacity_bisect` answers
     with ~log_W(max_new) W-lane rounds instead of one lane per count."""
@@ -645,6 +664,7 @@ def _execute_sweep(arrs, masks, sweep_cfg, mesh, fail_reasons,
     values (all -1 nodes, pristine headroom)."""
     import time as _time
 
+    from open_simulator_tpu.resilience import faults
     from open_simulator_tpu.resilience.retry import run_with_retries
     from open_simulator_tpu.telemetry import registry as _telemetry
 
@@ -665,9 +685,16 @@ def _execute_sweep(arrs, masks, sweep_cfg, mesh, fail_reasons,
         fail = (np.asarray(out.fail_counts)[:, :n_pods] if fail_reasons
                 else np.zeros((out.node.shape[0], n_pods, sweep_cfg.n_ops),
                               dtype=np.int32))
+        headroom = np.asarray(out.state.headroom)
+        vg_used = np.asarray(out.state.vg_used)
+        # the E_NUMERIC sentinel scan: a NaN escaping a fused score into
+        # the carry must fail the lane loudly, not flow into occupancy
+        # verdicts (on the batched path the isolation fallback then
+        # narrows it to the offending lane)
+        faults.check_finite("batched_schedule", headroom=headroom,
+                            vg_used=vg_used)
         return (np.asarray(out.node)[:, :n_pods], fail,
-                np.asarray(out.state.headroom),
-                np.asarray(out.state.vg_used),
+                headroom, vg_used,
                 np.asarray(out.gpu_pick)[:, :n_pods],
                 np.asarray(out.vol_pick)[:, :n_pods])
 
@@ -677,8 +704,10 @@ def _execute_sweep(arrs, masks, sweep_cfg, mesh, fail_reasons,
         # carry only on the first attempt (donated buffers are dead after
         # it), and only as an explicit kwarg when present — the
         # fault-injection tests monkeypatch batched_schedule with the
-        # carry-less signature
-        kw = {}
+        # carry-less signature. The caller's retry knobs are threaded to
+        # the LAUNCH layer (faults.run_launch owns transient retries;
+        # an escalated DeviceFault is final — see faults.is_transient).
+        kw = {"retries": retries, "backoff_s": backoff_s}
         c = carry_once.pop("carry", None)
         if c is not None:
             kw["carry"] = c
@@ -687,16 +716,37 @@ def _execute_sweep(arrs, masks, sweep_cfg, mesh, fail_reasons,
         return batched_schedule(arrs, jnp.asarray(masks), sweep_cfg,
                                 mesh=mesh, **kw)
 
-    try:
+    def _run_batch(batched_fn):
         t0 = _time.perf_counter()
-        out = run_with_retries(_batched, retries=retries, backoff_s=backoff_s)
+        out = run_with_retries(batched_fn, retries=retries,
+                               backoff_s=backoff_s)
         hosted = host(out)  # np.asarray blocks: the timing covers execution
         trial_seconds.labels(mode="batched").observe(_time.perf_counter() - t0)
         trials_total.labels(outcome="ok").inc(masks.shape[0])
         return hosted + ({}, out.state if return_state else None)
-    except Exception:
+
+    try:
+        try:
+            return _run_batch(_batched)
+        except faults.DeviceFault as f:
+            # mesh -> single-device rung: a lost chip takes the whole
+            # GSPMD mesh down, but the AOT single-device path answers the
+            # same question (digest-identical — the multichip gate's own
+            # contract); everything else falls through to lane isolation
+            if (mesh is not None and not mesh.empty and not f.transient
+                    and f.code == faults.E_DEVICE_LOST):
+                faults.record_rung("mesh_schedule", "single_device", f.code)
+                return _run_batch(lambda: batched_schedule(
+                    arrs, jnp.asarray(masks), sweep_cfg, mesh=None,
+                    retries=retries, backoff_s=backoff_s,
+                    **({"waves": waves} if waves is not None else {})))
+            raise
+    except Exception as e:
         if not isolate_trials:
             raise
+        faults.record_rung(
+            "batched_schedule", "lane_isolate",
+            e.code if isinstance(e, faults.DeviceFault) else "")
 
     s = masks.shape[0]
     alloc = np.asarray(arrs.alloc)
@@ -718,6 +768,8 @@ def _execute_sweep(arrs, masks, sweep_cfg, mesh, fail_reasons,
             out_i = run_with_retries(
                 lambda: batched_schedule(arrs, jnp.asarray(masks[si:si + 1]),
                                          sweep_cfg, mesh=None,
+                                         retries=retries,
+                                         backoff_s=backoff_s,
                                          **({"waves": waves}
                                             if waves is not None else {})),
                 retries=retries, backoff_s=backoff_s)

@@ -72,7 +72,7 @@ from open_simulator_tpu.replay.trace import (
     parse_node_template,
 )
 from open_simulator_tpu.replay.controllers import controllers_digest
-from open_simulator_tpu.resilience import lifecycle
+from open_simulator_tpu.resilience import faults, lifecycle
 
 _log = logging.getLogger(__name__)
 
@@ -488,7 +488,9 @@ class _World:
     def full_scan(self, cfg=None, forced: Optional[np.ndarray] = None):
         """The defining semantics: scan the whole (padded) universe with
         the step's forced column. Same shapes every step -> one compiled
-        executable for the whole trajectory."""
+        executable for the whole trajectory. Runs inside the device
+        fault domain (fn="replay_step"): transients retry, classified
+        faults surface structured."""
         import jax.numpy as jnp
 
         from open_simulator_tpu.engine.scheduler import schedule_pods
@@ -498,11 +500,15 @@ class _World:
             prog.dev_master,
             forced_node=jnp.asarray(self._forced_pad(
                 self.step_forced() if forced is None else forced)))
-        out = schedule_pods(arrs, jnp.asarray(self._active_pad()),
-                            cfg or prog.cfg,
-                            hoist_forced=prog.hoist_forced)
-        self.carry = out.state
-        return np.asarray(out.node)[: prog.P]
+
+        def fire():
+            out = schedule_pods(arrs, jnp.asarray(self._active_pad()),
+                                cfg or prog.cfg,
+                                hoist_forced=prog.hoist_forced)
+            return out.state, np.asarray(out.node)[: prog.P]
+
+        self.carry, assign = faults.run_launch("replay_step", fire)
+        return assign
 
     def slice_scan(self, start: int, stop: int):
         """The carry fast path: schedule ONLY pods [start:stop) against
@@ -522,12 +528,23 @@ class _World:
         sl = slice_pods(prog.host_master, start, stop)
         _, pb = exec_cache.bucket_shape(prog.N_pad, stop - start)
         sl = exec_cache.pad_snapshot_arrays(sl, prog.N_pad, int(pb))
-        out = schedule_pods(
-            jax.tree_util.tree_map(jnp.asarray, sl),
-            jnp.asarray(self._active_pad()), prog.cfg,
-            state=self.carry, state_is_fresh=False)
-        self.carry = out.state  # the old carry was donated: it is dead
-        return np.asarray(out.node)[: stop - start]
+        # NO transient retries here (retries=0): the previous carry is
+        # DONATED to the first attempt, so a re-run cannot be proven
+        # exact — any fault, transient or not, falls back to the
+        # defining full scan in settle_step (which needs no carry)
+        carry = self.carry
+        self.carry = None  # donated below: dead either way
+
+        def fire():
+            out = schedule_pods(
+                jax.tree_util.tree_map(jnp.asarray, sl),
+                jnp.asarray(self._active_pad()), prog.cfg,
+                state=carry, state_is_fresh=False)
+            return out.state, np.asarray(out.node)[: stop - start]
+
+        self.carry, assign = faults.run_launch("replay_step", fire,
+                                               retries=0)
+        return assign
 
     def update_bound(self, assign: np.ndarray,
                      lo: int = 0, hi: Optional[int] = None) -> None:
@@ -747,9 +764,21 @@ def settle_step(prog: "_Program", world: "_World", controllers, ev: TraceEvent,
         and stop > start and prog.cfg.tie_break_seed == 0
         and not prog.cfg.extensions)
     if fast_ok:
-        world.update_bound(world.slice_scan(start, stop),
-                           lo=start, hi=stop)
-        steps_total.labels(path="slice").inc()
+        try:
+            world.update_bound(world.slice_scan(start, stop),
+                               lo=start, hi=stop)
+            steps_total.labels(path="slice").inc()
+        except faults.DeviceFault as f:
+            # fast-path -> full-scan rung: the defining semantics IS the
+            # full re-scan (the fast path is only ever an optimization
+            # proven bit-identical to it), so a device fault on the
+            # donated-carry slice launch degrades to the full scan from
+            # fresh state — the settled row, journal line and trajectory
+            # digest are identical to a healthy step
+            faults.record_rung("replay_step", "full_scan", f.code)
+            world.carry = None
+            world.update_bound(world.full_scan())
+            steps_total.labels(path="full").inc()
     elif ev.kind == "arrive" and stop == start:
         steps_total.labels(path="noop").inc()  # empty batch
     else:

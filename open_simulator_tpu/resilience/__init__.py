@@ -13,7 +13,12 @@ Three parts (ARCHITECTURE.md "Resilience layer"):
              re-simulated through the engine's active-node mask, emitting
              a deterministic DisruptionReport
   retry      retry-with-backoff (full jitter, elapsed-time cap) around
-             flaky device execution
+             flaky device execution; retries only what the device fault
+             classifier calls transient
+  faults     the device fault domain: runtime-failure classifier
+             (E_DEVICE_OOM/E_DEVICE_LOST/E_TRANSFER/E_NUMERIC/E_COMPILE,
+             transient vs deterministic), per-site degradation ladders,
+             and the SIMON_FAULT_PLAN deterministic fault injection
   lifecycle  survivable serving: bounded admission queue with EWMA
              Retry-After, per-request CancelToken deadlines observed at
              sweep-round/chaos-event boundaries, sweep checkpoint
@@ -47,6 +52,15 @@ from open_simulator_tpu.resilience.lifecycle import (  # noqa: F401
     cancel_scope,
     check_current,
     current_token,
+)
+from open_simulator_tpu.resilience.faults import (  # noqa: F401
+    DeviceFault,
+    FaultPlan,
+    check_finite,
+    classify,
+    install_plan,
+    is_transient,
+    run_launch,
 )
 from open_simulator_tpu.resilience.retry import (  # noqa: F401
     backoff_delay,

@@ -262,11 +262,21 @@ def _run_chaos_inner(
 
     wave_plan = waves_for(snapshot.arrays, cfg, n_pods_total=n_pods_pad)
 
+    from open_simulator_tpu.resilience import faults
+
     with span("chaos.baseline"):
-        out0 = schedule_pods(
-            arrs, jnp.asarray(exec_cache.pad_vector(active, n_nodes_pad, False)),
-            cfg, waves=wave_plan)
-        assign = np.asarray(out0.node)[:n_pods_real]
+        def baseline(wp):
+            out0 = schedule_pods(
+                arrs,
+                jnp.asarray(exec_cache.pad_vector(active, n_nodes_pad,
+                                                  False)),
+                cfg, waves=wp)
+            return np.asarray(out0.node)[:n_pods_real]
+
+        # the shared waves -> scan rung: bit-identical by the wave
+        # contract (event re-scans below never carry a plan)
+        assign, wave_plan = faults.run_wave_launch("schedule_pods",
+                                                   baseline, wave_plan)
     report = DisruptionReport(
         total_pods=snapshot.n_pods,
         baseline_unschedulable=int(np.sum(assign < 0)),
@@ -303,11 +313,15 @@ def _run_chaos_inner(
             arrs, forced_node=jnp.asarray(
                 exec_cache.pad_vector(forced, n_pods_pad, -4)))
         with span("chaos.event", kind=ev.kind, target=ev.target):
-            out = schedule_pods(
-                arrs_ev,
-                jnp.asarray(exec_cache.pad_vector(active, n_nodes_pad, False)),
-                cfg)
-            new_assign = np.asarray(out.node)[:n_pods_real]
+            def event_scan():
+                out = schedule_pods(
+                    arrs_ev,
+                    jnp.asarray(exec_cache.pad_vector(active, n_nodes_pad,
+                                                      False)),
+                    cfg)
+                return np.asarray(out.node)[:n_pods_real]
+
+            new_assign = faults.run_launch("schedule_pods", event_scan)
 
         replaced = {
             snapshot.pods[i].key: node_names[int(new_assign[i])]

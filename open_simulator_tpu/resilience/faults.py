@@ -1,0 +1,583 @@
+"""Device fault domain: classified runtime-failure recovery + injection.
+
+Every device launch in the stack (the exec-cache executables, the
+singleton ``schedule_pods`` scans, sweep rounds, serving coalesced
+batches, campaign fleet lanes, replay/session steps, tune rounds) runs
+inside this domain (ARCHITECTURE.md §18). Three pieces:
+
+**Classifier** (``classify`` / ``is_transient``): maps raised exceptions
+to a structured taxonomy and tags each class *transient* (worth a
+retry: the fault is about the moment, not the program) or
+*deterministic* (retrying the identical launch reproduces it — the
+degradation ladder, not the retry budget, is the answer):
+
+  ==============  ===========  ==========================================
+  code            disposition  raised when
+  ==============  ===========  ==========================================
+  E_DEVICE_OOM    determ.      XLA RESOURCE_EXHAUSTED / allocation
+                               failure — the program does not fit; the
+                               same shapes will OOM again
+  E_DEVICE_LOST   determ.      device lost / TPU slice preempted /
+                               device unavailable — this process will
+                               not get the device back by waiting
+  E_TRANSFER      transient    host<->device transfer trouble, DATA_LOSS,
+                               connection resets — and any bare OSError
+  E_NUMERIC       determ.      NaN/inf detected in decoded outputs (the
+                               ``check_finite`` sentinel scan) or a
+                               FloatingPointError
+  E_COMPILE       determ.      XLA/MLIR compilation or lowering failure
+  ==============  ===========  ==========================================
+
+Unclassified exceptions (``ValueError`` bugs, structured
+``SimulationError``\\ s, cancellation) pass through untouched — the
+domain narrates device trouble, it does not swallow program bugs.
+
+**Degradation ladder**: deterministic faults step down a per-site rung
+sequence instead of burning retries — split a coalesced/lane batch in
+half and re-launch (serving groups, fleet lanes, tune rounds), drop
+resident snapshots / evict the AOT executable cache and re-encode on
+OOM, fall back mesh→single-device on device loss, and finally
+waves→scan / lanes→serial. Every rung is metric-counted
+(``simon_fault_rungs_total``) and ledger-recorded (``record_rung``), and
+every rung's output is ledger-digest-identical to the healthy path —
+the degraded answer is the same answer, later.
+
+**Deterministic injection** (``SIMON_FAULT_PLAN`` / ``install_plan``):
+"fail launch #k of fn F with exception class E, n times" — the same
+move ``ChaosPlan`` made for cluster faults, applied to the runtime
+boundary, so every rung and every retry schedule is reproducibly
+testable. Grammar (rules split on ``;``, fields on ``,``)::
+
+    fn=<name>,exc=<kind>[,launch=<k>][,times=<n>]
+
+``fn`` is a known launch-site name (``KNOWN_FNS``), ``exc`` one of
+``oom | device_lost | transfer | numeric | compile``, ``launch`` the
+0-based launch counter for that fn (a retry is a new launch; default
+0), ``times`` how many consecutive launches fail (default 1). Injected
+exceptions carry realistic runtime messages so they take the SAME
+classifier path as real faults — injection tests the ladder, it does
+not shortcut it. Malformed plans are structured ``E_SPEC`` errors; a
+valid plan round-trips ``parse(canonical()) == plan`` (digest-stable).
+
+Everything here is HOST machinery (string matching, counters, an env
+read) — nothing runs inside jit/scan scope (graftlint GL4), and the
+healthy-path cost is one module-flag check per launch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import logging
+import os
+import re
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple, TypeVar
+
+from open_simulator_tpu.errors import SimulationError
+
+_log = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+FAULT_PLAN_ENV = "SIMON_FAULT_PLAN"
+
+# device-fault taxonomy codes (documented in errors.py / ARCHITECTURE §18)
+E_DEVICE_OOM = "E_DEVICE_OOM"
+E_DEVICE_LOST = "E_DEVICE_LOST"
+E_TRANSFER = "E_TRANSFER"
+E_NUMERIC = "E_NUMERIC"
+E_COMPILE = "E_COMPILE"
+
+DEVICE_FAULT_CODES = (E_DEVICE_OOM, E_DEVICE_LOST, E_TRANSFER, E_NUMERIC,
+                      E_COMPILE)
+
+# launch-site names a fault plan may target — one per host boundary the
+# domain wraps (a plan naming anything else is a typo, not a no-op)
+KNOWN_FNS = frozenset({
+    "schedule_pods",     # singleton scans: simulate/Simulator/chaos/applier
+    "batched_schedule",  # AOT scenario lanes: sweeps, serving prep, tune
+    "mesh_schedule",     # the GSPMD mesh-sharded lane path
+    "serving_lanes",     # coalesced serving groups (server/serving.py)
+    "fleet_schedule",    # campaign fleet lanes (campaign/lanes.py)
+    "replay_step",       # replay/session step scans (replay/engine.py)
+    "compile",           # AOT lower().compile() boundary (exec_cache)
+})
+
+
+# ---- classification ------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FaultClass:
+    """One taxonomy verdict: the structured code and its disposition."""
+
+    code: str
+    transient: bool
+
+
+_OOM = FaultClass(E_DEVICE_OOM, transient=False)
+_LOST = FaultClass(E_DEVICE_LOST, transient=False)
+_XFER = FaultClass(E_TRANSFER, transient=True)
+_NUM = FaultClass(E_NUMERIC, transient=False)
+_COMP = FaultClass(E_COMPILE, transient=False)
+
+# message patterns, checked in order (an OOM while compiling is an OOM:
+# the ladder's eviction rung is the right response either way)
+_PATTERNS: Tuple[Tuple[re.Pattern, FaultClass], ...] = (
+    (re.compile(r"resource[_ ]exhausted|out of memory|\boom\b|"
+                r"allocation failure|failed to allocate", re.I), _OOM),
+    (re.compile(r"device (?:lost|unavailable|not found|halted)|"
+                r"slice preempted|\bpreempted\b|device is gone|"
+                r"heartbeat.*(?:lost|timeout)", re.I), _LOST),
+    (re.compile(r"\bnan\b|\binf\b|non-?finite", re.I), _NUM),
+    (re.compile(r"compilation|lowering|\bmlir\b|\bhlo\b|"
+                r"compile failed", re.I), _COMP),
+    (re.compile(r"data[_ ]loss|transfer|connection reset|broken pipe|"
+                r"socket closed|\bunavailable\b", re.I), _XFER),
+)
+
+
+class DeviceFault(SimulationError):
+    """A classified device/runtime failure, structured for every surface
+    (CLI error exit, REST 5xx body, campaign quarantine). ``transient``
+    records the disposition at classification time; a transient
+    DeviceFault raised out of ``run_launch`` means its retry budget is
+    spent (the wrapped retries already happened)."""
+
+    code = E_TRANSFER
+
+    def __init__(self, message: str, code: str, transient: bool,
+                 fn: str = "", hint: str = ""):
+        super().__init__(message, code=code, ref=f"device/{fn}" if fn
+                         else "device", hint=hint)
+        self.transient = bool(transient)
+        self.fn = fn
+
+
+def classify(exc: BaseException) -> Optional[FaultClass]:
+    """Map an exception to its device-fault class, or None when it is
+    not device trouble (structured errors, cancellation, plain program
+    bugs). A ``DeviceFault`` classifies as itself, so nested fault
+    domains (a launch inside a ladder rung) compose."""
+    if isinstance(exc, DeviceFault):
+        return FaultClass(exc.code, exc.transient)
+    if isinstance(exc, SimulationError):
+        return None  # already structured (incl. CancelledError)
+    if isinstance(exc, FloatingPointError):
+        return _NUM
+    if not isinstance(exc, (RuntimeError, OSError)):
+        return None  # ValueError/TypeError/...: a bug, not the device
+    msg = str(exc)
+    for pat, fc in _PATTERNS:
+        if pat.search(msg):
+            return fc
+    if isinstance(exc, OSError):
+        # bare OSErrors around device/file transport are the classic
+        # transient (NFS hiccup, socket teardown) — retry-worthy
+        return _XFER
+    return None
+
+
+def is_transient(exc: BaseException) -> bool:
+    """The retry predicate (``retry.run_with_retries``' default): retry
+    only faults the classifier calls transient. Deterministic classes
+    and unclassified exceptions re-raise on attempt 0 — retrying a
+    reproducible failure wastes the budget and masks the root cause.
+
+    An escalated ``DeviceFault`` is never retry-worthy, even when its
+    CLASS is transient: ``run_launch`` only raises one after spending
+    the launch's own retry budget, so an outer retry layer re-retrying
+    it would multiply device launches (inner × outer) and bury the real
+    attempt count. ``classify`` still reports its class — ladders read
+    the disposition from the fault itself."""
+    if isinstance(exc, DeviceFault):
+        return False
+    fc = classify(exc)
+    return fc is not None and fc.transient
+
+
+# ---- metrics + ledger ----------------------------------------------------
+
+
+def _metrics():
+    from open_simulator_tpu import telemetry
+
+    return (
+        telemetry.counter(
+            "simon_fault_injected_total",
+            "faults injected by the active SIMON_FAULT_PLAN, per launch fn",
+            labelnames=("fn",)),
+        telemetry.counter(
+            "simon_fault_classified_total",
+            "device faults escalated out of a launch's retry loop, by "
+            "taxonomy code and disposition",
+            labelnames=("fn", "code", "disposition")),
+        telemetry.counter(
+            "simon_fault_rungs_total",
+            "degradation-ladder rungs taken after deterministic device "
+            "faults (each rung's output is digest-identical to the "
+            "healthy path)",
+            labelnames=("fn", "rung")),
+    )
+
+
+def record_fault(fn: str, fc: FaultClass) -> None:
+    """Count one classified fault escaping a launch boundary."""
+    _metrics()[1].labels(
+        fn=fn, code=fc.code,
+        disposition="transient" if fc.transient else "deterministic").inc()
+
+
+def record_rung(fn: str, rung: str, code: str = "") -> None:
+    """Count + ledger-record one degradation-ladder rung. The ledger
+    event is the persistent witness the smoke/tests read back: which
+    launch degraded, which rung caught it, for which fault code."""
+    from open_simulator_tpu.telemetry import ledger
+
+    _metrics()[2].labels(fn=fn, rung=rung).inc()
+    ledger.append_event("fault", tags={"fn": fn, "rung": rung,
+                                       "code": code})
+    _log.warning("device fault domain: %s degraded via rung %r (%s)",
+                 fn, rung, code or "unclassified")
+
+
+# ---- numeric sentinel scan -----------------------------------------------
+
+
+def check_finite(fn: str, **arrays: Any) -> None:
+    """NaN/inf sentinel scan over decoded (hosted) float outputs: a NaN
+    escaping a fused score would otherwise flow silently into verdicts
+    and digests. Raises a deterministic ``E_NUMERIC`` DeviceFault naming
+    the first offending array; integer arrays pass through untouched."""
+    import numpy as np
+
+    for name, x in arrays.items():
+        if x is None:
+            continue
+        x = np.asarray(x)
+        if not np.issubdtype(x.dtype, np.floating):
+            continue
+        if not bool(np.isfinite(x).all()):
+            bad = int(np.size(x) - np.count_nonzero(np.isfinite(x)))
+            raise DeviceFault(
+                f"non-finite values (NaN/inf) in decoded output "
+                f"{name!r}: {bad} element(s)", code=E_NUMERIC,
+                transient=False, fn=fn,
+                hint="a fused score or carry update produced NaN; the "
+                     "degraded re-launch (waves off / split batch) "
+                     "isolates the producer")
+
+
+# ---- deterministic fault-injection plan ----------------------------------
+
+
+_EXC_KINDS = ("oom", "device_lost", "transfer", "numeric", "compile")
+
+# injected exceptions carry realistic runtime messages so the classifier
+# (and therefore the ladder) treats them exactly like real faults
+_EXC_FACTORIES: Dict[str, Callable[[str], BaseException]] = {
+    "oom": lambda fn: RuntimeError(
+        f"RESOURCE_EXHAUSTED: out of memory while trying to allocate "
+        f"device buffers for {fn} (SIMON_FAULT_PLAN injected)"),
+    "device_lost": lambda fn: RuntimeError(
+        f"UNAVAILABLE: device lost: TPU slice preempted during {fn} "
+        f"(SIMON_FAULT_PLAN injected)"),
+    "transfer": lambda fn: OSError(
+        f"DATA_LOSS: failed to transfer buffer to device during {fn} "
+        f"(SIMON_FAULT_PLAN injected)"),
+    "numeric": lambda fn: FloatingPointError(
+        f"non-finite values (NaN) detected in {fn} outputs "
+        f"(SIMON_FAULT_PLAN injected)"),
+    "compile": lambda fn: RuntimeError(
+        f"XLA compilation failure lowering {fn} "
+        f"(SIMON_FAULT_PLAN injected)"),
+}
+
+
+def _plan_error(msg: str, field: str, hint: str = "") -> SimulationError:
+    return SimulationError(
+        msg, code="E_SPEC", ref="fault_plan", field=field,
+        hint=hint or "grammar: fn=<name>,exc=<kind>[,launch=<k>]"
+                     "[,times=<n>] rules joined by ';'")
+
+
+@dataclass(frozen=True)
+class FaultRule:
+    """Fail launches [launch, launch+times) of ``fn`` with ``exc``."""
+
+    fn: str
+    exc: str
+    launch: int = 0
+    times: int = 1
+
+    def canonical(self) -> str:
+        return (f"fn={self.fn},exc={self.exc},launch={self.launch},"
+                f"times={self.times}")
+
+    def matches(self, fn: str, count: int) -> bool:
+        return (fn == self.fn
+                and self.launch <= count < self.launch + self.times)
+
+    def make_exc(self) -> BaseException:
+        return _EXC_FACTORIES[self.exc](self.fn)
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """A parsed, validated injection plan (ordered rules)."""
+
+    rules: Tuple[FaultRule, ...] = ()
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultPlan":
+        """Parse the ``SIMON_FAULT_PLAN`` grammar. Every malformation —
+        unknown fn, bogus exception class, negative counts, truncated
+        rules — is a structured ``E_SPEC`` naming ``rules[i].<field>``,
+        never a traceback (the plan fuzz holds this)."""
+        if not isinstance(text, str):
+            raise _plan_error(
+                f"fault plan must be a string, got {type(text).__name__}",
+                "plan")
+        rules = []
+        chunks = [c for c in (r.strip() for r in text.split(";")) if c]
+        if not chunks:
+            raise _plan_error("fault plan has no rules", "rules",
+                              hint="e.g. fn=serving_lanes,exc=oom,times=2")
+        for i, chunk in enumerate(chunks):
+            fields: Dict[str, str] = {}
+            for part in (p.strip() for p in chunk.split(",")):
+                if not part:
+                    continue
+                if "=" not in part:
+                    raise _plan_error(
+                        f"rule fragment {part!r} is not key=value "
+                        f"(truncated rule?)", f"rules[{i}]")
+                k, v = part.split("=", 1)
+                k, v = k.strip(), v.strip()
+                if k not in ("fn", "exc", "launch", "times"):
+                    raise _plan_error(f"unknown rule field {k!r}",
+                                      f"rules[{i}].{k}",
+                                      hint="fields: fn, exc, launch, times")
+                if k in fields:
+                    raise _plan_error(f"duplicate rule field {k!r}",
+                                      f"rules[{i}].{k}")
+                fields[k] = v
+            fn = fields.get("fn", "")
+            if not fn:
+                raise _plan_error("rule has no fn=", f"rules[{i}].fn")
+            if fn not in KNOWN_FNS:
+                raise _plan_error(
+                    f"unknown launch fn {fn!r}", f"rules[{i}].fn",
+                    hint="known fns: " + ", ".join(sorted(KNOWN_FNS)))
+            exc = fields.get("exc", "")
+            if exc not in _EXC_KINDS:
+                raise _plan_error(
+                    f"unknown exception class {exc!r}", f"rules[{i}].exc",
+                    hint="one of: " + ", ".join(_EXC_KINDS))
+
+            def _int(name: str, default: int, minimum: int) -> int:
+                raw = fields.get(name)
+                if raw is None:
+                    return default
+                try:
+                    v = int(raw)
+                except ValueError:
+                    raise _plan_error(
+                        f"{name} must be an integer, got {raw!r}",
+                        f"rules[{i}].{name}") from None
+                if v < minimum:
+                    raise _plan_error(
+                        f"{name} must be >= {minimum}, got {v}",
+                        f"rules[{i}].{name}")
+                return v
+
+            rules.append(FaultRule(fn=fn, exc=exc,
+                                   launch=_int("launch", 0, 0),
+                                   times=_int("times", 1, 1)))
+        return cls(rules=tuple(rules))
+
+    def canonical(self) -> str:
+        """The normalized plan text: ``parse(canonical())`` yields an
+        equal plan (the round-trip/digest contract)."""
+        return ";".join(r.canonical() for r in self.rules)
+
+    def digest(self) -> str:
+        return hashlib.sha256(self.canonical().encode()).hexdigest()[:12]
+
+
+class _Injector:
+    """Per-process launch counters + the active plan (thread-safe)."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._counts: Dict[str, int] = {}
+        self._injected: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def fire(self, fn: str) -> None:
+        with self._lock:
+            count = self._counts.get(fn, 0)
+            self._counts[fn] = count + 1
+            rule = next((r for r in self.plan.rules
+                         if r.matches(fn, count)), None)
+            if rule is not None:
+                self._injected[fn] = self._injected.get(fn, 0) + 1
+        if rule is not None:
+            _metrics()[0].labels(fn=fn).inc()
+            _log.info("fault plan: injecting %s into %s launch #%d",
+                      rule.exc, fn, count)
+            raise rule.make_exc()
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {"launches": dict(self._counts),
+                    "injected": dict(self._injected)}
+
+
+# module injection state: None until the env is read (or a plan is
+# installed); False = env read, no plan (the permanent healthy fast path)
+_injector: Any = None
+_injector_lock = threading.Lock()
+
+
+def _resolve_injector():
+    global _injector
+    if _injector is not None:
+        return _injector
+    with _injector_lock:
+        if _injector is None:
+            text = os.environ.get(FAULT_PLAN_ENV, "").strip()
+            if not text:
+                _injector = False
+            else:
+                try:
+                    _injector = _Injector(FaultPlan.parse(text))
+                    _log.warning(
+                        "fault injection ACTIVE (%s): %s", FAULT_PLAN_ENV,
+                        _injector.plan.canonical())
+                except SimulationError as e:
+                    # a typo'd plan in a serving env must not poison
+                    # every launch: injection is a test rig, the server
+                    # keeps serving — the CLI flag validates eagerly
+                    _log.error("ignoring malformed %s (%s); fault "
+                               "injection disabled", FAULT_PLAN_ENV, e)
+                    _injector = False
+    return _injector
+
+
+def install_plan(plan: Any) -> None:
+    """Install an injection plan (a ``FaultPlan``, a plan string, or
+    None to clear — clearing also forgets the env read, so the next
+    launch re-reads ``SIMON_FAULT_PLAN``). The test/CLI hook; a string
+    that fails to parse raises the structured ``E_SPEC`` eagerly."""
+    global _injector
+    if plan is None:
+        with _injector_lock:
+            _injector = None
+        return
+    if isinstance(plan, str):
+        plan = FaultPlan.parse(plan)
+    with _injector_lock:
+        _injector = _Injector(plan)
+
+
+@contextlib.contextmanager
+def injected(plan: Any):
+    """Context manager: install a plan for the scope, restore after
+    (the tier-1 rung tests' hook)."""
+    global _injector
+    with _injector_lock:
+        prev = _injector
+    install_plan(plan)
+    try:
+        yield
+    finally:
+        with _injector_lock:
+            _injector = prev
+
+
+def injection_stats() -> Dict[str, Dict[str, int]]:
+    """Launch + injected counters per fn (empty when no plan is live) —
+    what the smoke asserts against the plan."""
+    inj = _resolve_injector()
+    return inj.stats() if inj else {"launches": {}, "injected": {}}
+
+
+def maybe_inject(fn: str) -> None:
+    """The per-launch injection point: counts the launch and raises the
+    planned exception when a rule matches. One flag check when no plan
+    is configured (the permanent healthy path)."""
+    inj = _resolve_injector()
+    if inj:
+        inj.fire(fn)
+
+
+# ---- the launch wrapper --------------------------------------------------
+
+
+def run_launch(fn: str, launch: Callable[[], T], *, retries: int = 2,
+               backoff_s: float = 0.05, max_backoff_s: float = 2.0,
+               jitter: bool = True, max_elapsed_s: Optional[float] = None,
+               rng: Any = None) -> T:
+    """Run one device launch inside the fault domain.
+
+    * the active injection plan fires first (a retry is a new launch);
+    * transient-classified failures retry with FULL JITTER by default
+      (``retry.run_with_retries`` under the classifier predicate) — a
+      fleet of workers hitting the same transient must not re-launch in
+      lockstep; deterministic ones re-raise on attempt 0;
+    * whatever escapes is wrapped into a structured ``DeviceFault``
+      (metric-counted) when the classifier recognizes it — callers
+      catch ``DeviceFault`` to walk their degradation ladder, and a
+      fault that outlives the ladder still reaches the surface as a
+      structured error, never a bare traceback.
+
+    Unclassified exceptions and ``SimulationError``\\ s (cancellation
+    included) pass through untouched."""
+    from open_simulator_tpu.resilience.retry import run_with_retries
+
+    def attempt() -> T:
+        maybe_inject(fn)
+        return launch()
+
+    try:
+        return run_with_retries(
+            attempt, retries=retries, backoff_s=backoff_s,
+            max_backoff_s=max_backoff_s, jitter=jitter, rng=rng,
+            max_elapsed_s=max_elapsed_s)
+    except SimulationError:
+        raise  # structured already (nested DeviceFault, cancellation)
+    except Exception as e:  # noqa: BLE001 — classify, wrap or re-raise
+        fc = classify(e)
+        if fc is None:
+            raise
+        record_fault(fn, fc)
+        raise DeviceFault(
+            f"{type(e).__name__}: {e}", code=fc.code,
+            transient=fc.transient, fn=fn,
+            hint=("transient retries exhausted" if fc.transient else
+                  "deterministic device fault: the degradation ladder "
+                  "was the recovery path")) from e
+
+
+def run_wave_launch(fn: str, launch_with_plan: Callable[[Any], T],
+                    wave_plan: Any) -> Tuple[T, Any]:
+    """``run_launch`` with the waves -> scan degradation rung, shared by
+    every wave-eligible singleton scan (simulate, Simulator, the chaos
+    baseline): the wave-batched program is an optimization proven
+    bit-identical to scan order, so a deterministic fault inside it (a
+    NaN in the batched step, an OOM on the wider wave tensors) degrades
+    to the sequential scan — same assignments, same digest. Returns
+    ``(result, effective_plan)``: the plan is ``None`` after a
+    degradation so callers thread the degraded mode into later passes
+    and the wave decode."""
+    try:
+        return run_launch(fn, lambda: launch_with_plan(wave_plan)), \
+            wave_plan
+    except DeviceFault as f:
+        if f.transient or wave_plan is None:
+            raise
+        record_rung(fn, "scan_fallback", f.code)
+        return run_launch(fn, lambda: launch_with_plan(None)), None

@@ -5,6 +5,16 @@ neighboring process, transport hiccups). A bounded exponential backoff
 turns those into latency instead of failures; persistent errors still
 propagate after the attempts are exhausted so real bugs surface.
 
+**What counts as retryable** is the device fault classifier's call
+(``resilience/faults.py``): by default only *transient*-classed faults
+(transfer trouble, bare OSErrors) retry, and a deterministic-classed
+error — an OOM, a NaN, a compile failure, a plain program bug — is
+re-raised on attempt 0. The old ``retry_on=(Exception,)``
+retry-everything default is DEPRECATED: it burned the whole backoff
+budget re-reproducing deterministic bugs and buried the root cause
+under attempt noise. Callers may still pass an exception-class tuple or
+their own predicate.
+
 Two knobs harden the schedule for fleet use:
 
 * **full jitter** (``jitter=True``): each sleep is drawn uniformly from
@@ -21,9 +31,12 @@ from __future__ import annotations
 
 import random
 import time
-from typing import Callable, Optional, Tuple, Type, TypeVar
+from typing import Callable, Optional, Tuple, Type, TypeVar, Union
 
 T = TypeVar("T")
+
+RetryOn = Union[Tuple[Type[BaseException], ...],
+                Callable[[BaseException], bool]]
 
 
 def backoff_delay(attempt: int, backoff_s: float, max_backoff_s: float,
@@ -39,6 +52,26 @@ def backoff_delay(attempt: int, backoff_s: float, max_backoff_s: float,
     return (rng or random).uniform(0.0, capped)
 
 
+def _retry_predicate(retry_on: Optional[RetryOn]
+                     ) -> Callable[[BaseException], bool]:
+    """Normalize ``retry_on`` to a predicate. ``None`` (the default)
+    resolves to the device-fault classifier's transient test — the
+    replacement for the deprecated retry-everything tuple."""
+    if retry_on is None:
+        from open_simulator_tpu.resilience.faults import is_transient
+
+        return is_transient
+    if isinstance(retry_on, type):
+        # a bare exception class (the old `except retry_on:` form took
+        # one): treat as a one-class tuple — falling through to the
+        # predicate branch would CALL the class, constructing a truthy
+        # instance, and silently retry everything
+        retry_on = (retry_on,)
+    if isinstance(retry_on, tuple):
+        return lambda e: isinstance(e, retry_on)
+    return retry_on
+
+
 def run_with_retries(
     fn: Callable[[], T],
     retries: int = 2,
@@ -47,12 +80,18 @@ def run_with_retries(
     max_elapsed_s: Optional[float] = None,
     jitter: bool = False,
     rng: Optional[random.Random] = None,
-    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    retry_on: Optional[RetryOn] = None,
     sleep: Callable[[float], None] = time.sleep,
 ) -> T:
     """Call fn(); on a retryable exception wait ``backoff_delay(attempt)``
     and try again, up to ``retries`` extra attempts. The last failure is
-    re-raised unchanged.
+    re-raised unchanged; non-retryable exceptions re-raise on attempt 0.
+
+    ``retry_on`` is an exception-class tuple, a predicate
+    ``(exc) -> bool``, or None (default) for the device-fault
+    classifier's transient test (``faults.is_transient``) — the old
+    ``(Exception,)`` retry-everything default is deprecated because it
+    spent the backoff budget reproducing deterministic failures.
 
     ``max_elapsed_s`` caps the loop in wall-clock terms: once the elapsed
     time plus the NEXT planned sleep would exceed it, the loop stops
@@ -69,6 +108,7 @@ def run_with_retries(
     outcomes = counter("simon_retry_total",
                        "retry-with-backoff outcomes around device execution",
                        labelnames=("outcome",))
+    should_retry = _retry_predicate(retry_on)
     t0 = time.monotonic()
     attempt = 0
     while True:
@@ -77,7 +117,9 @@ def run_with_retries(
             if attempt:
                 outcomes.labels(outcome="recovered").inc()
             return result
-        except retry_on:
+        except Exception as e:  # noqa: BLE001 — the predicate decides
+            if not should_retry(e):
+                raise
             if attempt >= retries:
                 outcomes.labels(outcome="exhausted").inc()
                 raise

@@ -107,6 +107,16 @@ STATUS_BY_CODE = {
     "E_NO_RUN": 404,
     "E_NO_SESSION": 404,   # unknown/closed digital-twin session id
     "E_AUDIT": 500,        # the engine's own invariants failed — server bug
+    # device fault domain (resilience/faults.py): classified runtime
+    # failures that outlived the retry schedule AND the degradation
+    # ladder — structured 5xx, never a bare traceback. 503 where another
+    # replica (or a later retry) plausibly answers; 500 where the
+    # program itself is at fault.
+    "E_DEVICE_OOM": 503,
+    "E_DEVICE_LOST": 503,
+    "E_TRANSFER": 503,
+    "E_NUMERIC": 500,
+    "E_COMPILE": 500,
 }
 
 
@@ -366,6 +376,26 @@ class ResidentSnapshotCache:
                 else:
                     busy.add(victim)
             self._gauges()
+
+    def drop_device(self) -> int:
+        """The OOM degradation rung's lever: release EVERY entry's
+        device arrays while keeping the host snapshots (and therefore
+        the digests clients hold) — later requests rehydrate
+        transparently via ``device_arrays``. Entries another thread is
+        mid-touch on are skipped (``try_hold``, the AB-BA rule)."""
+        _, _, _, events, _ = _resident_metrics()
+        with self._guard:
+            entries = list(self._entries.values())
+        dropped = 0
+        for e in entries:
+            with self._mutex.try_hold(e.digest) as got:
+                if got and e.resident:
+                    e.dev = None
+                    e.device_bytes = 0
+                    events.labels(event="eviction").inc()
+                    dropped += 1
+        self._gauges()
+        return dropped
 
     def drop_all(self) -> None:
         """Release every entry (drain/tests); gauges drain to 0."""
@@ -679,20 +709,38 @@ def execute_group(jobs: List[Any]) -> None:
     a member whose token cancelled mid-launch gets its own 504, a
     decode/audit failure its own structured error — siblings are
     answered normally, from the same hosted tensors their singleton
-    runs would produce."""
-    import jax.numpy as jnp
+    runs would produce.
 
-    from open_simulator_tpu.engine.exec_cache import run_batched_cached
-    from open_simulator_tpu.resilience.retry import run_with_retries
-    from open_simulator_tpu.telemetry.spans import span
-
+    Device faults walk the degradation ladder (resilience/faults.py,
+    ARCHITECTURE.md §18): transients already retried inside the launch
+    wrapper; a deterministic OOM drops every resident snapshot + the
+    AOT executable cache and re-launches from a re-encoded transfer
+    (``resident_drop``); any other deterministic fault splits the
+    coalesced batch in half and re-launches each side
+    (``batch_split``), so one poisoned member degrades to its own
+    structured 5xx while the siblings still answer 200 with digests
+    identical to their singleton runs."""
     members: List[PreparedLanes] = [j.payload for j in jobs]
-    lead = members[0]
-    entry, cache = lead.entry, lead.cache
     _, _, _, _, launches = _resident_metrics()
     launches.labels(
         kind="coalesced" if len(members) > 1 else "singleton").inc()
+    _run_group(list(jobs), members)
 
+
+def _launch_group(members: List[PreparedLanes]
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """One batched launch for ``members``: device arrays (rehydrating),
+    lane-axis bucketing, the launch through the fault domain, hosting,
+    and the E_NUMERIC sentinel scan. Returns
+    (nodes, headroom, vg_used, masks_pad)."""
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.engine.exec_cache import run_batched_cached
+    from open_simulator_tpu.resilience import faults
+    from open_simulator_tpu.telemetry.spans import span
+
+    lead = members[0]
+    entry, cache = lead.entry, lead.cache
     masks_pad = _pad_masks(
         np.concatenate([m.masks for m in members], axis=0), entry.n_pad)
     # bucket the LANE axis too: the lane count is part of the compile
@@ -709,33 +757,75 @@ def execute_group(jobs: List[Any]) -> None:
             [masks_pad, np.repeat(masks_pad[:1], bucket - lanes, axis=0)],
             axis=0)
 
-    try:
-        arrs = cache.device_arrays(entry)
-        if lead.forced is not None:
-            # forced-column overlay (pod deltas): same shapes + cfg as the
-            # base launch, so the AOT executable is REUSED — overlays are
-            # data, not programs. Overlay groups are singletons by key.
-            pad = np.full(entry.p_pad, SENTINEL, dtype=np.int32)
-            pad[: entry.n_pods] = lead.forced
-            arrs = dataclasses.replace(arrs, forced_node=jnp.asarray(pad))
+    arrs = cache.device_arrays(entry)
+    if lead.forced is not None:
+        # forced-column overlay (pod deltas): same shapes + cfg as the
+        # base launch, so the AOT executable is REUSED — overlays are
+        # data, not programs. Overlay groups are singletons by key.
+        pad = np.full(entry.p_pad, SENTINEL, dtype=np.int32)
+        pad[: entry.n_pods] = lead.forced
+        arrs = dataclasses.replace(arrs, forced_node=jnp.asarray(pad))
 
-        with span("serving.launch", members=len(members), lanes=lanes,
-                  launch_lanes=bucket):
-            out = run_with_retries(
-                lambda: run_batched_cached(arrs, jnp.asarray(masks_launch),
-                                           entry.cfg,
-                                           fn_name="serving_lanes"),
-                retries=2, backoff_s=0.05)
-            nodes = np.asarray(out.node)[:lanes, : entry.n_pods]
-            headroom = np.asarray(out.state.headroom)[:lanes]
-            vg_used = np.asarray(out.state.vg_used)[:lanes]
-    except SimulationError as e:
-        # a whole-launch failure with taxonomy (retries exhausted,
-        # rehydration OOM): every member gets the STRUCTURED body —
-        # letting it escape would render as a bare 500 upstream
+    with span("serving.launch", members=len(members), lanes=lanes,
+              launch_lanes=bucket):
+        # transient retries + the exec-cache OOM rung live inside
+        # run_batched_cached's own fault domain (fn="serving_lanes")
+        out = run_batched_cached(arrs, jnp.asarray(masks_launch),
+                                 entry.cfg, fn_name="serving_lanes")
+        nodes = np.asarray(out.node)[:lanes, : entry.n_pods]
+        headroom = np.asarray(out.state.headroom)[:lanes]
+        vg_used = np.asarray(out.state.vg_used)[:lanes]
+    # a NaN escaping a fused score must become a structured E_NUMERIC
+    # (and walk the batch-split ladder), not flow into lane digests
+    faults.check_finite("serving_lanes", headroom=headroom,
+                        vg_used=vg_used)
+    return nodes, headroom, vg_used, masks_pad
+
+
+def _run_group(jobs: List[Any], members: List[PreparedLanes],
+               resident_dropped: bool = False) -> None:
+    """Launch + decode one (sub)group, walking the degradation ladder on
+    deterministic device faults. Recursion depth is log2(members)."""
+    from open_simulator_tpu.engine.exec_cache import EXEC_CACHE
+    from open_simulator_tpu.resilience import faults
+
+    def answer_all(e: SimulationError) -> None:
+        # a whole-launch failure with taxonomy (retries exhausted, the
+        # ladder dry): every member gets the STRUCTURED body — letting
+        # it escape would render as a bare 500 upstream
         for job in jobs:
             if job.result is None:
                 job.result = (status_for(e), error_payload(e))
+
+    cache = members[0].cache
+    try:
+        nodes, headroom, vg_used, masks_pad = _launch_group(members)
+    except faults.DeviceFault as f:
+        if not f.transient:
+            if f.code == faults.E_DEVICE_OOM and not resident_dropped:
+                # OOM rung: every resident snapshot's device arrays and
+                # every cached executable go; the re-launch re-encodes
+                # (pad + transfer) from the host snapshot — digests are
+                # untouched because the host tables survive
+                faults.record_rung("serving_lanes", "resident_drop",
+                                   f.code)
+                cache.drop_device()
+                EXEC_CACHE.clear()
+                return _run_group(jobs, members, resident_dropped=True)
+            if len(members) > 1:
+                # batch-split rung: isolate the poison by halving — the
+                # healthy half answers 200 with singleton digests, the
+                # poisoned half keeps halving down to one member's own
+                # structured 5xx
+                faults.record_rung("serving_lanes", "batch_split", f.code)
+                half = len(members) // 2
+                _run_group(jobs[:half], members[:half], resident_dropped)
+                _run_group(jobs[half:], members[half:], resident_dropped)
+                return
+        answer_all(f)
+        return
+    except SimulationError as e:
+        answer_all(e)
         return
 
     offset = 0

@@ -139,6 +139,8 @@ class Simulator:
         lcap.set_config(cfg, snapshot=snapshot, arrs=arrs)
         active_np = np.asarray(snapshot.arrays.active)
         preempted_by = None
+        from open_simulator_tpu.resilience import faults
+
         with telemetry.schedule_phase(schedule_pods):
             if self.preemption:
                 from open_simulator_tpu.engine.preemption import run_with_preemption
@@ -150,16 +152,24 @@ class Simulator:
                 def schedule_fn(disabled, nominated):
                     # session re-runs always pass the carried columns,
                     # so waves never apply on this branch (wave_plan is
-                    # None here by the guard above) — pass None literally
-                    return exec_cache.unpad_output(
-                        schedule_pods(
-                            arrs, arrs.active, cfg,
-                            disabled=exec_cache.pad_vector(
-                                disabled, arrs.req.shape[0], False),
-                            nominated=exec_cache.pad_vector(
-                                nominated, arrs.req.shape[0], -1),
-                            waves=None),
-                        n_pods)
+                    # None here by the guard above) — pass None literally.
+                    # Each pass is one device launch in the fault domain;
+                    # block_until_ready keeps async-dispatch faults
+                    # inside the wrapper where they classify.
+                    import jax as _jax
+
+                    return faults.run_launch(
+                        "schedule_pods",
+                        lambda: _jax.block_until_ready(
+                            exec_cache.unpad_output(
+                                schedule_pods(
+                                    arrs, arrs.active, cfg,
+                                    disabled=exec_cache.pad_vector(
+                                        disabled, arrs.req.shape[0], False),
+                                    nominated=exec_cache.pad_vector(
+                                        nominated, arrs.req.shape[0], -1),
+                                    waves=None),
+                                n_pods)))
 
                 out, pre = run_with_preemption(
                     snapshot, active_np, schedule_fn, pdbs,
@@ -172,11 +182,18 @@ class Simulator:
                 preempted_by = dict(self._preempted_by)
                 self._pre_disabled = np.asarray(pre.disabled)
                 self._pre_assign = np.asarray(out.node).astype(np.int32)
+                node_assign = np.asarray(out.node)
             else:
-                out = exec_cache.unpad_output(
-                    schedule_pods(arrs, arrs.active, cfg, waves=wave_plan),
-                    n_pods)
-            node_assign = np.asarray(out.node)  # blocks on device completion
+                def scan(wp):
+                    o = exec_cache.unpad_output(
+                        schedule_pods(arrs, arrs.active, cfg, waves=wp),
+                        n_pods)
+                    return o, np.asarray(o.node)
+
+                # the shared waves -> scan rung: degraded runs are
+                # bit-identical to the wave-batched one
+                (out, node_assign), wave_plan = faults.run_wave_launch(
+                    "schedule_pods", scan, wave_plan)
         with span("decode"):
             result = decode_result(
                 snapshot,

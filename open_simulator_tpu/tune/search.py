@@ -306,7 +306,7 @@ def tune_search(cluster, apps, opts: Optional[TuneOptions] = None,
     from open_simulator_tpu.engine import exec_cache
     from open_simulator_tpu.k8s.loader import make_valid_node
     from open_simulator_tpu.parallel.sweep import batched_schedule
-    from open_simulator_tpu.resilience import lifecycle
+    from open_simulator_tpu.resilience import faults, lifecycle
     from open_simulator_tpu.telemetry import ledger
     from open_simulator_tpu.telemetry.spans import span
 
@@ -372,10 +372,38 @@ def tune_search(cluster, apps, opts: Optional[TuneOptions] = None,
                 "tune", tags={"tune": tune_id, "round": rounds_run,
                               "mode": opts.mode}) as cap:
             with span("tune.round", lanes=lanes, fresh=len(fresh)):
-                out = batched_schedule(arrs, masks, cfg, weights=wmat,
-                                       carry=carry)
-                nodes_out = np.asarray(out.node)[:, :n_pods]
-                carry = out.state  # donated into the next round
+                try:
+                    out = batched_schedule(arrs, masks, cfg, weights=wmat,
+                                           carry=carry)
+                    nodes_out = np.asarray(out.node)[:, :n_pods]
+                    carry = out.state  # donated into the next round
+                except lifecycle.CancelledError:
+                    raise
+                except faults.DeviceFault as f:
+                    if f.transient or lanes == 1:
+                        raise  # retries spent / nothing left to split
+                    # batch-split rung: re-run this round's fresh
+                    # vectors as two half-width launches. Each lane's
+                    # outputs are lane-independent (no cross-lane ops
+                    # under vmap), so the evaluated points — and the
+                    # report digest — are identical to the full-width
+                    # round. The previous carry may have been consumed
+                    # by the failed launch, so the halves (and the next
+                    # round) start from fresh zeros — value-identical,
+                    # the executable resets donated carries anyway.
+                    faults.record_rung("tune_round", "batch_split",
+                                       f.code)
+                    half = max(1, lanes // 2)
+                    rows = []
+                    for lo in range(0, len(fresh), half):
+                        seg = fresh[lo: lo + half]
+                        wm = np.stack(seg + [seg[-1]] * (half - len(seg)))
+                        out = batched_schedule(arrs, masks[:half], cfg,
+                                               weights=wm)
+                        rows.append(
+                            np.asarray(out.node)[: len(seg), :n_pods])
+                    nodes_out = np.concatenate(rows, axis=0)
+                    carry = None
             if cap.recording:
                 cap.set_config(cfg, snapshot=snapshot, arrs=arrs)
                 best = min(int(np.sum(nodes_out[i] < 0))

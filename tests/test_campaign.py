@@ -111,8 +111,10 @@ def test_audit_violation_quarantines_with_e_audit(fleet_dir, no_checkpoint,
 
 def test_transient_failures_retry_with_history(fleet_dir, no_checkpoint,
                                                monkeypatch):
-    """RuntimeError (the XlaRuntimeError base) is transient: retried with
-    full jitter; persistent ones quarantine with the attempt count."""
+    """Only classifier-transient failures (resilience/faults.py) spend
+    the retry budget; persistent transients quarantine with the attempt
+    count, and a deterministic-classed fault quarantines on attempt 1
+    instead of being retried like a transient."""
     calls = {"n": 0}
     real_simulate = simulate
 
@@ -120,7 +122,8 @@ def test_transient_failures_retry_with_history(fleet_dir, no_checkpoint,
         if cluster.nodes[0].name.startswith("cluster-00"):
             calls["n"] += 1
             if calls["n"] == 1:
-                raise RuntimeError("transient device hiccup")
+                # E_TRANSFER-classed: the retry-worthy class
+                raise OSError("DATA_LOSS: failed to transfer buffer")
         return real_simulate(cluster, apps, **kw)
 
     monkeypatch.setattr("open_simulator_tpu.core.simulate", flaky)
@@ -132,7 +135,7 @@ def test_transient_failures_retry_with_history(fleet_dir, no_checkpoint,
 
     def always_down(cluster, apps, **kw):
         if cluster.nodes[0].name.startswith("cluster-00"):
-            raise RuntimeError("device is gone")
+            raise OSError("connection reset by peer")
         return real_simulate(cluster, apps, **kw)
 
     monkeypatch.setattr("open_simulator_tpu.core.simulate", always_down)
@@ -143,6 +146,25 @@ def test_transient_failures_retry_with_history(fleet_dir, no_checkpoint,
                 if q["cluster"] == "cluster-00")
     assert quar["error"]["code"] == "E_INTERNAL"
     assert quar["attempts"] == 3 and quar["transient_retries"] == 2
+
+    # the satellite's point: a deterministic fault (an OOM) must NOT
+    # burn the retry budget reproducing itself — one attempt, quarantined
+    det_calls = {"n": 0}
+
+    def oom(cluster, apps, **kw):
+        if cluster.nodes[0].name.startswith("cluster-00"):
+            det_calls["n"] += 1
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        return real_simulate(cluster, apps, **kw)
+
+    monkeypatch.setattr("open_simulator_tpu.core.simulate", oom)
+    report = run_campaign(CampaignOptions(fleet=fleet_dir,
+                                          checkpoint=False, retries=2,
+                                          backoff_s=0.0))
+    quar = next(q for q in report["quarantined"]
+                if q["cluster"] == "cluster-00")
+    assert det_calls["n"] == 1
+    assert quar["attempts"] == 1 and quar["transient_retries"] == 0
 
 
 def test_cancellation_observed_at_cluster_boundary(fleet_dir,

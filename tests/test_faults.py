@@ -1,0 +1,710 @@
+"""Device fault domain (resilience/faults.py, ARCHITECTURE.md §18).
+
+Covers the ISSUE-14 acceptance criteria:
+
+* classifier taxonomy: transient vs deterministic dispositions, the
+  E_NUMERIC sentinel scan, DeviceFault structure + HTTP status mapping;
+* SIMON_FAULT_PLAN: grammar, canonical round-trip + digest, the
+  50-seed mutation fuzz (structured E_SPEC, never a traceback);
+* every degradation rung exercised under injected faults with the
+  degraded output LEDGER-DIGEST-IDENTICAL to the healthy path:
+  cache_drop (exec cache, OOM), resident_drop + batch_split (serving),
+  mesh -> single-device (sweep), waves -> scan (simulate), fleet-lane
+  batch_split (campaign), tune-round batch_split, replay fast-path ->
+  full-scan;
+* fault-on-first-post-resume-launch leaves the sweep journal intact
+  (the next resume is digest-identical to an uninterrupted run).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu import telemetry
+from open_simulator_tpu.errors import SimulationError
+from open_simulator_tpu.resilience import faults
+from open_simulator_tpu.telemetry import ledger
+
+
+def _rungs():
+    return telemetry.counter("simon_fault_rungs_total",
+                             labelnames=("fn", "rung"))
+
+
+# ---- classifier ----------------------------------------------------------
+
+
+def test_classifier_taxonomy():
+    cases = [
+        (RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating"),
+         faults.E_DEVICE_OOM, False),
+        (RuntimeError("Allocation failure on device 0"),
+         faults.E_DEVICE_OOM, False),
+        (RuntimeError("UNAVAILABLE: device lost: TPU slice preempted"),
+         faults.E_DEVICE_LOST, False),
+        (OSError("DATA_LOSS: failed to transfer buffer"),
+         faults.E_TRANSFER, True),
+        (OSError("connection reset by peer"), faults.E_TRANSFER, True),
+        (OSError("no such file or directory"), faults.E_TRANSFER, True),
+        (FloatingPointError("overflow"), faults.E_NUMERIC, False),
+        (RuntimeError("found NaN in output buffer"),
+         faults.E_NUMERIC, False),
+        (RuntimeError("XLA compilation failure lowering fn"),
+         faults.E_COMPILE, False),
+    ]
+    for exc, code, transient in cases:
+        fc = faults.classify(exc)
+        assert fc is not None, exc
+        assert fc.code == code and fc.transient is transient, (exc, fc)
+        assert faults.is_transient(exc) is transient
+
+    # NOT device trouble: structured errors, cancellation, plain bugs
+    from open_simulator_tpu.resilience import lifecycle
+
+    assert faults.classify(SimulationError("x", code="E_SPEC")) is None
+    assert faults.classify(lifecycle.CancelledError("deadline")) is None
+    assert faults.classify(ValueError("nan")) is None
+    assert faults.classify(RuntimeError("some random engine bug")) is None
+    assert not faults.is_transient(RuntimeError("some random engine bug"))
+
+    # a DeviceFault classifies as itself (nested domains compose)
+    df = faults.DeviceFault("m", code=faults.E_DEVICE_OOM, transient=False,
+                            fn="f")
+    fc = faults.classify(df)
+    assert fc.code == faults.E_DEVICE_OOM and not fc.transient
+
+
+def test_device_fault_is_structured_and_status_mapped():
+    from open_simulator_tpu.server.serving import STATUS_BY_CODE, status_for
+
+    f = faults.DeviceFault("device went away", code=faults.E_DEVICE_LOST,
+                           transient=False, fn="batched_schedule")
+    assert isinstance(f, SimulationError)
+    assert f.to_dict()["code"] == "E_DEVICE_LOST"
+    assert f.ref == "device/batched_schedule"
+    # every taxonomy code maps to an explicit 5xx — no classified device
+    # fault ever renders as an unstructured default
+    for code in faults.DEVICE_FAULT_CODES:
+        assert STATUS_BY_CODE[code] in (500, 502, 503), code
+    assert status_for(f) == 503
+
+
+def test_check_finite_sentinel_scan():
+    faults.check_finite("t", ints=np.arange(4), ok=np.ones(3),
+                        none=None)  # clean: no raise
+    with pytest.raises(faults.DeviceFault) as ei:
+        faults.check_finite("t", ok=np.ones(2),
+                            bad=np.array([[1.0, np.nan], [np.inf, 0.0]]))
+    assert ei.value.code == faults.E_NUMERIC
+    assert not ei.value.transient
+    assert "bad" in str(ei.value) and "2 element(s)" in str(ei.value)
+
+
+# ---- fault plan grammar --------------------------------------------------
+
+
+def test_fault_plan_parse_canonical_digest_roundtrip():
+    plan = faults.FaultPlan.parse(
+        " fn=serving_lanes , exc=oom , times=2 ;fn=compile,exc=compile,"
+        "launch=3")
+    assert plan.rules[0] == faults.FaultRule("serving_lanes", "oom", 0, 2)
+    assert plan.rules[1] == faults.FaultRule("compile", "compile", 3, 1)
+    again = faults.FaultPlan.parse(plan.canonical())
+    assert again == plan
+    assert again.digest() == plan.digest()
+    assert len(plan.digest()) == 12
+
+
+def test_fault_plan_malformed_is_structured():
+    for text, field in [
+        ("", "rules"),
+        ("fn=nope,exc=oom", "rules[0].fn"),
+        ("fn=compile,exc=zap", "rules[0].exc"),
+        ("fn=compile", "rules[0].exc"),
+        ("exc=oom", "rules[0].fn"),
+        ("fn=compile,exc=oom,times=-1", "rules[0].times"),
+        ("fn=compile,exc=oom,times=0", "rules[0].times"),
+        ("fn=compile,exc=oom,launch=-2", "rules[0].launch"),
+        ("fn=compile,exc=oom,launch=x", "rules[0].launch"),
+        ("fn=compile,exc=oom,bogus=1", "rules[0].bogus"),
+        ("fn=compile,exc=oom,fn=compile", "rules[0].fn"),
+        ("garbage", "rules[0]"),
+        ("fn=compile,exc=oom;truncated", "rules[1]"),
+    ]:
+        with pytest.raises(SimulationError) as ei:
+            faults.FaultPlan.parse(text)
+        assert ei.value.code == "E_SPEC", text
+        assert ei.value.field == field, (text, ei.value.field)
+
+
+def _mutate(text: str, rng: random.Random) -> str:
+    """One random mutilation of a valid plan string."""
+    ops = rng.randint(0, 6)
+    if ops == 0:                       # truncate
+        return text[: rng.randint(0, len(text) - 1)]
+    if ops == 1:                       # unknown fn
+        return text.replace("batched_schedule",
+                            rng.choice(["bogus_fn", "", "sched ule"]))
+    if ops == 2:                       # bogus exception class
+        return text.replace("oom", rng.choice(["kaboom", "", "OOM!"]))
+    if ops == 3:                       # negative / non-integer counts
+        return text.replace("times=2",
+                            rng.choice(["times=-3", "times=x", "times="]))
+    if ops == 4:                       # random char damage
+        i = rng.randint(0, len(text) - 1)
+        return text[:i] + rng.choice(";,=#") + text[i + 1:]
+    if ops == 5:                       # drop a random chunk
+        parts = text.split(",")
+        del parts[rng.randint(0, len(parts) - 1)]
+        return ",".join(parts)
+    return text + rng.choice([";", ";fn=", ",times=2", "=", ";;garbage"])
+
+
+def test_fault_plan_fuzz_50_seeds():
+    """Every mutation is either a structured E_SPEC or parses to a plan
+    that round-trips through its canonical form and digest — never a
+    traceback (the ChaosPlan fuzz contract applied to runtime faults)."""
+    valid = ("fn=batched_schedule,exc=oom,launch=1,times=2;"
+             "fn=serving_lanes,exc=transfer")
+    outcomes = {"rejected": 0, "parsed": 0}
+    for seed in range(50):
+        rng = random.Random(seed)
+        text = _mutate(valid, rng)
+        try:
+            plan = faults.FaultPlan.parse(text)
+        except SimulationError as e:
+            assert e.code == "E_SPEC", (text, e)
+            assert e.field.startswith("rules") or e.field == "plan", text
+            outcomes["rejected"] += 1
+            continue
+        again = faults.FaultPlan.parse(plan.canonical())
+        assert again == plan and again.digest() == plan.digest(), text
+        outcomes["parsed"] += 1
+    # the mutation space must actually cover both sides
+    assert outcomes["rejected"] >= 10 and outcomes["parsed"] >= 3, outcomes
+
+
+def test_malformed_env_plan_disables_injection(monkeypatch):
+    """A typo'd SIMON_FAULT_PLAN in a serving environment must not
+    poison every launch: one error log, injection off (the CLI flag is
+    the eager-validation path)."""
+    monkeypatch.setenv(faults.FAULT_PLAN_ENV, "fn=bogus,exc=nope")
+    faults.install_plan(None)  # forget any cached injector + env read
+    try:
+        assert faults.run_launch("schedule_pods", lambda: "ok") == "ok"
+        assert faults.injection_stats() == {"launches": {}, "injected": {}}
+    finally:
+        monkeypatch.delenv(faults.FAULT_PLAN_ENV)
+        faults.install_plan(None)
+
+
+# ---- injection + run_launch ----------------------------------------------
+
+
+def test_injection_counts_and_retry_semantics():
+    inj = telemetry.counter("simon_fault_injected_total",
+                            labelnames=("fn",))
+    b = inj.value(fn="schedule_pods")
+
+    # transient: retried through the backoff schedule, recovered
+    with faults.injected("fn=schedule_pods,exc=transfer,times=2"):
+        out = faults.run_launch("schedule_pods", lambda: "ok",
+                                backoff_s=0.0)
+        assert out == "ok"
+        stats = faults.injection_stats()
+        # a retry is a new launch: 2 injected + 1 clean
+        assert stats["launches"]["schedule_pods"] == 3
+        assert stats["injected"]["schedule_pods"] == 2
+    assert inj.value(fn="schedule_pods") == b + 2
+
+    # deterministic: attempt 0 re-raises as a structured DeviceFault
+    calls = {"n": 0}
+
+    def work():
+        calls["n"] += 1
+        return "ok"
+
+    with faults.injected("fn=schedule_pods,exc=oom,times=99"):
+        with pytest.raises(faults.DeviceFault) as ei:
+            faults.run_launch("schedule_pods", work, backoff_s=0.0)
+        assert faults.injection_stats()["launches"]["schedule_pods"] == 1
+    assert ei.value.code == faults.E_DEVICE_OOM and not ei.value.transient
+    assert calls["n"] == 0  # the injected launch never reached the work
+
+    # transient exhausted: still a structured DeviceFault (retries spent)
+    with faults.injected("fn=schedule_pods,exc=transfer,times=99"):
+        with pytest.raises(faults.DeviceFault) as ei:
+            faults.run_launch("schedule_pods", lambda: "ok", retries=1,
+                              backoff_s=0.0)
+    assert ei.value.code == faults.E_TRANSFER and ei.value.transient
+
+    # unclassified exceptions pass through unwrapped
+    with pytest.raises(ValueError):
+        faults.run_launch("schedule_pods",
+                          lambda: (_ for _ in ()).throw(ValueError("bug")))
+
+
+def test_escalated_transient_not_re_retried_by_outer_layers():
+    """A transient DeviceFault out of run_launch already spent its
+    budget: an outer run_with_retries under the default predicate must
+    NOT multiply launches (inner x outer) by re-retrying it."""
+    from open_simulator_tpu.resilience.retry import run_with_retries
+
+    df = faults.DeviceFault("transfer died", code=faults.E_TRANSFER,
+                            transient=True, fn="batched_schedule")
+    assert df.transient                      # ladders read this
+    assert not faults.is_transient(df)       # retry layers do not
+    calls = {"n": 0}
+
+    def inner_exhausted():
+        calls["n"] += 1
+        raise df
+
+    with pytest.raises(faults.DeviceFault):
+        run_with_retries(inner_exhausted, retries=5, backoff_s=0.0,
+                         sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_fleet_nan_sentinel_real_nan_isolated_or_quarantined(
+        tmp_path, monkeypatch):
+    """A REAL NaN in a fleet launch's hosted state (not an injected
+    exception) must raise E_NUMERIC and walk the batch-split ladder —
+    and at the ladder bottom a still-NaN single lane QUARANTINES with
+    the structured code instead of settling NaN-derived rows through
+    the sentinel-less serial boundary."""
+    import numpy as np
+
+    from open_simulator_tpu.campaign import CampaignOptions, run_campaign
+    from open_simulator_tpu.campaign.fleet import write_synthetic_fleet
+    from open_simulator_tpu.engine import exec_cache
+
+    # 4 clusters -> two same-bucket PAIRS, so the lane path genuinely
+    # launches chunks (a lone remainder would go serial untested)
+    write_synthetic_fleet(str(tmp_path), n_clusters=4, nodes=4, pods=8)
+    serial = run_campaign(CampaignOptions(fleet=str(tmp_path),
+                                          fleet_lanes=False,
+                                          checkpoint=False))
+    real = exec_cache.run_fleet_batched
+    poisoned = {"n": 0}
+
+    def nan_batched_only(arrs_batch, masks, cfg, **kw):
+        # a vmap-path-only NaN: single-lane re-launches come out clean
+        out = real(arrs_batch, masks, cfg, **kw)
+        if int(masks.shape[0]) > 1:
+            poisoned["n"] += 1
+            hr = np.asarray(out.state.headroom).copy()
+            hr[0, 0, 0] = np.nan
+            out = out._replace(state=out.state._replace(headroom=hr))
+        return out
+
+    monkeypatch.setattr(exec_cache, "run_fleet_batched", nan_batched_only)
+    split = run_campaign(CampaignOptions(fleet=str(tmp_path),
+                                         fleet_lanes=True,
+                                         checkpoint=False))
+    assert poisoned["n"] >= 1                 # the sentinel saw the NaN
+    # the split isolated it; every cluster settled, rows identical
+    assert split["digest"] == serial["digest"]
+    assert split["totals"]["quarantined"] == 0
+
+    def nan_always(arrs_batch, masks, cfg, **kw):
+        out = real(arrs_batch, masks, cfg, **kw)
+        hr = np.asarray(out.state.headroom).copy()
+        hr[0, 0, 0] = np.nan
+        return out._replace(state=out.state._replace(headroom=hr))
+
+    monkeypatch.setattr(exec_cache, "run_fleet_batched", nan_always)
+    quarantined = run_campaign(CampaignOptions(fleet=str(tmp_path),
+                                               fleet_lanes=True,
+                                               checkpoint=False))
+    # the ladder bottom: every cluster's single-lane launch still NaNs,
+    # so every cluster carries the structured E_NUMERIC quarantine —
+    # NONE settles as a completed row built from poisoned outputs
+    assert quarantined["totals"]["completed"] == 0
+    codes = {q["error"]["code"] for q in quarantined["quarantined"]}
+    assert codes == {"E_NUMERIC"}, quarantined["quarantined"]
+
+
+# ---- degradation rungs: digest identity under injected faults ------------
+
+
+def test_cache_drop_rung_sweep_digest_identical():
+    """E_DEVICE_OOM on the batched sweep launch: the exec-cache rung
+    evicts every compiled executable and re-launches — plan identical."""
+    from open_simulator_tpu.engine.scheduler import make_config
+    from open_simulator_tpu.parallel import sweep as sweep_mod
+    from open_simulator_tpu.testing.synthetic import synthetic_snapshot
+
+    snap = synthetic_snapshot(n_nodes=4, n_pods=8, max_new=2)
+    cfg = make_config(snap)
+    healthy = sweep_mod.capacity_sweep(snap, cfg, [0, 1, 2], backoff_s=0.0)
+    b = _rungs().value(fn="batched_schedule", rung="cache_drop")
+    with faults.injected("fn=batched_schedule,exc=oom,times=1"):
+        degraded = sweep_mod.capacity_sweep(snap, cfg, [0, 1, 2],
+                                            backoff_s=0.0)
+    assert not degraded.trial_errors
+    assert degraded.satisfied == healthy.satisfied
+    assert degraded.best_count == healthy.best_count
+    assert np.array_equal(degraded.nodes_per_scenario,
+                          healthy.nodes_per_scenario)
+    assert (ledger.plan_digest(degraded)["digest"]
+            == ledger.plan_digest(healthy)["digest"])
+    assert _rungs().value(fn="batched_schedule", rung="cache_drop") == b + 1
+
+
+def test_mesh_single_device_rung_digest_identical():
+    """E_DEVICE_LOST on the mesh-sharded launch falls back to the AOT
+    single-device path — the multichip gate's digest contract, now as a
+    runtime recovery rung."""
+    from open_simulator_tpu.engine.scheduler import make_config
+    from open_simulator_tpu.parallel import sweep as sweep_mod
+    from open_simulator_tpu.testing.synthetic import synthetic_snapshot
+
+    snap = synthetic_snapshot(n_nodes=4, n_pods=8, max_new=2)
+    cfg = make_config(snap)
+    mesh = sweep_mod.make_mesh(n_scenario=1)
+    healthy = sweep_mod.capacity_sweep(snap, cfg, [0, 1], mesh=mesh,
+                                       backoff_s=0.0)
+    b = _rungs().value(fn="mesh_schedule", rung="single_device")
+    with faults.injected("fn=mesh_schedule,exc=device_lost,times=5"):
+        degraded = sweep_mod.capacity_sweep(snap, cfg, [0, 1], mesh=mesh,
+                                            backoff_s=0.0)
+    assert not degraded.trial_errors
+    assert degraded.satisfied == healthy.satisfied
+    assert np.array_equal(degraded.nodes_per_scenario,
+                          healthy.nodes_per_scenario)
+    assert (ledger.plan_digest(degraded)["digest"]
+            == ledger.plan_digest(healthy)["digest"])
+    assert _rungs().value(fn="mesh_schedule",
+                          rung="single_device") == b + 1
+
+
+def _pools_cluster(n_nodes=8, n_pods=24, pools=4):
+    """A multi-tenant cluster whose disjoint pool footprints give
+    simulate() a real wave plan (the waves -> scan rung needs one)."""
+    from open_simulator_tpu.k8s.loader import ClusterResources
+    from open_simulator_tpu.k8s.objects import Node, Pod
+
+    cluster = ClusterResources()
+    cluster.nodes = [Node.from_dict({
+        "metadata": {"name": f"n{i}",
+                     "labels": {"pool": f"p{i % pools}",
+                                "topology.kubernetes.io/zone": f"z{i % 2}"}},
+        "status": {"allocatable": {"cpu": "16", "memory": "64Gi",
+                                   "pods": 110}},
+    }) for i in range(n_nodes)]
+    cluster.pods = [Pod.from_dict({
+        "metadata": {"name": f"p{i}", "namespace": "default",
+                     "labels": {"app": f"a{i % pools}"}},
+        "spec": {
+            "containers": [{"name": "c", "resources": {"requests": {
+                "cpu": f"{100 + (i * 37) % 900}m", "memory": "256Mi"}}}],
+            "nodeSelector": {"pool": f"p{i % pools}"},
+            "topologySpreadConstraints": [{
+                "maxSkew": 5,
+                "topologyKey": "topology.kubernetes.io/zone",
+                "whenUnsatisfiable": "ScheduleAnyway",
+                "labelSelector": {"matchLabels": {"app": f"a{i % pools}"}},
+            }],
+        },
+    }) for i in range(n_pods)]
+    return cluster
+
+
+def test_waves_to_scan_rung_digest_identical():
+    """A deterministic fault (an injected NaN) inside the wave-batched
+    program degrades to the sequential scan — bit-identical result
+    digest, by the wave contract."""
+    from open_simulator_tpu.core import simulate
+
+    healthy = simulate(_pools_cluster(), [])
+    assert healthy.wave_id is not None  # the plan was real
+    b = _rungs().value(fn="schedule_pods", rung="scan_fallback")
+    with faults.injected("fn=schedule_pods,exc=numeric,times=1"):
+        degraded = simulate(_pools_cluster(), [])
+    assert degraded.wave_id is None     # fell back to the scan
+    assert (ledger.result_digest(degraded)["digest"]
+            == ledger.result_digest(healthy)["digest"])
+    assert _rungs().value(fn="schedule_pods",
+                          rung="scan_fallback") == b + 1
+
+
+def test_tune_round_batch_split_digest_identical():
+    """A deterministic fault on a tune round re-runs the round's fresh
+    vectors as two half-width launches — points and digest identical
+    (lanes are vmap-independent)."""
+    from open_simulator_tpu.k8s.loader import ClusterResources
+    from open_simulator_tpu.testing.builders import (
+        make_fake_deployment,
+        make_fake_node,
+    )
+    from open_simulator_tpu.tune.search import TuneOptions, tune_search
+
+    def cluster():
+        c = ClusterResources()
+        c.nodes = [make_fake_node(f"n{i}") for i in range(4)]
+        c.deployments = [make_fake_deployment("a", replicas=6, cpu="500m")]
+        return c
+
+    healthy = tune_search(cluster(), [],
+                          TuneOptions(mode="cem", variants=4, rounds=2,
+                                      seed=7))
+    b = _rungs().value(fn="tune_round", rung="batch_split")
+    with faults.injected("fn=batched_schedule,exc=device_lost,times=1"):
+        degraded = tune_search(cluster(), [],
+                               TuneOptions(mode="cem", variants=4,
+                                           rounds=2, seed=7))
+    assert degraded["digest"] == healthy["digest"]
+    assert degraded["pareto"] == healthy["pareto"]
+    assert _rungs().value(fn="tune_round", rung="batch_split") == b + 1
+
+
+def test_fleet_lanes_batch_split_digest_identical(tmp_path):
+    """A deterministic fault on a fleet-lane launch halves the chunk;
+    per-lane rows are chunking-invariant, so the campaign report digest
+    equals the healthy fleet-lane run (and the serial boundary stays
+    the final rung)."""
+    from open_simulator_tpu.campaign import CampaignOptions, run_campaign
+    from open_simulator_tpu.campaign.fleet import write_synthetic_fleet
+
+    write_synthetic_fleet(str(tmp_path), n_clusters=4, nodes=4, pods=8)
+    healthy = run_campaign(CampaignOptions(fleet=str(tmp_path),
+                                           fleet_lanes=True,
+                                           checkpoint=False))
+    b = _rungs().value(fn="fleet_schedule", rung="batch_split")
+    with faults.injected("fn=fleet_schedule,exc=numeric,times=1"):
+        degraded = run_campaign(CampaignOptions(fleet=str(tmp_path),
+                                                fleet_lanes=True,
+                                                checkpoint=False))
+    assert degraded["digest"] == healthy["digest"]
+    assert degraded["totals"]["quarantined"] == 0
+    # the poisoned launch became two half launches
+    assert degraded["launches"] > healthy["launches"]
+    assert _rungs().value(fn="fleet_schedule", rung="batch_split") == b + 1
+
+
+def test_replay_fast_path_full_scan_rung_digest_identical():
+    """A device fault on the donated-carry slice launch degrades to the
+    defining full scan — trajectory digest identical (fast == full is
+    the replay contract)."""
+    from open_simulator_tpu.k8s.loader import ClusterResources
+    from open_simulator_tpu.replay.engine import ReplayOptions, run_replay
+    from open_simulator_tpu.replay.synthetic import _deployment_yaml
+    from open_simulator_tpu.replay.trace import ReplayTrace
+    from open_simulator_tpu.testing.builders import make_fake_node
+
+    def cluster():
+        c = ClusterResources()
+        c.nodes = [make_fake_node(f"n{i}") for i in range(3)]
+        return c
+
+    def arrive(t, name, replicas):
+        return {"t": t, "kind": "arrive",
+                "app": {"name": name,
+                        "yaml": _deployment_yaml(name, replicas, 400, 256)}}
+
+    trace = ReplayTrace.from_dict(
+        {"events": [arrive(1.0, "b1", 4), arrive(2.0, "b2", 2)]})
+    healthy = run_replay(cluster(), trace, ReplayOptions(checkpoint=False))
+    b = _rungs().value(fn="replay_step", rung="full_scan")
+    # launches: baseline full scan (#0), arrive-1 slice (#1),
+    # arrive-2 slice (#2) — poison the second fast path
+    with faults.injected("fn=replay_step,exc=device_lost,launch=2,"
+                         "times=1"):
+        degraded = run_replay(cluster(), trace,
+                              ReplayOptions(checkpoint=False))
+    assert degraded["digest"] == healthy["digest"]
+    assert _rungs().value(fn="replay_step", rung="full_scan") == b + 1
+
+
+# ---- fault during resume --------------------------------------------------
+
+
+def test_fault_on_first_post_resume_launch_keeps_journal(tmp_path,
+                                                         monkeypatch):
+    """A device fault right after a resume must not corrupt the sweep
+    journal: the failed resume appends nothing, and the next (healthy)
+    resume completes digest-identical to an uninterrupted run."""
+    from open_simulator_tpu.engine.scheduler import make_config
+    from open_simulator_tpu.parallel.sweep import capacity_bisect
+    from open_simulator_tpu.resilience import lifecycle
+    from open_simulator_tpu.testing.synthetic import synthetic_snapshot
+
+    monkeypatch.setenv(lifecycle.CHECKPOINT_DIR_ENV, str(tmp_path))
+    # a shape that genuinely bisects: round 1 probes {0, 6}, round 2 the
+    # interior — so there IS a post-round-1 launch to poison
+    snap = synthetic_snapshot(n_nodes=2, n_pods=40, max_new=6)
+    cfg = make_config(snap)
+    reference = capacity_bisect(snap, cfg, 6, lanes=2, checkpoint=False)
+    ref_digest = ledger.plan_digest(reference)["digest"]
+
+    # crash mid-bisect: round 1 journals, round 2's launch dies hard
+    # (isolation lanes included — a systemic deterministic fault)
+    with faults.injected("fn=batched_schedule,exc=device_lost,launch=1,"
+                         "times=99"):
+        with pytest.raises(Exception):
+            capacity_bisect(snap, cfg, 6, lanes=2, checkpoint=True)
+    journals = sorted(tmp_path.glob("*.sweep.jsonl"))
+    assert len(journals) == 1
+    after_crash = journals[0].read_bytes()
+    assert after_crash  # round 1 was settled and journaled
+
+    # resume attempt #1: the device is STILL bad — the fault surfaces
+    # structured (or as the sweep's systemic error) and the journal is
+    # byte-identical afterwards: no torn line, nothing lost
+    with faults.injected("fn=batched_schedule,exc=device_lost,times=99"):
+        with pytest.raises(Exception):
+            capacity_bisect(snap, cfg, 6, lanes=2, resume="last")
+    assert journals[0].read_bytes() == after_crash
+
+    # resume attempt #2: healthy device — bit-identical to uninterrupted
+    resumed = capacity_bisect(snap, cfg, 6, lanes=2, resume="last")
+    assert resumed.resumed_rounds >= 1
+    assert ledger.plan_digest(resumed)["digest"] == ref_digest
+
+
+# ---- serving ladder (direct group executor) -------------------------------
+
+
+CLUSTER_YAML = """
+apiVersion: v1
+kind: Node
+metadata: {name: s0}
+status: {allocatable: {cpu: "8", memory: 16Gi, pods: "110"}}
+---
+apiVersion: v1
+kind: Node
+metadata: {name: s1}
+status: {allocatable: {cpu: "4", memory: 8Gi, pods: "110"}}
+---
+apiVersion: apps/v1
+kind: Deployment
+metadata: {name: app, namespace: default}
+spec:
+  replicas: 3
+  selector: {matchLabels: {app: a}}
+  template:
+    metadata: {labels: {app: a}}
+    spec:
+      containers:
+        - name: c
+          resources: {requests: {cpu: "1", memory: 1Gi}}
+"""
+
+
+class _FakeJob:
+    """The slice of lifecycle.Job the group executor reads."""
+
+    def __init__(self, payload):
+        self.payload = payload
+        self.token = None
+        self.result = None
+
+
+@pytest.fixture(scope="module")
+def serving_box():
+    from open_simulator_tpu.server import serving
+    from open_simulator_tpu.server.rest import SimulationServer
+
+    srv = SimulationServer()
+    admit = _FakeJob(serving.prepare_simulate(
+        srv, {"cluster": {"yaml": CLUSTER_YAML}}))
+    serving.execute_group([admit])
+    assert admit.result[0] == 200, admit.result
+    return (srv, admit.result[1]["snapshot_digest"],
+            admit.result[1]["digest"])
+
+
+def _probe_group(srv, digest, n):
+    from open_simulator_tpu.server import serving
+
+    return [_FakeJob(serving.prepare_simulate(srv, {"base": digest}))
+            for _ in range(n)]
+
+
+def test_serving_batch_split_rung_siblings_healthy(serving_box):
+    """One deterministic fault on the coalesced launch: the batch splits
+    and every member still answers 200 with the singleton digest."""
+    from open_simulator_tpu.server import serving
+
+    srv, digest, singleton = serving_box
+    b = _rungs().value(fn="serving_lanes", rung="batch_split")
+    with faults.injected("fn=serving_lanes,exc=numeric,times=1"):
+        group = _probe_group(srv, digest, 2)
+        serving.execute_group(group)
+    assert all(j.result[0] == 200 and j.result[1]["digest"] == singleton
+               for j in group), [j.result for j in group]
+    assert _rungs().value(fn="serving_lanes", rung="batch_split") == b + 1
+
+
+def test_serving_poisoned_member_structured_5xx_sibling_200(serving_box):
+    """times=2 follows the split down to one member: the poisoned
+    request answers its own structured 5xx (never a bare 500 body), the
+    sibling answers 200 with the singleton digest."""
+    from open_simulator_tpu.server import serving
+
+    srv, digest, singleton = serving_box
+    with faults.injected("fn=serving_lanes,exc=numeric,times=2"):
+        group = _probe_group(srv, digest, 2)
+        serving.execute_group(group)
+    outcomes = sorted((j.result[0], j.result[1].get("code"))
+                      for j in group)
+    assert outcomes == [(200, None), (500, "E_NUMERIC")], outcomes
+    ok = next(j for j in group if j.result[0] == 200)
+    assert ok.result[1]["digest"] == singleton
+    bad = next(j for j in group if j.result[0] == 500)
+    assert bad.result[1]["error"]  # structured body, message included
+
+
+def test_serving_resident_drop_rung_on_oom(serving_box):
+    """A persistent OOM climbs the ladder: exec-cache drop first, then
+    every resident snapshot's device arrays — the re-encoded re-launch
+    answers 200 with the same digest (host tables survive)."""
+    from open_simulator_tpu.server import serving
+
+    srv, digest, singleton = serving_box
+    b_res = _rungs().value(fn="serving_lanes", rung="resident_drop")
+    b_cache = _rungs().value(fn="serving_lanes", rung="cache_drop")
+    with faults.injected("fn=serving_lanes,exc=oom,times=2"):
+        group = _probe_group(srv, digest, 2)
+        serving.execute_group(group)
+    assert all(j.result[0] == 200 and j.result[1]["digest"] == singleton
+               for j in group), [j.result for j in group]
+    assert _rungs().value(fn="serving_lanes",
+                          rung="resident_drop") == b_res + 1
+    assert _rungs().value(fn="serving_lanes",
+                          rung="cache_drop") == b_cache + 1
+
+
+def test_serving_transient_fault_retried_invisible(serving_box):
+    """A transient transfer fault is absorbed by the launch wrapper's
+    retry schedule — the client never sees it."""
+    from open_simulator_tpu.server import serving
+
+    srv, digest, singleton = serving_box
+    with faults.injected("fn=serving_lanes,exc=transfer,times=1"):
+        group = _probe_group(srv, digest, 2)
+        serving.execute_group(group)
+    assert all(j.result[0] == 200 and j.result[1]["digest"] == singleton
+               for j in group)
+
+
+def test_rungs_write_ledger_events(tmp_path, monkeypatch):
+    """Each rung taken lands one persistent 'fault' event in the run
+    ledger — the witness the smoke reads back."""
+    monkeypatch.delenv(ledger.LEDGER_DIR_ENV, raising=False)
+    ledger.configure(str(tmp_path))
+    try:
+        faults.record_rung("serving_lanes", "batch_split",
+                           faults.E_NUMERIC)
+        recs = [r for r in ledger.default_ledger().records()
+                if r.get("surface") == "fault"]
+        assert len(recs) == 1
+        assert recs[0]["tags"] == {"fn": "serving_lanes",
+                                   "rung": "batch_split",
+                                   "code": "E_NUMERIC"}
+    finally:
+        ledger.configure(None)

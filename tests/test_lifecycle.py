@@ -94,6 +94,7 @@ def test_run_with_retries_jitter_bounds_sleeps():
 
     assert run_with_retries(flaky, retries=5, backoff_s=0.1,
                             jitter=True, rng=random.Random(3),
+                            retry_on=(RuntimeError,),
                             sleep=sleeps.append) == "ok"
     assert len(sleeps) == 3
     for i, s in enumerate(sleeps):
@@ -111,7 +112,8 @@ def test_run_with_retries_max_elapsed_caps_the_loop():
     t0 = time.monotonic()
     with pytest.raises(RuntimeError, match="hard"):
         run_with_retries(always, retries=50, backoff_s=0.2,
-                         max_elapsed_s=0.1, sleep=sleeps.append)
+                         max_elapsed_s=0.1, retry_on=(RuntimeError,),
+                         sleep=sleeps.append)
     # first planned sleep (0.2s) already exceeds the 0.1s budget
     assert sleeps == []
     assert time.monotonic() - t0 < 1.0
@@ -121,6 +123,7 @@ def test_run_with_retries_max_elapsed_caps_the_loop():
     with pytest.raises(RuntimeError):
         run_with_retries(always, retries=50, backoff_s=0.04,
                          max_backoff_s=10.0, max_elapsed_s=0.05,
+                         retry_on=(RuntimeError,),
                          sleep=lambda s: (sleeps2.append(s), time.sleep(s)))
     assert len(sleeps2) == 1
 
@@ -702,6 +705,63 @@ def test_graceful_drain_finishes_inflight_rejects_new(tmp_path, monkeypatch):
         [rec] = [r for r in ledger.default_ledger().records()
                  if r["surface"] == "server:drain"]
         assert rec["run_id"] == drain_info["ledger_run_id"]
+        assert rec["tags"]["drained_clean"] is True
+    finally:
+        ledger.configure(None)
+        httpd.shutdown()
+
+
+def test_fault_mid_drain_answers_structured_and_drains_clean(
+        tmp_path, monkeypatch):
+    """ISSUE-14 satellite: a deterministic device fault on the in-flight
+    request DURING a SIGTERM drain must answer its structured 5xx (never
+    a bare traceback), and the drain still finishes clean with its
+    ledger record — a bad device cannot turn shutdown into a crash."""
+    from open_simulator_tpu.resilience import faults
+    from open_simulator_tpu.server import serving
+    from open_simulator_tpu.telemetry import ledger
+
+    monkeypatch.delenv(ledger.LEDGER_DIR_ENV, raising=False)
+    ledger.configure(str(tmp_path))
+    srv, httpd, url = _mini_server(depth=2, drain_timeout_s=10.0)
+    entered, release = threading.Event(), threading.Event()
+    real_launch = serving._launch_group
+
+    def gated(members):
+        # hold the launch open until the drain has begun, so the fault
+        # genuinely fires mid-drain
+        entered.set()
+        release.wait(10.0)
+        return real_launch(members)
+
+    monkeypatch.setattr(serving, "_launch_group", gated)
+    inflight = {}
+
+    def post():
+        inflight["out"] = _post_status(
+            url + "/api/simulate", {"cluster": {"yaml": CLUSTER_YAML}})
+
+    t = threading.Thread(target=post)
+    drain_info = {}
+    drainer = threading.Thread(
+        target=lambda: drain_info.update(srv.begin_drain()))
+    try:
+        # E_COMPILE is deterministic and has no serving rung for a
+        # singleton member: the request must answer the structured 500
+        with faults.injected("fn=serving_lanes,exc=compile,times=99"):
+            t.start()
+            assert entered.wait(10.0)
+            drainer.start()
+            time.sleep(0.1)            # drain underway, launch held
+            release.set()
+            t.join(15.0)
+            drainer.join(15.0)
+        status, _, body = inflight["out"]
+        assert status == 500 and body["code"] == "E_COMPILE", (status, body)
+        assert "compilation" in body["error"]
+        assert drain_info.get("drained_clean") is True
+        [rec] = [r for r in ledger.default_ledger().records()
+                 if r["surface"] == "server:drain"]
         assert rec["tags"]["drained_clean"] is True
     finally:
         ledger.configure(None)

@@ -242,15 +242,88 @@ def test_run_with_retries_backs_off():
     def flaky():
         calls["n"] += 1
         if calls["n"] < 3:
-            raise RuntimeError("transient")
+            # classified-transient under the default predicate
+            raise OSError("connection reset by peer")
         return "ok"
 
     assert run_with_retries(flaky, retries=3, backoff_s=0.1,
                             sleep=sleeps.append) == "ok"
     assert sleeps == [0.1, 0.2]  # exponential
+    with pytest.raises(OSError):
+        run_with_retries(
+            lambda: (_ for _ in ()).throw(OSError("connection reset")),
+            retries=1, backoff_s=0.0, sleep=lambda s: None)
+
+
+def test_run_with_retries_deterministic_raises_on_attempt_zero():
+    """The deprecated retry-everything default is gone: an error the
+    classifier calls deterministic (or cannot classify) re-raises
+    immediately, spending zero retries."""
+    calls = {"n": 0}
+
+    def oom():
+        calls["n"] += 1
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
     with pytest.raises(RuntimeError):
-        run_with_retries(lambda: (_ for _ in ()).throw(RuntimeError("hard")),
-                         retries=1, backoff_s=0.0, sleep=lambda s: None)
+        run_with_retries(oom, retries=5, backoff_s=0.0,
+                         sleep=lambda s: None)
+    assert calls["n"] == 1  # attempt 0 only
+
+    calls["n"] = 0
+
+    def bug():
+        calls["n"] += 1
+        raise ValueError("plain program bug")
+
+    with pytest.raises(ValueError):
+        run_with_retries(bug, retries=5, backoff_s=0.0,
+                         sleep=lambda s: None)
+    assert calls["n"] == 1
+
+    # an explicit tuple still works (opt back into broader retries)
+    calls["n"] = 0
+
+    def hard():
+        calls["n"] += 1
+        raise RuntimeError("hard")
+
+    with pytest.raises(RuntimeError):
+        run_with_retries(hard, retries=2, backoff_s=0.0,
+                         retry_on=(RuntimeError,), sleep=lambda s: None)
+    assert calls["n"] == 3
+
+    # a BARE class (the old `except retry_on:` form) is a one-class
+    # tuple, not a predicate — it must retry only that class
+    calls["n"] = 0
+    with pytest.raises(RuntimeError):
+        run_with_retries(hard, retries=2, backoff_s=0.0,
+                         retry_on=RuntimeError, sleep=lambda s: None)
+    assert calls["n"] == 3
+    calls["n"] = 0
+
+    def bug2():
+        calls["n"] += 1
+        raise ValueError("not retryable under a RuntimeError class")
+
+    with pytest.raises(ValueError):
+        run_with_retries(bug2, retries=5, backoff_s=0.0,
+                         retry_on=RuntimeError, sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_run_with_retries_max_elapsed_caps_transient_loop():
+    """max_elapsed_s still caps a transient retry loop under the
+    classifier default (the retry satellite's second contract)."""
+    sleeps = []
+
+    def always_transient():
+        raise OSError("connection reset by peer")
+
+    with pytest.raises(OSError):
+        run_with_retries(always_transient, retries=50, backoff_s=0.2,
+                         max_elapsed_s=0.1, sleep=sleeps.append)
+    assert sleeps == []  # first planned sleep already blows the budget
 
 
 def test_sweep_isolates_failing_trial(monkeypatch):
@@ -263,7 +336,7 @@ def test_sweep_isolates_failing_trial(monkeypatch):
     n_real = snap.n_real_nodes
     real_batched = sweep_mod.batched_schedule
 
-    def chaotic_batched(arrs, masks, cfg_, mesh=None):
+    def chaotic_batched(arrs, masks, cfg_, mesh=None, **kw):
         if masks.shape[0] > 1:
             raise RuntimeError("injected: batch lane crashed")
         count = int(np.asarray(masks[0]).sum()) - n_real
@@ -310,10 +383,11 @@ def test_sweep_retry_recovers_transient_failure(monkeypatch):
     real_batched = sweep_mod.batched_schedule
     calls = {"n": 0}
 
-    def flaky_batched(arrs, masks, cfg_, mesh=None):
+    def flaky_batched(arrs, masks, cfg_, mesh=None, **kw):
         calls["n"] += 1
         if calls["n"] == 1:
-            raise RuntimeError("transient device hiccup")
+            # classified transient (E_TRANSFER) — the retry-worthy class
+            raise OSError("DATA_LOSS: failed to transfer buffer")
         return real_batched(arrs, masks, cfg_, mesh=mesh)
 
     monkeypatch.setattr(sweep_mod, "batched_schedule", flaky_batched)

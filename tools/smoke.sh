@@ -162,6 +162,15 @@ if [ "$rc" -ne 0 ]; then
 fi
 
 echo
+echo "== device fault domain (injected plan: structured 5xx, ladder rungs, healthy digest) =="
+make fault-smoke
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: fault-smoke exited $rc" >&2
+  exit "$rc"
+fi
+
+echo
 echo "== serving lifecycle (SIGTERM drain: readyz flip, 503s, in-flight finishes) =="
 make lifecycle-smoke
 rc=$?
