@@ -4,7 +4,7 @@
 
 .PHONY: test lint smoke bench bench-smoke bench-regress lifecycle-smoke \
 	multichip-smoke campaign-smoke replay-smoke session-smoke serve-smoke \
-	tune-smoke fault-smoke
+	tune-smoke fault-smoke journal-smoke
 
 test:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -94,6 +94,16 @@ tune-smoke:
 # the plan exactly; SIGTERM under the plan still exits 0
 fault-smoke:
 	env JAX_PLATFORMS=cpu python tools/fault_smoke.py
+
+# durable-state fault-domain gate (resilience/journal.py): SIGKILL a
+# real server mid-session, then damage the journals both ways — a torn
+# FINAL line must resume digest-identically while a flipped byte
+# mid-file answers a structured 409 E_CORRUPT (kind/record/offset, the
+# sibling unharmed); an injected ENOSPC plan walks the shared
+# checkpointing_disabled rung with simon_journal_* counters matching;
+# SIGTERM under the plan still exits 0
+journal-smoke:
+	env JAX_PLATFORMS=cpu python tools/journal_smoke.py
 
 # regression gate over the run ledger (SIMON_LEDGER_DIR or
 # BENCH_LEDGER_DIR=... make bench-regress): the newest bench record per

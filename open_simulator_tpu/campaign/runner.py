@@ -63,6 +63,7 @@ from open_simulator_tpu.campaign.fleet import (
 )
 from open_simulator_tpu.campaign.report import build_report
 from open_simulator_tpu.errors import SimulationError
+from open_simulator_tpu.resilience import journal as journal_mod
 from open_simulator_tpu.resilience import lifecycle
 from open_simulator_tpu.resilience.retry import run_with_retries
 
@@ -98,7 +99,7 @@ class CampaignOptions:
 # ---- journal -------------------------------------------------------------
 
 
-class CampaignJournal:
+class CampaignJournal(journal_mod.DurableJournal):
     """Append-only per-campaign settlement log, §11 SweepJournal-shaped:
 
       {"kind": "header", "campaign_id", "ts", "fleet_digest", "scenario",
@@ -110,18 +111,20 @@ class CampaignJournal:
 
     Lines are appended only when a cluster is SETTLED (hosted outputs or
     a final quarantine verdict in hand) and fsynced, so a SIGKILL
-    resumes from the last settled cluster. Unwritable-dir degrade
-    matches SweepJournal: one warning, checkpointing off, run continues.
+    resumes from the last settled cluster. Records ride the shared
+    CRC-framed ``DurableJournal`` format (ARCH §19): a torn final line
+    resumes from the prefix, mid-file corruption is ``E_CORRUPT``, and
+    an unwritable dir takes the shared checkpointing_disabled rung.
     """
+
+    KIND = "campaign"
 
     def __init__(self, path: str, header: Dict[str, Any],
                  records: Optional[List[Dict[str, Any]]] = None,
                  done: Optional[Dict[str, Any]] = None):
-        self.path = path
-        self.header = header
+        super().__init__(path, header)
         self.records = records or []
         self.done = done
-        self.broken = False
 
     @property
     def campaign_id(self) -> str:
@@ -149,51 +152,28 @@ class CampaignJournal:
     @classmethod
     def load(cls, root: str, token: str) -> "CampaignJournal":
         """Resolve ``token`` (unique campaign-id prefix or ``last``) and
-        parse; torn trailing lines (crash mid-append) are dropped."""
-        if not root or not os.path.isdir(root):
-            raise lifecycle.ResumeError(
-                f"no checkpoint directory at {root!r}", ref="resume",
-                hint="run with --ledger-dir (checkpoints live in "
-                     "<ledger>/checkpoints) or set SIMON_CHECKPOINT_DIR")
-        names = sorted(n for n in os.listdir(root)
-                       if n.endswith(CAMPAIGN_JOURNAL_SUFFIX))
-        if not names:
-            raise lifecycle.ResumeError(
-                f"no campaign checkpoints under {root}", ref="resume")
-        if token in ("last", "latest"):
-            pick = max(names, key=lambda n: os.path.getmtime(
-                os.path.join(root, n)))
-        else:
-            hits = [n for n in names if n.startswith(token)]
-            if not hits:
-                raise lifecycle.ResumeError(
-                    f"no campaign checkpoint matches {token!r}",
-                    ref="resume",
-                    hint=f"known: {[n.split('.')[0] for n in names]}")
-            if len(hits) > 1:
-                raise lifecycle.ResumeError(
-                    f"campaign id prefix {token!r} is ambiguous: "
-                    f"{[n.split('.')[0] for n in hits]}", ref="resume")
-            pick = hits[0]
-        path = os.path.join(root, pick)
+        run the strict reader: only a torn FINAL line (crash mid-append)
+        is dropped; mid-file corruption or a sequence gap is a
+        structured ``E_CORRUPT``."""
+        path = journal_mod.resolve_journal_path(
+            root, token, CAMPAIGN_JOURNAL_SUFFIX, "campaign")
+        scan = journal_mod.read_journal(path, cls.KIND)
         header, records, done = None, [], None
-        with open(path, "r", encoding="utf-8") as f:
-            for ln in f:
-                try:
-                    rec = json.loads(ln)
-                except json.JSONDecodeError:
-                    continue  # torn line from the crash
-                kind = rec.get("kind")
-                if kind == "header":
-                    header = rec
-                elif kind in ("cluster", "quarantine"):
-                    records.append(rec)
-                elif kind == "done":
-                    done = rec
+        for rec in scan.records:
+            kind = rec.get("kind")
+            if kind == "header":
+                header = rec
+            elif kind in ("cluster", "quarantine"):
+                records.append(rec)
+            elif kind == "done":
+                done = rec
         if header is None:
             raise lifecycle.ResumeError(
-                f"checkpoint {pick} has no header line", ref="resume")
-        return cls(path, header, records, done)
+                f"checkpoint {os.path.basename(path)} has no header line",
+                ref="resume")
+        journal = cls(path, header, records, done)
+        journal._adopt_scan(scan)
+        return journal
 
     def verify(self, fleet_dig: str, scenario: str) -> None:
         """Resume contract: same fleet (names + source digests + engine
@@ -212,22 +192,6 @@ class CampaignJournal:
                 f"scenario drifted since the checkpoint "
                 f"({self.header.get('scenario')!r} -> {scenario!r})",
                 ref=f"campaign/{self.campaign_id}", field="scenario")
-
-    def _append(self, rec: Dict[str, Any]) -> None:
-        if self.broken:
-            return
-        line = json.dumps(rec, sort_keys=True) + "\n"
-        try:
-            with open(self.path, "a", encoding="utf-8") as f:
-                f.write(line)
-                f.flush()
-                os.fsync(f.fileno())
-        except OSError as e:
-            self.broken = True
-            _log.warning(
-                "campaign journal %s is unwritable (%s); checkpointing "
-                "disabled for the rest of this campaign — it cannot be "
-                "resumed past the last settled line", self.path, e)
 
     def append_cluster(self, name: str, fingerprint: Dict[str, str],
                        row: Dict[str, Any]) -> None:
@@ -542,17 +506,23 @@ def run_campaign(opts: CampaignOptions,
     report["launches"] = int(launches)
     if journal is not None and journal.done is None:
         journal.finish(report["digest"], len(rows), len(quars))
+    # surface the storage degradation rung on the report itself (outside
+    # the digested core, like wall_s): the fleet run is complete and
+    # correct, but cannot be resumed past the last durable record
+    if journal is not None and journal.broken:
+        report["checkpointing_disabled"] = True
     # one campaign-summary line in the run ledger (beside the per-cluster
     # records): how the fleet run went, surviving process exit
-    ledger.append_event(
-        "campaign",
-        tags={"campaign": campaign_id, "scenario": opts.scenario,
-              "clusters": report["totals"]["clusters"],
-              "completed": report["totals"]["completed"],
-              "quarantined": report["totals"]["quarantined"],
-              "digest": report["digest"],
-              "clusters_per_sec": report.get("clusters_per_sec")},
-        wall_s=report.get("wall_s", 0.0))
+    tags = {"campaign": campaign_id, "scenario": opts.scenario,
+            "clusters": report["totals"]["clusters"],
+            "completed": report["totals"]["completed"],
+            "quarantined": report["totals"]["quarantined"],
+            "digest": report["digest"],
+            "clusters_per_sec": report.get("clusters_per_sec")}
+    if report.get("checkpointing_disabled"):
+        tags["checkpointing_disabled"] = True
+    ledger.append_event("campaign", tags=tags,
+                        wall_s=report.get("wall_s", 0.0))
     return report
 
 

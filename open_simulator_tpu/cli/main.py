@@ -179,9 +179,10 @@ def build_parser() -> argparse.ArgumentParser:
              "queued jobs")
     sp.add_argument(
         "--fault-plan", default="", metavar="PLAN",
-        help="deterministic device fault injection (test rigs only): "
-             "'fn=<launch>,exc=<oom|device_lost|transfer|numeric|"
-             "compile>[,launch=<k>][,times=<n>]' rules joined by ';' — "
+        help="deterministic device/storage fault injection (test rigs "
+             "only): 'fn=<launch>,exc=<oom|device_lost|transfer|numeric|"
+             "compile|enospc|eio>[,launch=<k>][,times=<n>]' rules joined "
+             "by ';' — "
              "fail launch #k of that fn n times so every degradation "
              "rung and retry schedule is reproducibly testable (also "
              "honors SIMON_FAULT_PLAN; a malformed plan is a startup "
@@ -611,11 +612,19 @@ def _runs_main(args) -> int:
         print("error: pick a subcommand: runs {list, show, diff}",
               file=sys.stderr)
         return 2
+    def _warn_corrupt() -> None:
+        # every subcommand read the ledger through records(); a nonzero
+        # skip count means the regression window silently shrank — say so
+        if led.skipped_corrupt:
+            print(f"warning: skipped {led.skipped_corrupt} corrupt ledger "
+                  f"record(s) in {led.path}", file=sys.stderr)
+
     try:
         if args.runs_command == "list":
             recs = led.records(surface=args.surface or None,
                                limit=None if args.campaign
                                else (args.limit or None))
+            _warn_corrupt()
             if args.campaign:
                 recs = [r for r in recs
                         if str((r.get("tags") or {}).get("campaign", ""))
@@ -629,10 +638,13 @@ def _runs_main(args) -> int:
                 print(ledger.format_run_list(recs))
             return 0
         if args.runs_command == "show":
-            print(_json.dumps(led.find(args.run), indent=2, sort_keys=True))
+            rec = led.find(args.run)
+            _warn_corrupt()
+            print(_json.dumps(rec, indent=2, sort_keys=True))
             return 0
         # diff
         d = ledger.diff_records(led.find(args.run_a), led.find(args.run_b))
+        _warn_corrupt()
         print(_json.dumps(d, indent=2) if args.json else ledger.format_diff(d))
         return 0
     except ledger.LedgerError as e:

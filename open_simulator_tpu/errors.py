@@ -70,6 +70,23 @@ could not absorb it; transient classes spent their retry budget first:
                        check_finite sentinel scan; deterministic)
   E_COMPILE            XLA/MLIR compilation or lowering failure
                        (deterministic)
+
+Durable-state fault domain (resilience/journal.py, ARCHITECTURE.md §19)
+— the filesystem gets the same taxonomy discipline as the device:
+
+  E_CORRUPT            a journal failed the strict integrity read
+                       somewhere other than the torn tail (mid-file
+                       undecodable/CRC-failing line, sequence gap,
+                       duplicated or reordered record); carries the
+                       journal kind, record index, and byte offset —
+                       the resume/rehydrate path refuses instead of
+                       fabricating a wrong-prefix trajectory (HTTP 409)
+  E_STORAGE_FULL       ENOSPC/EDQUOT/EROFS on a durable write
+                       (deterministic: the disk stays full; journaling
+                       takes the checkpointing_disabled rung, the run
+                       finishes; HTTP 507)
+  E_STORAGE_IO         EIO on a durable write (transient: retried on
+                       disk timescales before escalating; HTTP 503)
 """
 
 from __future__ import annotations

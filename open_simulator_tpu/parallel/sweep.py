@@ -66,6 +66,10 @@ def _with_run_record(fn):
             if cap.recording:
                 cap.set_config(cfg, snapshot=snapshot)
                 cap.set_plan(plan)
+                if getattr(plan, "checkpointing_disabled", False):
+                    # the storage degradation rung rides the RunRecord:
+                    # the ledger shows WHICH runs lost crash-safety
+                    cap.tag("checkpointing_disabled", True)
             return plan
 
     return wrapper
@@ -103,6 +107,12 @@ class CapacityPlan:
     # rounds replayed from a checkpoint instead of executed (0 on a
     # fresh run) — the resume witness for tests and responses
     resumed_rounds: int = 0
+    # True when a storage fault disabled the sweep journal mid-run (the
+    # checkpointing_disabled degradation rung, ARCH §19): the plan is
+    # complete and correct, but the run cannot be resumed past the last
+    # durable round — surfaced on the final report/ledger, not just a
+    # log line
+    checkpointing_disabled: bool = False
 
 
 def make_mesh(
@@ -640,6 +650,10 @@ def capacity_bisect(
     )
     if journal is not None and journal.done is None:
         journal.finish(plan.best_count, ledger.plan_digest(plan)["digest"])
+    # surface the storage degradation rung on the verdict itself: a plan
+    # from a run whose journal died mid-sweep is correct but unresumable
+    plan.checkpointing_disabled = bool(journal is not None
+                                       and journal.broken)
     return plan
 
 

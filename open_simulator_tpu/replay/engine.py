@@ -73,6 +73,7 @@ from open_simulator_tpu.replay.trace import (
 )
 from open_simulator_tpu.replay.controllers import controllers_digest
 from open_simulator_tpu.resilience import faults, lifecycle
+from open_simulator_tpu.resilience import journal as journal_mod
 
 _log = logging.getLogger(__name__)
 
@@ -117,7 +118,7 @@ def row_digest(row: Dict[str, Any]) -> str:
 # ---- journal -------------------------------------------------------------
 
 
-class ReplayJournal:
+class ReplayJournal(journal_mod.DurableJournal):
     """Append-only per-replay step log, section-11 SweepJournal-shaped:
 
       {"kind": "header", "replay_id", "ts", "fingerprint", "n_events",
@@ -127,18 +128,20 @@ class ReplayJournal:
 
     A row is appended only when the step SETTLED (event applied,
     controllers converged, outputs hosted) and fsynced — a SIGKILL
-    resumes from the last settled step. Unwritable-dir degrade matches
-    SweepJournal: one warning, checkpointing off, the replay continues.
+    resumes from the last settled step. Records ride the shared
+    CRC-framed ``DurableJournal`` format (ARCH §19): torn final line →
+    resume from the prefix; mid-file corruption → ``E_CORRUPT``;
+    unwritable dir → the shared checkpointing_disabled rung.
     """
+
+    KIND = "replay"
 
     def __init__(self, path: str, header: Dict[str, Any],
                  rows: Optional[List[Dict[str, Any]]] = None,
                  done: Optional[Dict[str, Any]] = None):
-        self.path = path
-        self.header = header
+        super().__init__(path, header)
         self.rows = rows or []
         self.done = done
-        self.broken = False
 
     @property
     def replay_id(self) -> str:
@@ -164,49 +167,25 @@ class ReplayJournal:
 
     @classmethod
     def load(cls, root: str, token: str) -> "ReplayJournal":
-        if not root or not os.path.isdir(root):
-            raise lifecycle.ResumeError(
-                f"no checkpoint directory at {root!r}", ref="resume",
-                hint="run with --ledger-dir (checkpoints live in "
-                     "<ledger>/checkpoints) or set SIMON_CHECKPOINT_DIR")
-        names = sorted(n for n in os.listdir(root)
-                       if n.endswith(REPLAY_JOURNAL_SUFFIX))
-        if not names:
-            raise lifecycle.ResumeError(
-                f"no replay checkpoints under {root}", ref="resume")
-        if token in ("last", "latest"):
-            pick = max(names, key=lambda n: os.path.getmtime(
-                os.path.join(root, n)))
-        else:
-            hits = [n for n in names if n.startswith(token)]
-            if not hits:
-                raise lifecycle.ResumeError(
-                    f"no replay checkpoint matches {token!r}", ref="resume",
-                    hint=f"known: {[n.split('.')[0] for n in names]}")
-            if len(hits) > 1:
-                raise lifecycle.ResumeError(
-                    f"replay id prefix {token!r} is ambiguous: "
-                    f"{[n.split('.')[0] for n in hits]}", ref="resume")
-            pick = hits[0]
-        path = os.path.join(root, pick)
+        path = journal_mod.resolve_journal_path(
+            root, token, REPLAY_JOURNAL_SUFFIX, "replay")
+        scan = journal_mod.read_journal(path, cls.KIND)
         header, rows, done = None, [], None
-        with open(path, "r", encoding="utf-8") as f:
-            for ln in f:
-                try:
-                    rec = json.loads(ln)
-                except json.JSONDecodeError:
-                    continue  # torn line from the crash
-                kind = rec.get("kind")
-                if kind == "header":
-                    header = rec
-                elif kind == "step":
-                    rows.append(rec["row"])
-                elif kind == "done":
-                    done = rec
+        for rec in scan.records:
+            kind = rec.get("kind")
+            if kind == "header":
+                header = rec
+            elif kind == "step":
+                rows.append(rec["row"])
+            elif kind == "done":
+                done = rec
         if header is None:
             raise lifecycle.ResumeError(
-                f"checkpoint {pick} has no header line", ref="resume")
-        return cls(path, header, rows, done)
+                f"checkpoint {os.path.basename(path)} has no header line",
+                ref="resume")
+        journal = cls(path, header, rows, done)
+        journal._adopt_scan(scan)
+        return journal
 
     def verify(self, fingerprint: Dict[str, Any]) -> None:
         """Resume contract: the rebuilt trajectory must ask the engine
@@ -224,22 +203,6 @@ class ReplayJournal:
                 field="fingerprint",
                 hint="re-run without --resume, or restore the original "
                      "cluster/trace/controllers")
-
-    def _append(self, rec: Dict[str, Any]) -> None:
-        if self.broken:
-            return
-        line = json.dumps(rec, sort_keys=True) + "\n"
-        try:
-            with open(self.path, "a", encoding="utf-8") as f:
-                f.write(line)
-                f.flush()
-                os.fsync(f.fileno())
-        except OSError as e:
-            self.broken = True
-            _log.warning(
-                "replay journal %s is unwritable (%s); checkpointing "
-                "disabled for the rest of this replay — it cannot be "
-                "resumed past the last settled step", self.path, e)
 
     def append_step(self, row: Dict[str, Any]) -> None:
         rec = {"kind": "step", "row": row}
@@ -930,17 +893,22 @@ def run_replay(cluster, trace: ReplayTrace,
     assert report["digest"] == digest
     if journal is not None and journal.done is None:
         journal.finish(digest, len(rows))
+    # storage degradation rung on the report (outside the digested core,
+    # like wall_s): complete and correct, but unresumable past the last
+    # durable step
+    if journal is not None and journal.broken:
+        report["checkpointing_disabled"] = True
     # one trajectory-summary line beside the per-step records: how the
     # day went, surviving process exit (diffable across engine versions)
-    ledger.append_event(
-        "replay",
-        tags={"replay": replay_id, "steps": len(rows),
-              "events": len(trace.events), "digest": digest,
-              "placed": report["totals"]["placed"],
-              "pending": report["totals"]["pending"],
-              "lost": report["totals"]["lost"],
-              "resumed_steps": resumed_steps},
-        wall_s=report["wall_s"])
+    tags = {"replay": replay_id, "steps": len(rows),
+            "events": len(trace.events), "digest": digest,
+            "placed": report["totals"]["placed"],
+            "pending": report["totals"]["pending"],
+            "lost": report["totals"]["lost"],
+            "resumed_steps": resumed_steps}
+    if report.get("checkpointing_disabled"):
+        tags["checkpointing_disabled"] = True
+    ledger.append_event("replay", tags=tags, wall_s=report["wall_s"])
     return report
 
 

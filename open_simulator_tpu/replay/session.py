@@ -79,6 +79,7 @@ from open_simulator_tpu.replay.trace import (
     ReplayTrace,
     TraceEvent,
 )
+from open_simulator_tpu.resilience import journal as journal_mod
 from open_simulator_tpu.resilience import lifecycle
 
 _log = logging.getLogger(__name__)
@@ -235,7 +236,7 @@ def _docs_digest(docs: List[Dict[str, Any]]) -> str:
 # ---- journal -------------------------------------------------------------
 
 
-class SessionJournal:
+class SessionJournal(journal_mod.DurableJournal):
     """Append-only per-session settlement log, §11-shaped:
 
       {"kind": "header", "session_id", "ts", "name", "fingerprint",
@@ -251,20 +252,23 @@ class SessionJournal:
     server rehydrates every open session from its settled prefix. The
     header carries the serialized cluster + spec + controller roster, so
     a journal is fully self-contained: nothing else must survive the
-    crash. Unwritable-dir degrade matches SweepJournal: one warning,
-    journaling off, the session continues (it just stops being
-    crash-safe past the last settled line)."""
+    crash. Records ride the shared CRC-framed ``DurableJournal`` format
+    (ARCH §19): a torn final line rehydrates from the prefix, mid-file
+    corruption is ``E_CORRUPT`` (the store quarantines the session), and
+    an unwritable dir takes the shared checkpointing_disabled rung (the
+    session continues; it just stops being crash-safe past the last
+    settled line)."""
+
+    KIND = "session"
 
     def __init__(self, path: str, header: Dict[str, Any],
                  steps: Optional[List[Dict[str, Any]]] = None,
                  forks: Optional[List[Dict[str, Any]]] = None,
                  closed: Optional[Dict[str, Any]] = None):
-        self.path = path
-        self.header = header
+        super().__init__(path, header)
         self.steps = steps or []       # [{"event": ..., "row": ...}]
         self.forks = forks or []       # [fork record]
         self.closed = closed
-        self.broken = False
 
     @property
     def session_id(self) -> str:
@@ -291,50 +295,31 @@ class SessionJournal:
 
     @classmethod
     def load(cls, path: str) -> "SessionJournal":
-        header, steps, forks, closed = None, [], [], None
         try:
-            f = open(path, "r", encoding="utf-8")
+            scan = journal_mod.read_journal(path, cls.KIND)
         except OSError as e:
             raise SimulationError(
                 f"session journal {path} is unreadable: {e}",
                 code=E_NO_SESSION, ref="session") from None
-        with f:
-            for ln in f:
-                try:
-                    rec = json.loads(ln)
-                except json.JSONDecodeError:
-                    continue  # torn line from the crash
-                kind = rec.get("kind")
-                if kind == "header":
-                    header = rec
-                elif kind == "step":
-                    steps.append({"event": rec.get("event"),
-                                  "row": rec["row"]})
-                elif kind == "fork":
-                    forks.append(rec["row"])
-                elif kind == "close":
-                    closed = rec
+        header, steps, forks, closed = None, [], [], None
+        for rec in scan.records:
+            kind = rec.get("kind")
+            if kind == "header":
+                header = rec
+            elif kind == "step":
+                steps.append({"event": rec.get("event"),
+                              "row": rec["row"]})
+            elif kind == "fork":
+                forks.append(rec["row"])
+            elif kind == "close":
+                closed = rec
         if header is None:
             raise lifecycle.ResumeError(
                 f"session journal {os.path.basename(path)} has no header "
                 f"line", ref="session")
-        return cls(path, header, steps, forks, closed)
-
-    def _append(self, rec: Dict[str, Any]) -> None:
-        if self.broken:
-            return
-        line = json.dumps(rec, sort_keys=True) + "\n"
-        try:
-            with open(self.path, "a", encoding="utf-8") as f:
-                f.write(line)
-                f.flush()
-                os.fsync(f.fileno())
-        except OSError as e:
-            self.broken = True
-            _log.warning(
-                "session journal %s is unwritable (%s); journaling "
-                "disabled for the rest of this session — it cannot be "
-                "rehydrated past the last settled step", self.path, e)
+        journal = cls(path, header, steps, forks, closed)
+        journal._adopt_scan(scan)
+        return journal
 
     def append_step(self, event: Dict[str, Any], row: Dict[str, Any]) -> None:
         self._append({"kind": "step", "event": event, "row": row})
@@ -880,6 +865,10 @@ class ReplaySession:
             "digest": self.digest,
             "forks": forks,
             "controllers": [dict(c) for c in self._controller_specs],
+            # journal integrity (ARCH §19): framed vs legacy format,
+            # torn-tail truncation, the checkpointing_disabled rung
+            "journal": (self.journal.integrity()
+                        if self.journal is not None else None),
         }
 
     def placements(self) -> Dict[str, List[str]]:
@@ -938,6 +927,11 @@ class SessionStore:
         self._mutex = lifecycle.KeyedMutex()
         # sid -> ReplaySession (loaded) | None (open on disk, not loaded)
         self._sessions: Dict[str, Optional[ReplaySession]] = {}
+        # sid -> the E_CORRUPT verdict from the integrity scan: the
+        # journal failed the strict reader somewhere other than the torn
+        # tail, so the session is open on disk but UNRESUMABLE — the
+        # server boots, siblings rehydrate, this sid reports the error
+        self._quarantined: Dict[str, journal_mod.JournalCorrupt] = {}
         self._scanned = False
 
     # -- root / scan -------------------------------------------------------
@@ -950,21 +944,39 @@ class SessionStore:
 
     def scan(self) -> List[str]:
         """Register every OPEN session journal under the root (server
-        start / after a SIGKILL). Journals are NOT parsed here — the
-        first touch rehydrates lazily."""
+        start / after a SIGKILL), running the startup integrity scan:
+        a journal the strict reader rejects (mid-file corruption, a
+        sequence gap — anything the torn-tail rule does not forgive) is
+        QUARANTINED with its structured ``E_CORRUPT`` verdict instead of
+        registered. The server boots, sibling sessions rehydrate;
+        touching the corrupt sid reports the stored error. Healthy
+        journals are not retained here — the first touch rehydrates
+        lazily from the same verified read path."""
         root = self.root()
         found: List[str] = []
+        corrupt: Dict[str, journal_mod.JournalCorrupt] = {}
         if root and os.path.isdir(root):
             for n in sorted(os.listdir(root)):
                 if not n.endswith(SESSION_JOURNAL_SUFFIX):
                     continue
-                if lifecycle.journal_is_done(os.path.join(root, n)):
+                path = os.path.join(root, n)
+                if lifecycle.journal_is_done(path):
                     continue  # closed: history, not an open session
-                found.append(n[: -len(SESSION_JOURNAL_SUFFIX)])
+                sid = n[: -len(SESSION_JOURNAL_SUFFIX)]
+                verdict = journal_mod.scan_integrity(path, "session")
+                if verdict is not None:
+                    corrupt[sid] = verdict
+                    _log.error("session %s quarantined at startup: %s",
+                               sid, verdict)
+                    continue
+                found.append(sid)
         with self._guard:
             self._scanned = True
             for sid in found:
                 self._sessions.setdefault(sid, None)
+            for sid, verdict in corrupt.items():
+                self._quarantined[sid] = verdict
+                self._sessions.pop(sid, None)
         self._gauges()
         return found
 
@@ -1008,6 +1020,10 @@ class SessionStore:
                 ref="session", field="session_id",
                 hint="list open sessions with GET /api/session")
         self._ensure_scanned()
+        with self._guard:
+            verdict = self._quarantined.get(sid)
+        if verdict is not None:
+            raise verdict  # the startup integrity scan's E_CORRUPT
         with self._mutex.hold(sid):
             with self._guard:
                 known = sid in self._sessions
@@ -1019,7 +1035,15 @@ class SessionStore:
                         f"no open session {sid!r}", code=E_NO_SESSION,
                         ref=f"session/{sid}",
                         hint="list open sessions with GET /api/session")
-                sess = ReplaySession.rehydrate(path)
+                try:
+                    sess = ReplaySession.rehydrate(path)
+                except journal_mod.JournalCorrupt as e:
+                    # corrupted between the startup scan and this touch:
+                    # same quarantine, same structured verdict
+                    with self._guard:
+                        self._quarantined[sid] = e
+                        self._sessions.pop(sid, None)
+                    raise
                 if sess.closed:
                     with self._guard:
                         self._sessions.pop(sid, None)
@@ -1051,17 +1075,32 @@ class SessionStore:
     def list(self) -> List[Dict[str, Any]]:
         """Status of every open session — loaded ones from memory,
         on-disk ones rehydrated lazily (host-side parse only; status
-        never touches the device)."""
+        never touches the device). Quarantined sessions appear with
+        their structured E_CORRUPT verdict — a corrupt journal is an
+        operator-visible fact, not a silent omission."""
         self._ensure_scanned()
         with self._guard:
             sids = sorted(self._sessions)
+            quarantined = dict(self._quarantined)
         out = []
         for sid in sids:
             try:
                 out.append(self.get(sid, touch=False).status())
+            except journal_mod.JournalCorrupt as e:
+                quarantined.setdefault(sid, e)
             except SimulationError:
                 continue  # closed/vanished between listdir and open
+        for sid in sorted(quarantined):
+            e = quarantined[sid]
+            out.append({"session_id": sid, "corrupt": True,
+                        "error": e.to_dict()})
         return out
+
+    def quarantined(self) -> Dict[str, journal_mod.JournalCorrupt]:
+        """The startup integrity scan's verdicts (sid -> E_CORRUPT)."""
+        self._ensure_scanned()
+        with self._guard:
+            return dict(self._quarantined)
 
     # -- residency cap / drain ---------------------------------------------
 

@@ -16,9 +16,15 @@ Three parts (ARCHITECTURE.md "Resilience layer"):
              flaky device execution; retries only what the device fault
              classifier calls transient
   faults     the device fault domain: runtime-failure classifier
-             (E_DEVICE_OOM/E_DEVICE_LOST/E_TRANSFER/E_NUMERIC/E_COMPILE,
+             (E_DEVICE_OOM/E_DEVICE_LOST/E_TRANSFER/E_NUMERIC/E_COMPILE
+             plus the storage class E_STORAGE_FULL/E_STORAGE_IO,
              transient vs deterministic), per-site degradation ladders,
              and the SIMON_FAULT_PLAN deterministic fault injection
+  journal    the durable-journal subsystem: CRC-framed fsynced records,
+             strict torn-tail-only recovery (anything worse is a
+             structured E_CORRUPT naming kind/index/offset), the shared
+             DurableJournal base the sweep/campaign/replay/session
+             journals ride on
   lifecycle  survivable serving: bounded admission queue with EWMA
              Retry-After, per-request CancelToken deadlines observed at
              sweep-round/chaos-event boundaries, sweep checkpoint
@@ -60,7 +66,14 @@ from open_simulator_tpu.resilience.faults import (  # noqa: F401
     classify,
     install_plan,
     is_transient,
+    run_io,
     run_launch,
+)
+from open_simulator_tpu.resilience.journal import (  # noqa: F401
+    DurableJournal,
+    JournalCorrupt,
+    read_journal,
+    scan_integrity,
 )
 from open_simulator_tpu.resilience.retry import (  # noqa: F401
     backoff_delay,

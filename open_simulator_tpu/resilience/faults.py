@@ -26,6 +26,12 @@ degradation ladder, not the retry budget, is the answer):
                                ``check_finite`` sentinel scan) or a
                                FloatingPointError
   E_COMPILE       determ.      XLA/MLIR compilation or lowering failure
+  E_STORAGE_FULL  determ.      ENOSPC / EDQUOT / EROFS on durable-state
+                               writes — the disk will still be full on
+                               the retry; the degradation rung
+                               (checkpointing_disabled) is the answer
+  E_STORAGE_IO    transient    EIO on durable-state writes — a flaky
+                               block/NFS moment, worth the retry budget
   ==============  ===========  ==========================================
 
 Unclassified exceptions (``ValueError`` bugs, structured
@@ -50,8 +56,10 @@ testable. Grammar (rules split on ``;``, fields on ``,``)::
 
     fn=<name>,exc=<kind>[,launch=<k>][,times=<n>]
 
-``fn`` is a known launch-site name (``KNOWN_FNS``), ``exc`` one of
-``oom | device_lost | transfer | numeric | compile``, ``launch`` the
+``fn`` is a known launch-site name (``KNOWN_FNS`` — device launches plus
+the durable-I/O sites ``journal_append``/``ledger_append``), ``exc`` one
+of ``oom | device_lost | transfer | numeric | compile | enospc | eio``
+(case-insensitive), ``launch`` the
 0-based launch counter for that fn (a retry is a new launch; default
 0), ``times`` how many consecutive launches fail (default 1). Injected
 exceptions carry realistic runtime messages so they take the SAME
@@ -67,6 +75,7 @@ healthy-path cost is one module-flag check per launch.
 from __future__ import annotations
 
 import contextlib
+import errno as _errno
 import hashlib
 import logging
 import os
@@ -89,9 +98,13 @@ E_DEVICE_LOST = "E_DEVICE_LOST"
 E_TRANSFER = "E_TRANSFER"
 E_NUMERIC = "E_NUMERIC"
 E_COMPILE = "E_COMPILE"
+# storage class (ISSUE 16): durable-state writes get the same taxonomy
+# discipline as device launches
+E_STORAGE_FULL = "E_STORAGE_FULL"
+E_STORAGE_IO = "E_STORAGE_IO"
 
 DEVICE_FAULT_CODES = (E_DEVICE_OOM, E_DEVICE_LOST, E_TRANSFER, E_NUMERIC,
-                      E_COMPILE)
+                      E_COMPILE, E_STORAGE_FULL, E_STORAGE_IO)
 
 # launch-site names a fault plan may target — one per host boundary the
 # domain wraps (a plan naming anything else is a typo, not a no-op)
@@ -103,6 +116,8 @@ KNOWN_FNS = frozenset({
     "fleet_schedule",    # campaign fleet lanes (campaign/lanes.py)
     "replay_step",       # replay/session step scans (replay/engine.py)
     "compile",           # AOT lower().compile() boundary (exec_cache)
+    "journal_append",    # durable journal frames (resilience/journal.py)
+    "ledger_append",     # run-ledger writes + rotation (telemetry/ledger)
 })
 
 
@@ -122,6 +137,16 @@ _LOST = FaultClass(E_DEVICE_LOST, transient=False)
 _XFER = FaultClass(E_TRANSFER, transient=True)
 _NUM = FaultClass(E_NUMERIC, transient=False)
 _COMP = FaultClass(E_COMPILE, transient=False)
+_SFULL = FaultClass(E_STORAGE_FULL, transient=False)
+_SIO = FaultClass(E_STORAGE_IO, transient=True)
+
+# errnos that pin an OSError to the storage class before any message
+# pattern runs — a full disk stays full for the retry (deterministic),
+# an I/O error is the classic flaky-block transient
+_STORAGE_FULL_ERRNOS = frozenset(
+    {_errno.ENOSPC, _errno.EDQUOT, _errno.EROFS})
+_STORAGE_FULL_PAT = re.compile(
+    r"no space left|disk quota exceeded|read-?only file ?system", re.I)
 
 # message patterns, checked in order (an OOM while compiling is an OOM:
 # the ladder's eviction rung is the right response either way)
@@ -170,6 +195,14 @@ def classify(exc: BaseException) -> Optional[FaultClass]:
     if not isinstance(exc, (RuntimeError, OSError)):
         return None  # ValueError/TypeError/...: a bug, not the device
     msg = str(exc)
+    if isinstance(exc, OSError):
+        # the storage class rides on errno (set by the kernel and by the
+        # injection factories alike), with a message fallback for
+        # re-wrapped exceptions that lost theirs
+        if exc.errno in _STORAGE_FULL_ERRNOS or _STORAGE_FULL_PAT.search(msg):
+            return _SFULL
+        if exc.errno == _errno.EIO:
+            return _SIO
     for pat, fc in _PATTERNS:
         if pat.search(msg):
             return fc
@@ -273,7 +306,8 @@ def check_finite(fn: str, **arrays: Any) -> None:
 # ---- deterministic fault-injection plan ----------------------------------
 
 
-_EXC_KINDS = ("oom", "device_lost", "transfer", "numeric", "compile")
+_EXC_KINDS = ("oom", "device_lost", "transfer", "numeric", "compile",
+              "enospc", "eio")
 
 # injected exceptions carry realistic runtime messages so the classifier
 # (and therefore the ladder) treats them exactly like real faults
@@ -293,6 +327,14 @@ _EXC_FACTORIES: Dict[str, Callable[[str], BaseException]] = {
     "compile": lambda fn: RuntimeError(
         f"XLA compilation failure lowering {fn} "
         f"(SIMON_FAULT_PLAN injected)"),
+    # storage kinds carry a REAL errno, so the classifier takes the same
+    # errno path a kernel-raised ENOSPC/EIO would
+    "enospc": lambda fn: OSError(
+        _errno.ENOSPC,
+        f"No space left on device during {fn} (SIMON_FAULT_PLAN injected)"),
+    "eio": lambda fn: OSError(
+        _errno.EIO,
+        f"Input/output error during {fn} (SIMON_FAULT_PLAN injected)"),
 }
 
 
@@ -371,7 +413,7 @@ class FaultPlan:
                 raise _plan_error(
                     f"unknown launch fn {fn!r}", f"rules[{i}].fn",
                     hint="known fns: " + ", ".join(sorted(KNOWN_FNS)))
-            exc = fields.get("exc", "")
+            exc = fields.get("exc", "").lower()  # exc=ENOSPC == exc=enospc
             if exc not in _EXC_KINDS:
                 raise _plan_error(
                     f"unknown exception class {exc!r}", f"rules[{i}].exc",
@@ -560,6 +602,20 @@ def run_launch(fn: str, launch: Callable[[], T], *, retries: int = 2,
             hint=("transient retries exhausted" if fc.transient else
                   "deterministic device fault: the degradation ladder "
                   "was the recovery path")) from e
+
+
+def run_io(fn: str, op: Callable[[], T], *, retries: int = 2,
+           backoff_s: float = 0.02, max_backoff_s: float = 0.5,
+           jitter: bool = True, rng: Any = None) -> T:
+    """``run_launch`` for durable-state I/O boundaries (journal appends,
+    ledger writes + rotation, checkpoint files): the same
+    inject→classify→retry-transient→wrap discipline, tuned to disk
+    timescales (an EIO retry should cost milliseconds, not the device
+    backoff schedule). A deterministic ``E_STORAGE_FULL`` escapes on
+    attempt 0 — the caller's degradation rung (checkpointing_disabled /
+    ``mark_unwritable``), not the retry budget, is the answer."""
+    return run_launch(fn, op, retries=retries, backoff_s=backoff_s,
+                      max_backoff_s=max_backoff_s, jitter=jitter, rng=rng)
 
 
 def run_wave_launch(fn: str, launch_with_plan: Callable[[Any], T],

@@ -1,0 +1,486 @@
+"""Durable-state fault domain: integrity-framed journals (ARCH §19).
+
+The sweep/campaign/replay/session journals and the run ledger are the
+repo's crash-safety contract — resume digests are bit-identical because
+the journal is the truth. PR 14 classified *device* failures; this
+module does the same for the *filesystem*: a bit-flipped or truncated
+record in the middle of a journal must never be mistaken for the benign
+torn tail a SIGKILL leaves behind.
+
+**Frame format** (one record per line)::
+
+    J1 <crc32:08x> <seq> <canonical-json payload>\\n
+
+``seq`` is the 0-based, strictly monotone record number (the header is
+record 0); the CRC32 covers ``"<seq> <payload>"``, so a flipped bit
+anywhere in the line — including the sequence number — fails the check,
+while an intact line pasted at the wrong position keeps its CRC but
+breaks monotonicity. Journals written before this format (plain JSON
+lines) are still readable: the first line decides the mode, and legacy
+journals are flagged ``legacy`` so their weaker guarantee (no bit-flip
+detection, no loss detection) stays visible to ``verify``/status
+surfaces.
+
+**Strict torn-tail-only recovery**: the ONLY tolerated damage is an
+undecodable (or CRC-failing) FINAL line — the partial write a crash
+mid-append leaves. It is logically truncated and the journal resumes
+from the surviving prefix, digest-identical to resuming from that
+prefix (the SIGKILL tests' contract). Everything else — an undecodable
+or CRC-failing line mid-file, a sequence gap, a duplicated or reordered
+record — raises a structured ``E_CORRUPT`` (``JournalCorrupt``) naming
+the journal kind, record index, and byte offset. The silent
+``continue``-past-anything readers this replaces turned all of those
+into a wrong-prefix resume that still claimed digest fidelity.
+
+**Storage fault domain**: appends run inside
+``faults.run_io("journal_append", ...)`` — ENOSPC/EIO are classified
+(``E_STORAGE_FULL`` deterministic, ``E_STORAGE_IO`` transient, same
+taxonomy discipline as device faults) and deterministically injectable
+via ``SIMON_FAULT_PLAN`` (``fn=journal_append,exc=enospc,launch=k``). A
+storage fault that outlives the retry schedule takes the shared
+``checkpointing_disabled`` degradation rung: the run continues, the
+journal stops, the rung is metric-counted (``simon_journal_*``) and
+ledger-evented — one shared, visible rung instead of four private
+copies of a warning line. A partial write that precedes a retry is
+truncated back first, so a retried append can never leave a torn line
+*mid*-file.
+
+Everything here is HOST machinery (files, CRCs, counters) — nothing
+runs inside jit/scan scope (graftlint GL4).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from open_simulator_tpu.errors import SimulationError
+
+_log = logging.getLogger(__name__)
+
+E_CORRUPT = "E_CORRUPT"
+
+FRAME_PREFIX = b"J1 "
+FORMAT_FRAMED = "framed"
+FORMAT_LEGACY = "legacy"
+
+
+class ResumeError(SimulationError):
+    """Bad resume request: unknown id, fingerprint mismatch, parameter
+    drift. (Home module; re-exported as ``lifecycle.ResumeError``.)"""
+
+    code = "E_RESUME"
+
+
+class JournalCorrupt(SimulationError):
+    """Durable state failed the integrity scan somewhere OTHER than the
+    torn tail: a mid-file undecodable/CRC-failing line, a sequence gap,
+    a duplicated or reordered record. Resuming past it would fabricate a
+    wrong-prefix trajectory while still claiming digest fidelity, so
+    every resume/rehydrate path refuses with this structured error
+    instead. Carries the journal ``kind``, 0-based record ``index``, and
+    byte ``offset`` of the first bad record."""
+
+    code = E_CORRUPT
+
+    def __init__(self, message: str, *, kind: str = "", index: int = -1,
+                 offset: int = -1, path: str = "", **kw):
+        kw.setdefault("ref", f"journal/{kind}" if kind else "journal")
+        kw.setdefault(
+            "hint",
+            "the journal cannot be resumed; quarantine or delete the file "
+            "and re-run from scratch (the torn-tail rule only forgives a "
+            "partial FINAL line)")
+        super().__init__(message, code=E_CORRUPT, **kw)
+        self.kind = kind
+        self.index = int(index)
+        self.offset = int(offset)
+        self.path = path
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = super().to_dict()
+        out["journal"] = {"kind": self.kind, "index": self.index,
+                          "offset": self.offset,
+                          "file": os.path.basename(self.path)}
+        return out
+
+
+def _json_default(o):
+    import numpy as np
+
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not JSON serializable: {type(o).__name__}")
+
+
+# ---- metrics -------------------------------------------------------------
+
+
+def _metrics():
+    from open_simulator_tpu import telemetry
+
+    return (
+        telemetry.counter(
+            "simon_journal_appends_total",
+            "journal records durably appended (framed + fsynced)",
+            labelnames=("kind",)),
+        telemetry.counter(
+            "simon_journal_disabled_total",
+            "checkpointing_disabled degradation rungs: a storage fault "
+            "outlived the retry schedule and journaling latched off for "
+            "the rest of the run",
+            labelnames=("kind", "code")),
+        telemetry.counter(
+            "simon_journal_corrupt_total",
+            "integrity scans that found mid-file corruption (structured "
+            "E_CORRUPT; the journal is unresumable)",
+            labelnames=("kind",)),
+        telemetry.counter(
+            "simon_journal_recovered_total",
+            "loads that tolerated weaker-than-framed state: torn final "
+            "lines truncated, legacy unframed journals accepted",
+            labelnames=("kind", "event")),  # torn_tail | legacy
+    )
+
+
+# ---- frame codec ---------------------------------------------------------
+
+
+def frame_record(seq: int, rec: Dict[str, Any]) -> bytes:
+    """One framed journal line: prefix, CRC32 over ``"<seq> <payload>"``,
+    sequence number, canonical JSON payload."""
+    payload = json.dumps(rec, sort_keys=True, default=_json_default)
+    body = f"{int(seq)} {payload}".encode("utf-8")
+    crc = zlib.crc32(body) & 0xFFFFFFFF
+    return FRAME_PREFIX + f"{crc:08x} ".encode() + body + b"\n"
+
+
+def unframe_line(line) -> str:
+    """Return the JSON payload of one journal line, framed or legacy.
+
+    Convenience for tests/tools that eyeball journal files line by line;
+    production reads go through :func:`read_journal`, which verifies CRCs
+    and sequence numbers instead of trusting the split.
+    """
+    if isinstance(line, bytes):
+        line = line.decode("utf-8")
+    if line.startswith(FRAME_PREFIX.decode()):
+        return line.split(" ", 3)[3]
+    return line
+
+
+class _BadLine(Exception):
+    """A line that failed to decode. ``tolerable`` marks damage a torn
+    write could produce (garbage bytes / partial line / bad CRC) —
+    forgivable at the tail only. Sequence violations on a line whose CRC
+    *verified* can never come from a torn write and are never
+    tolerable."""
+
+    def __init__(self, reason: str, tolerable: bool = True):
+        super().__init__(reason)
+        self.reason = reason
+        self.tolerable = tolerable
+
+
+def _decode_frame(raw: bytes, expect_seq: int) -> Dict[str, Any]:
+    parts = raw.split(b" ", 3)
+    if len(parts) != 4 or parts[0] != b"J1":
+        raise _BadLine("not a J1-framed line")
+    _, crc_hex, seq_b, payload = parts
+    try:
+        want_crc = int(crc_hex, 16)
+    except ValueError:
+        raise _BadLine(f"unparsable crc field {crc_hex[:16]!r}") from None
+    body = seq_b + b" " + payload
+    have_crc = zlib.crc32(body) & 0xFFFFFFFF
+    if have_crc != want_crc:
+        raise _BadLine(
+            f"crc mismatch (recorded {want_crc:08x}, computed "
+            f"{have_crc:08x}) — the line's bytes changed after it was "
+            f"written")
+    # CRC verified: the line is exactly what some append wrote. Any seq
+    # violation now means a record went missing, was duplicated, or was
+    # moved — never a torn write.
+    try:
+        seq = int(seq_b)
+    except ValueError:
+        raise _BadLine(f"unparsable seq field {seq_b[:16]!r}",
+                       tolerable=False) from None
+    if seq != expect_seq:
+        raise _BadLine(
+            f"sequence break: expected record #{expect_seq}, found "
+            f"#{seq} (gap, duplicate, or reordered line)",
+            tolerable=False)
+    try:
+        rec = json.loads(payload)
+    except json.JSONDecodeError as e:
+        # CRC over broken JSON means the writer framed garbage — treat
+        # as corruption, not a torn tail
+        raise _BadLine(f"framed payload is not JSON: {e}",
+                       tolerable=False) from None
+    if not isinstance(rec, dict):
+        raise _BadLine("framed payload is not a JSON object",
+                       tolerable=False)
+    return rec
+
+
+def _decode_legacy(raw: bytes) -> Dict[str, Any]:
+    try:
+        rec = json.loads(raw)
+    except json.JSONDecodeError as e:
+        raise _BadLine(f"unparsable JSON line: {e}") from None
+    if not isinstance(rec, dict):
+        raise _BadLine("record is not a JSON object")
+    return rec
+
+
+# ---- the strict reader ---------------------------------------------------
+
+
+@dataclass
+class JournalScan:
+    """One verified read of a journal file."""
+
+    records: List[Dict[str, Any]] = field(default_factory=list)
+    legacy: bool = False
+    torn_tail: bool = False
+    torn_offset: int = -1        # byte offset of the truncated line
+    next_seq: int = 0            # the seq the next append must carry
+    path: str = ""
+
+    @property
+    def format(self) -> str:
+        return FORMAT_LEGACY if self.legacy else FORMAT_FRAMED
+
+    def integrity(self) -> Dict[str, Any]:
+        """The status-surface summary of what this load guarantees."""
+        out: Dict[str, Any] = {"format": self.format}
+        if self.torn_tail:
+            out["torn_tail"] = True
+        return out
+
+
+def read_journal(path: str, kind: str) -> JournalScan:
+    """Parse + verify a journal file under the strict torn-tail-only
+    recovery rule. Returns the verified record prefix; raises
+    ``JournalCorrupt`` on anything a crash mid-append cannot explain."""
+    with open(path, "rb") as f:
+        data = f.read()
+    lines = data.split(b"\n")
+    if lines and lines[-1] == b"":
+        lines.pop()  # the final newline's empty remainder
+    scan = JournalScan(path=path)
+    if lines:
+        scan.legacy = not lines[0].startswith(FRAME_PREFIX)
+    offset = 0
+    for i, raw in enumerate(lines):
+        line_off = offset
+        offset += len(raw) + 1
+        last = i == len(lines) - 1
+        try:
+            if scan.legacy:
+                if not raw.strip():
+                    raise _BadLine("blank line inside the journal")
+                rec = _decode_legacy(raw)
+            else:
+                rec = _decode_frame(raw, scan.next_seq)
+        except _BadLine as bad:
+            if last and bad.tolerable:
+                # the torn tail: a partial final write, logically
+                # truncated — resuming from the prefix is the contract
+                scan.torn_tail = True
+                scan.torn_offset = line_off
+                _metrics()[3].labels(kind=kind, event="torn_tail").inc()
+                _log.warning(
+                    "%s journal %s: dropped torn final line at byte "
+                    "offset %d (%s); resuming from the %d-record prefix",
+                    kind, path, line_off, bad.reason, len(scan.records))
+                break
+            _metrics()[2].labels(kind=kind).inc()
+            raise JournalCorrupt(
+                f"{kind} journal {os.path.basename(path)} is corrupt at "
+                f"record #{i} (byte offset {line_off}): {bad.reason}",
+                kind=kind, index=i, offset=line_off, path=path) from None
+        scan.records.append(rec)
+        scan.next_seq += 1
+    if scan.legacy and scan.records:
+        _metrics()[3].labels(kind=kind, event="legacy").inc()
+        _log.warning(
+            "%s journal %s is legacy (unframed plain JSON): bit-flip and "
+            "record-loss detection unavailable; only torn-tail recovery "
+            "is guaranteed", kind, path)
+    return scan
+
+
+def scan_integrity(path: str, kind: str) -> Optional[JournalCorrupt]:
+    """Cheap startup integrity probe (``SessionStore.scan``): run the
+    strict reader and report the corruption verdict instead of raising.
+    Unreadable files return None — absence/permissions are a different
+    failure (the open path reports those)."""
+    try:
+        read_journal(path, kind)
+    except JournalCorrupt as e:
+        return e
+    except OSError:
+        return None
+    return None
+
+
+# ---- shared token resolution ---------------------------------------------
+
+
+def resolve_journal_path(root: str, token: str, suffix: str,
+                         noun: str) -> str:
+    """Resolve ``token`` (unique id prefix, or ``last``/``latest`` for
+    the newest journal) to a path — the resolution logic every journal
+    kind shares. Raises ``ResumeError`` for missing dirs and unknown or
+    ambiguous tokens."""
+    if not root or not os.path.isdir(root):
+        raise ResumeError(
+            f"no checkpoint directory at {root!r}", ref="resume",
+            hint="run with --ledger-dir (checkpoints live in "
+                 "<ledger>/checkpoints) or set SIMON_CHECKPOINT_DIR")
+    names = sorted(n for n in os.listdir(root) if n.endswith(suffix))
+    if not names:
+        raise ResumeError(f"no {noun} checkpoints under {root}",
+                          ref="resume")
+    if token in ("last", "latest"):
+        pick = max(names,
+                   key=lambda n: os.path.getmtime(os.path.join(root, n)))
+    else:
+        hits = [n for n in names if n.startswith(token)]
+        if not hits:
+            raise ResumeError(
+                f"no {noun} checkpoint matches {token!r}", ref="resume",
+                hint=f"known: {[n.split('.')[0] for n in names]}")
+        if len(hits) > 1:
+            raise ResumeError(
+                f"{noun} id prefix {token!r} is ambiguous: "
+                f"{[n.split('.')[0] for n in hits]}", ref="resume")
+        pick = hits[0]
+    return os.path.join(root, pick)
+
+
+# ---- the durable journal base --------------------------------------------
+
+
+class DurableJournal:
+    """The shared framed writer + verified-state holder the four journal
+    kinds (sweep, campaign, replay, session) collapse onto. Subclasses
+    keep their record schemas and public APIs; this base owns:
+
+    * ``_append``: frame + fsync through the ``journal_append`` storage
+      fault domain, with the shared ``checkpointing_disabled``
+      degradation rung (latched ``broken`` + ``broken_code``, counted
+      and ledger-evented — the run continues, crash-safety stops);
+    * the format/integrity bookkeeping (``legacy``, ``torn_tail``,
+      monotone ``seq``) a strict load threads in via ``_adopt_scan``.
+    """
+
+    KIND = "journal"
+
+    def __init__(self, path: str, header: Dict[str, Any]):
+        self.path = path
+        self.header = header
+        self.legacy = False
+        self.torn_tail = False
+        self._seq = 0
+        # storage-degradation latch: a full disk mid-run disables
+        # journaling with ONE counted rung (the run itself must finish;
+        # only crash recovery past this point is lost)
+        self.broken = False
+        self.broken_code: Optional[str] = None
+        # byte offset of a torn final line to physically drop before the
+        # first resumed append — appending AFTER the partial bytes would
+        # turn the tolerated tail into the mid-file corruption the
+        # strict reader refuses
+        self._truncate_at: Optional[int] = None
+
+    def _adopt_scan(self, scan: JournalScan) -> None:
+        self.legacy = scan.legacy
+        self.torn_tail = scan.torn_tail
+        self._seq = scan.next_seq
+        if scan.torn_tail and scan.torn_offset >= 0:
+            self._truncate_at = scan.torn_offset
+
+    def integrity(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "format": FORMAT_LEGACY if self.legacy else FORMAT_FRAMED}
+        if self.torn_tail:
+            out["torn_tail"] = True
+        if self.broken:
+            out["checkpointing_disabled"] = True
+            out["storage_fault"] = self.broken_code
+        return out
+
+    # -- writing ---------------------------------------------------------
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        from open_simulator_tpu.resilience import faults
+
+        if self.broken:
+            return
+        if self.legacy:
+            # a legacy journal keeps its format: mixing framed lines into
+            # an unframed file would make BOTH readers reject it
+            line = json.dumps(rec, sort_keys=True,
+                              default=_json_default).encode() + b"\n"
+        else:
+            line = frame_record(self._seq, rec)
+
+        def write() -> None:
+            if self._truncate_at is not None:
+                with open(self.path, "r+b") as tf:
+                    tf.truncate(self._truncate_at)
+                self._truncate_at = None
+            with open(self.path, "ab") as f:
+                start = f.tell()
+                try:
+                    f.write(line)
+                    f.flush()
+                    os.fsync(f.fileno())
+                except OSError:
+                    # drop any partial write before a retry re-appends:
+                    # a retried append must never leave a torn line
+                    # MID-file (that is the corruption the strict
+                    # reader refuses)
+                    try:
+                        f.truncate(start)
+                    except OSError:
+                        pass
+                    raise
+
+        try:
+            faults.run_io("journal_append", write)
+        except faults.DeviceFault as e:
+            self._disable(e.code, e)
+            return
+        except OSError as e:  # unclassified storage trouble: same rung
+            self._disable(faults.E_STORAGE_IO, e)
+            return
+        self._seq += 1
+        _metrics()[0].labels(kind=self.KIND).inc()
+
+    def _disable(self, code: str, err: Exception) -> None:
+        from open_simulator_tpu.resilience import faults
+
+        self.broken = True
+        self.broken_code = code
+        _metrics()[1].labels(kind=self.KIND, code=code).inc()
+        # the shared, ledger-visible rung (simon_fault_rungs_total + a
+        # ledger "fault" event) — no longer a private log line per kind
+        faults.record_rung("journal_append", "checkpointing_disabled",
+                           code)
+        _log.warning(
+            "%s journal %s is unwritable (%s: %s); checkpointing "
+            "disabled for the rest of this run — it cannot be resumed "
+            "past the last durable record", self.KIND, self.path, code,
+            err)

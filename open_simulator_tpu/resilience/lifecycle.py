@@ -662,12 +662,16 @@ class AdmissionQueue:
 
 # ---- sweep checkpoint journal -------------------------------------------
 
-
-class ResumeError(SimulationError):
-    """Bad resume request: unknown id, fingerprint mismatch, parameter
-    drift."""
-
-    code = "E_RESUME"
+# home module is resilience/journal.py (the shared durable-journal
+# subsystem); re-exported here for the pre-existing import paths
+from open_simulator_tpu.resilience.journal import (  # noqa: E402
+    DurableJournal,
+    JournalCorrupt,
+    ResumeError,
+    _json_default,
+    read_journal,
+    resolve_journal_path,
+)
 
 
 def checkpoint_dir() -> Optional[str]:
@@ -683,19 +687,7 @@ def checkpoint_dir() -> Optional[str]:
     return os.path.join(d, "checkpoints") if d else None
 
 
-def _json_default(o):
-    import numpy as np
-
-    if isinstance(o, (np.integer,)):
-        return int(o)
-    if isinstance(o, (np.floating,)):
-        return float(o)
-    if isinstance(o, np.ndarray):
-        return o.tolist()
-    raise TypeError(f"not JSON serializable: {type(o).__name__}")
-
-
-class SweepJournal:
+class SweepJournal(DurableJournal):
     """Append-only per-sweep round log. One file per sweep; each line is
     a self-contained JSON record:
 
@@ -712,19 +704,20 @@ class SweepJournal:
     the interrupted one — bit-identical, since probes are deterministic.
     Floats round-trip exactly through JSON (repr-based), so reconstructed
     verdicts equal the originals.
+
+    Records ride the shared ``DurableJournal`` frame (CRC32 + monotone
+    seq, ARCH §19): a torn final line resumes from the prefix, anything
+    worse is a structured ``E_CORRUPT``.
     """
+
+    KIND = "sweep"
 
     def __init__(self, path: str, header: Dict[str, Any],
                  rounds: Optional[List[Dict[str, Any]]] = None,
                  done: Optional[Dict[str, Any]] = None):
-        self.path = path
-        self.header = header
+        super().__init__(path, header)
         self.rounds = rounds or []
         self.done = done
-        # unwritable-journal latch: a full disk mid-sweep degrades
-        # checkpointing to disabled-with-one-warning (the sweep itself
-        # must finish; only crash recovery is lost)
-        self.broken = False
 
     @property
     def sweep_id(self) -> str:
@@ -766,51 +759,28 @@ class SweepJournal:
     @classmethod
     def load(cls, root: str, token: str) -> "SweepJournal":
         """Resolve ``token`` (unique sweep-id prefix, or ``last`` for the
-        newest journal) and parse the file. Corrupt trailing lines (a
-        crash mid-append) are dropped, not fatal."""
-        if not root or not os.path.isdir(root):
-            raise ResumeError(
-                f"no checkpoint directory at {root!r}",
-                ref="resume", hint="run with --ledger-dir (checkpoints live "
-                "in <ledger>/checkpoints) or set SIMON_CHECKPOINT_DIR")
-        names = sorted(n for n in os.listdir(root)
-                       if n.endswith(SWEEP_JOURNAL_SUFFIX))
-        if not names:
-            raise ResumeError(f"no sweep checkpoints under {root}",
-                              ref="resume")
-        if token in ("last", "latest"):
-            pick = max(names, key=lambda n: os.path.getmtime(
-                os.path.join(root, n)))
-        else:
-            hits = [n for n in names if n.startswith(token)]
-            if not hits:
-                raise ResumeError(
-                    f"no sweep checkpoint matches {token!r}", ref="resume",
-                    hint=f"known: {[n.split('.')[0] for n in names]}")
-            if len(hits) > 1:
-                raise ResumeError(
-                    f"sweep id prefix {token!r} is ambiguous: "
-                    f"{[n.split('.')[0] for n in hits]}", ref="resume")
-            pick = hits[0]
-        path = os.path.join(root, pick)
+        newest journal) and run the strict reader: only a torn FINAL
+        line (a crash mid-append) is dropped; mid-file corruption or a
+        sequence gap is a structured ``E_CORRUPT``."""
+        path = resolve_journal_path(root, token, SWEEP_JOURNAL_SUFFIX,
+                                    "sweep")
+        scan = read_journal(path, cls.KIND)
         header, rounds, done = None, [], None
-        with open(path, "r", encoding="utf-8") as f:
-            for ln in f:
-                try:
-                    rec = json.loads(ln)
-                except json.JSONDecodeError:
-                    continue  # crash mid-append: drop the torn line
-                kind = rec.get("kind")
-                if kind == "header":
-                    header = rec
-                elif kind == "round":
-                    rounds.append(rec)
-                elif kind == "done":
-                    done = rec
+        for rec in scan.records:
+            kind = rec.get("kind")
+            if kind == "header":
+                header = rec
+            elif kind == "round":
+                rounds.append(rec)
+            elif kind == "done":
+                done = rec
         if header is None:
-            raise ResumeError(f"checkpoint {pick} has no header line",
-                              ref="resume")
-        return cls(path, header, rounds, done)
+            raise ResumeError(
+                f"checkpoint {os.path.basename(path)} has no header line",
+                ref="resume")
+        journal = cls(path, header, rounds, done)
+        journal._adopt_scan(scan)
+        return journal
 
     # -- verification ----------------------------------------------------
 
@@ -847,25 +817,7 @@ class SweepJournal:
                 + "; ".join(mismatches), ref=f"sweep/{self.sweep_id}",
                 hint="resume with the original --max-new-nodes/thresholds")
 
-    # -- writing ---------------------------------------------------------
-
-    def _append(self, rec: Dict[str, Any]) -> None:
-        if self.broken:
-            return
-        line = json.dumps(rec, sort_keys=True, default=_json_default) + "\n"
-        try:
-            with open(self.path, "a", encoding="utf-8") as f:
-                f.write(line)
-                f.flush()
-                os.fsync(f.fileno())
-        except OSError as e:
-            # disk full / dir went readonly mid-run: the run continues,
-            # checkpointing stops — warn ONCE, never crash the sweep
-            self.broken = True
-            _log.warning(
-                "checkpoint journal %s is unwritable (%s); checkpointing "
-                "disabled for the rest of this run — it cannot be resumed "
-                "past the last complete line", self.path, e)
+    # -- writing (the shared DurableJournal._append) ---------------------
 
     def append_round(self, counts: List[int],
                      lanes: Dict[int, Dict[str, Any]]) -> None:

@@ -793,9 +793,12 @@ class SimulationServer:
                 f"limit must be an integer, got {raw_limit!r}",
                 code="E_BAD_REQUEST", ref="request", field="limit",
                 hint="GET /api/runs?limit=20") from None
-        return {"ledger_dir": led.root,
-                "runs": [ledger.run_summary(r)
-                         for r in led.records(surface=surface, limit=limit)]}
+        runs = [ledger.run_summary(r)
+                for r in led.records(surface=surface, limit=limit)]
+        # corrupt lines the read skipped: operators watching this
+        # endpoint see the ledger rotting instead of a shrinking history
+        return {"ledger_dir": led.root, "runs": runs,
+                "skipped_corrupt": led.skipped_corrupt}
 
     def run_record(self, run_id: str) -> Dict[str, Any]:
         """One full RunRecord (GET /api/runs/<id|last|prev>)."""

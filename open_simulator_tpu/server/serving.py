@@ -117,6 +117,12 @@ STATUS_BY_CODE = {
     "E_TRANSFER": 503,
     "E_NUMERIC": 500,
     "E_COMPILE": 500,
+    # durable-state fault domain (resilience/journal.py, ARCH §19)
+    "E_CORRUPT": 409,       # journal failed the integrity scan: the
+                            # resume/rehydrate CONFLICTS with what
+                            # survived on disk — unresumable, not a 5xx
+    "E_STORAGE_FULL": 507,  # Insufficient Storage, deterministically
+    "E_STORAGE_IO": 503,    # transient disk trouble past its retries
 }
 
 

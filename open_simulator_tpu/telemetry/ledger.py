@@ -375,10 +375,16 @@ def append_event(surface: str, tags: Optional[Dict[str, Any]] = None,
         "env": _environment(),
         "tags": dict(tags or {}),
     }
+    from open_simulator_tpu.resilience.faults import DeviceFault
+
     try:
         led.append(rec)
-    except OSError as e:
-        mark_unwritable(led.root, e)  # one warning, then disabled
+    except (OSError, DeviceFault) as e:
+        # classified storage fault (E_STORAGE_FULL after run_io's retry
+        # schedule) or a raw OSError: one warning, then disabled.
+        # Deliberately NOT record_rung — that writes a ledger event, and
+        # the ledger is the thing that just failed (recursion).
+        mark_unwritable(led.root, e)
         return None
     except Exception as e:  # noqa: BLE001 — lifecycle records are best-effort
         _log.warning("ledger append failed (%s): %s", led.path, e)
@@ -418,11 +424,14 @@ def run_capture(surface: str,
         yield cap
     finally:
         _tls.active = False
+    from open_simulator_tpu.resilience.faults import DeviceFault
+
     try:
         led.append(cap.finish())
-    except OSError as e:
-        # unwritable dir / full disk: one warning, then recording goes
-        # dark for this process instead of warning on every later run
+    except (OSError, DeviceFault) as e:
+        # unwritable dir / full disk (raw, or classified E_STORAGE_*
+        # out of run_io): one warning, then recording goes dark for
+        # this process instead of warning on every later run
         mark_unwritable(led.root, e)
     except Exception as e:  # noqa: BLE001 — a non-JSON tag, ...:
         # the flight recorder must never take the plane down
@@ -433,7 +442,15 @@ def run_capture(surface: str,
 
 
 class Ledger:
-    """Append-only JSON-lines store with one-generation size rotation."""
+    """Append-only JSON-lines store with one-generation size rotation.
+
+    Writes (the append itself AND the rotation rename) run inside the
+    ``ledger_append`` storage fault domain (resilience/faults.py, ARCH
+    §19): EIO retries on disk timescales, ENOSPC escapes as a
+    deterministic ``E_STORAGE_FULL`` DeviceFault for the callers'
+    ``mark_unwritable`` latch. Reads count what they skip
+    (``skipped_corrupt``) so a rotting ledger cannot quietly shrink the
+    regression window."""
 
     def __init__(self, root: str, max_bytes: Optional[int] = None):
         self.root = root
@@ -445,34 +462,59 @@ class Ledger:
                 max_bytes = DEFAULT_MAX_BYTES
         self.max_bytes = max(4096, int(max_bytes))
         self.path = os.path.join(root, LEDGER_FILE)
+        # corrupt lines skipped by the most recent records() call — the
+        # CLI/REST/bench read paths surface this instead of hiding it
+        self.skipped_corrupt = 0
 
     def append(self, record: Dict[str, Any]) -> None:
+        from open_simulator_tpu.resilience import faults
+
         line = json.dumps(record, sort_keys=True) + "\n"
-        with _io_lock:
-            os.makedirs(self.root, exist_ok=True)
-            size = os.path.getsize(self.path) if os.path.exists(self.path) else 0
-            if size and size + len(line) > self.max_bytes:
-                # rotate: current generation becomes .1 (prior .1 dropped)
-                os.replace(self.path, self.path + ".1")
-            with open(self.path, "a", encoding="utf-8") as f:
-                f.write(line)
+
+        def write() -> None:
+            with _io_lock:
+                os.makedirs(self.root, exist_ok=True)
+                size = (os.path.getsize(self.path)
+                        if os.path.exists(self.path) else 0)
+                if size and size + len(line) > self.max_bytes:
+                    # rotate: current generation becomes .1 (prior .1
+                    # dropped)
+                    os.replace(self.path, self.path + ".1")
+                with open(self.path, "a", encoding="utf-8") as f:
+                    f.write(line)
+
+        faults.run_io("ledger_append", write)
 
     def records(self, surface: Optional[str] = None,
                 limit: Optional[int] = None) -> List[Dict[str, Any]]:
         """All parseable records, oldest first (.1 generation included).
-        Corrupt lines (a crash mid-append) are skipped, not fatal."""
+        Corrupt lines (a crash mid-append, bit rot) are skipped but
+        COUNTED into ``self.skipped_corrupt`` — the read survives, the
+        damage is visible."""
         out: List[Dict[str, Any]] = []
+        skipped = 0
         for path in (self.path + ".1", self.path):
             if not os.path.exists(path):
                 continue
             with open(path, "r", encoding="utf-8") as f:
                 for ln in f:
+                    if not ln.strip():
+                        continue  # a blank line is not a record
                     try:
                         rec = json.loads(ln)
                     except json.JSONDecodeError:
+                        skipped += 1
                         continue
                     if isinstance(rec, dict) and rec.get("run_id"):
                         out.append(rec)
+                    else:
+                        skipped += 1  # parseable JSON, not a RunRecord
+        self.skipped_corrupt = skipped
+        if skipped:
+            _log.warning(
+                "run ledger %s: skipped %d corrupt record(s) — the "
+                "regression window is smaller than the file suggests",
+                self.path, skipped)
         if surface:
             out = [r for r in out if r.get("surface") == surface]
         out.sort(key=lambda r: r.get("ts", 0.0))

@@ -32,6 +32,7 @@ from open_simulator_tpu.errors import SimulationError
 from open_simulator_tpu.k8s.cluster_source import ClusterSourceError
 from open_simulator_tpu.k8s.loader import ClusterResources
 from open_simulator_tpu.resilience import lifecycle
+from open_simulator_tpu.resilience.journal import unframe_line
 from tests.conftest import make_node, make_pod
 
 FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -231,7 +232,8 @@ def test_sigkill_mid_campaign_then_resume_bit_identical(fleet_dir,
 
     [name] = [n for n in os.listdir(ckpt) if n.endswith(".campaign.jsonl")]
     with open(ckpt / name, encoding="utf-8") as f:
-        kinds = [json.loads(ln)["kind"] for ln in f if ln.strip()]
+        kinds = [json.loads(unframe_line(ln))["kind"] for ln in f
+                 if ln.strip()]
     assert kinds == ["header", "cluster"]  # torn mid-campaign
 
     os.environ[lifecycle.CHECKPOINT_DIR_ENV] = str(ckpt)

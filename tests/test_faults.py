@@ -86,9 +86,10 @@ def test_device_fault_is_structured_and_status_mapped():
     assert f.to_dict()["code"] == "E_DEVICE_LOST"
     assert f.ref == "device/batched_schedule"
     # every taxonomy code maps to an explicit 5xx — no classified device
-    # fault ever renders as an unstructured default
+    # fault ever renders as an unstructured default (507 = the storage
+    # class's Insufficient Storage, ARCH §19)
     for code in faults.DEVICE_FAULT_CODES:
-        assert STATUS_BY_CODE[code] in (500, 502, 503), code
+        assert STATUS_BY_CODE[code] in (500, 502, 503, 507), code
     assert status_for(f) == 503
 
 
@@ -142,7 +143,13 @@ def test_fault_plan_malformed_is_structured():
 
 def _mutate(text: str, rng: random.Random) -> str:
     """One random mutilation of a valid plan string."""
-    ops = rng.randint(0, 6)
+    ops = rng.randint(0, 8)
+    if ops == 7:                       # bogus storage I/O site
+        return text.replace("journal_append",
+                            rng.choice(["journal_rotate", "", "append "]))
+    if ops == 8:                       # bogus storage exception class
+        return text.replace("enospc",
+                            rng.choice(["efull", "ENOSPC!", "enospc=1"]))
     if ops == 0:                       # truncate
         return text[: rng.randint(0, len(text) - 1)]
     if ops == 1:                       # unknown fn
@@ -168,7 +175,8 @@ def test_fault_plan_fuzz_50_seeds():
     that round-trips through its canonical form and digest — never a
     traceback (the ChaosPlan fuzz contract applied to runtime faults)."""
     valid = ("fn=batched_schedule,exc=oom,launch=1,times=2;"
-             "fn=serving_lanes,exc=transfer")
+             "fn=serving_lanes,exc=transfer;"
+             "fn=journal_append,exc=enospc,launch=3")
     outcomes = {"rejected": 0, "parsed": 0}
     for seed in range(50):
         rng = random.Random(seed)

@@ -16,6 +16,7 @@ import pytest
 
 from open_simulator_tpu import telemetry
 from open_simulator_tpu.resilience import lifecycle
+from open_simulator_tpu.resilience.journal import unframe_line
 from open_simulator_tpu.resilience.retry import backoff_delay, run_with_retries
 from open_simulator_tpu.server.rest import SimulationServer, _make_handler
 
@@ -366,8 +367,8 @@ def test_bisect_checkpoints_and_resumes_identically(tmp_path, monkeypatch):
     with open(full.path, "r", encoding="utf-8") as f:
         lines = f.readlines()
     kept = [ln for ln in lines
-            if json.loads(ln).get("kind") == "header"
-            or json.loads(ln).get("round") == 1]
+            if json.loads(unframe_line(ln)).get("kind") == "header"
+            or json.loads(unframe_line(ln)).get("round") == 1]
     with open(full.path, "w", encoding="utf-8") as f:
         f.writelines(kept)
 
@@ -932,7 +933,7 @@ def test_drain_with_open_sessions_journals_and_resumes(tmp_path,
         # every settled step is on disk: header + baseline + the event
         jpath = tmp_path / (sid + sess_mod.SESSION_JOURNAL_SUFFIX)
         with open(jpath, encoding="utf-8") as f:
-            kinds = [json.loads(ln)["kind"] for ln in f]
+            kinds = [json.loads(unframe_line(ln))["kind"] for ln in f]
         assert kinds == ["header", "step", "step"]
         # "restart": a fresh server over the same checkpoint dir serves
         # the session bit-identically and keeps settling events

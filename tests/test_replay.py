@@ -43,6 +43,7 @@ from open_simulator_tpu.replay.synthetic import (
     _node_yaml,
 )
 from open_simulator_tpu.resilience import lifecycle
+from open_simulator_tpu.resilience.journal import unframe_line
 
 
 def _trace(events, **kw):
@@ -450,7 +451,8 @@ def test_sigkill_mid_replay_then_resume_digest_identical(tmp_path):
     [name] = [n for n in os.listdir(tmp_path)
               if n.endswith(REPLAY_JOURNAL_SUFFIX)]
     with open(tmp_path / name, encoding="utf-8") as f:
-        kinds = [json.loads(ln)["kind"] for ln in f if ln.strip()]
+        kinds = [json.loads(unframe_line(ln))["kind"] for ln in f
+                 if ln.strip()]
     assert kinds == ["header"] + ["step"] * KILL_AFTER_STEPS
 
     os.environ[lifecycle.CHECKPOINT_DIR_ENV] = str(tmp_path)

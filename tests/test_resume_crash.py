@@ -16,6 +16,7 @@ import sys
 import pytest
 
 from open_simulator_tpu.resilience import lifecycle
+from open_simulator_tpu.resilience.journal import unframe_line
 
 KILL_AFTER_ROUNDS = 2
 MAX_NEW = 8
@@ -92,7 +93,8 @@ def test_sigkill_mid_sweep_then_resume_matches_uninterrupted(tmp_path):
     [journal_name] = [n for n in os.listdir(tmp_path)
                       if n.endswith(lifecycle.SWEEP_JOURNAL_SUFFIX)]
     with open(tmp_path / journal_name, encoding="utf-8") as f:
-        kinds = [json.loads(ln)["kind"] for ln in f if ln.strip()]
+        kinds = [json.loads(unframe_line(ln))["kind"] for ln in f
+                 if ln.strip()]
     assert kinds == ["header", "round", "round"]
 
     # 4) resume replays the two recorded rounds and finishes the rest:

@@ -35,6 +35,7 @@ from open_simulator_tpu.replay.session import (
     SessionJournal,
 )
 from open_simulator_tpu.resilience import lifecycle
+from open_simulator_tpu.resilience.journal import frame_record, unframe_line
 
 N_NODES = 3
 N_INITIAL = 3
@@ -96,7 +97,7 @@ def test_session_baseline_events_status_close(tmp_path, no_checkpoint):
     [journal] = [n for n in os.listdir(tmp_path)
                  if n.endswith(SESSION_JOURNAL_SUFFIX)]
     with open(tmp_path / journal, encoding="utf-8") as f:
-        kinds = [json.loads(ln)["kind"] for ln in f]
+        kinds = [json.loads(unframe_line(ln))["kind"] for ln in f]
     assert kinds == ["header"] + ["step"] * 4
 
     out = sess.close()
@@ -212,9 +213,11 @@ def test_rehydrate_rejects_mangled_journal(tmp_path, no_checkpoint):
     # must refuse to rehydrate a journal whose payload no longer hashes
     # to what the header recorded
     lines = open(path, encoding="utf-8").read().splitlines()
-    header = json.loads(lines[0])
+    header = json.loads(unframe_line(lines[0]))
     header["cluster_docs"] = header["cluster_docs"][:-1]
-    lines[0] = json.dumps(header, sort_keys=True)
+    # re-frame with a VALID crc/seq: the integrity layer must pass and
+    # the semantic fingerprint check must be the one that refuses
+    lines[0] = frame_record(0, header).decode("utf-8").rstrip("\n")
     with open(path, "w", encoding="utf-8") as f:
         f.write("\n".join(lines) + "\n")
     with pytest.raises(lifecycle.ResumeError):

@@ -107,7 +107,14 @@ def main(argv=None) -> int:
         print("bench-regress: no ledger configured (--ledger-dir / "
               "SIMON_LEDGER_DIR) — nothing to gate")
         return 0
-    return gate(led.records(surface="bench"), args.threshold, args.window)
+    records = led.records(surface="bench")
+    if led.skipped_corrupt:
+        # a rotting ledger silently shrinks the regression window —
+        # surface the skip count instead of gating on partial history
+        print(f"bench-regress: WARNING — skipped {led.skipped_corrupt} "
+              f"corrupt ledger record(s) in {led.path}; the comparison "
+              f"window is smaller than the file suggests", file=sys.stderr)
+    return gate(records, args.threshold, args.window)
 
 
 if __name__ == "__main__":

@@ -96,7 +96,9 @@ def main() -> int:
     [journal] = [n for n in os.listdir(ckpt)
                  if n.endswith(".campaign.jsonl")]
     with open(os.path.join(ckpt, journal), encoding="utf-8") as f:
-        kinds = [json.loads(ln)["kind"] for ln in f if ln.strip()]
+        from open_simulator_tpu.resilience.journal import unframe_line
+        kinds = [json.loads(unframe_line(ln))["kind"] for ln in f
+                 if ln.strip()]
     assert kinds[0] == "header" and len(kinds) == 2 and "done" not in kinds, (
         f"expected a torn journal (header + 1 settled cluster), got {kinds}")
 

@@ -108,7 +108,9 @@ def main() -> int:
     [journal] = [n for n in os.listdir(ckpt)
                  if n.endswith(".replay.jsonl")]
     with open(os.path.join(ckpt, journal), encoding="utf-8") as f:
-        kinds = [json.loads(ln)["kind"] for ln in f if ln.strip()]
+        from open_simulator_tpu.resilience.journal import unframe_line
+        kinds = [json.loads(unframe_line(ln))["kind"] for ln in f
+                 if ln.strip()]
     assert kinds == ["header"] + ["step"] * KILL_AFTER_STEPS, (
         f"expected a torn journal, got {kinds}")
 

@@ -171,6 +171,15 @@ if [ "$rc" -ne 0 ]; then
 fi
 
 echo
+echo "== durable-state fault domain (torn tail resumes, mid-file corruption 409s, ENOSPC rung) =="
+make journal-smoke
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: journal-smoke exited $rc" >&2
+  exit "$rc"
+fi
+
+echo
 echo "== serving lifecycle (SIGTERM drain: readyz flip, 503s, in-flight finishes) =="
 make lifecycle-smoke
 rc=$?
