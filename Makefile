@@ -10,9 +10,11 @@ test:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
 
 # static trace-safety / engine-contract analysis (rules GL1-GL5);
-# exits nonzero on any finding — see ARCHITECTURE.md "graftlint"
+# exits nonzero on any finding — see ARCHITECTURE.md "graftlint".
+# Full tree, all rules (GL0-GL10), parallel parse; `simon-tpu lint
+# --changed` is the fast pre-commit subset, this target stays strict.
 lint:
-	python -m open_simulator_tpu.cli lint
+	python -m open_simulator_tpu.cli lint --jobs 4
 
 smoke:
 	bash tools/smoke.sh

@@ -1,3 +1,4 @@
+# graftlint: disable-file=GL6 bench times raw launch+sync latency; the fault-domain wrapper would add its own retries/backoff to the measurement
 """Benchmark: batched capacity-planning throughput on the local accelerator.
 
 Prints ONE JSON line:

@@ -28,6 +28,27 @@ Rule codes (catalog in ARCHITECTURE.md "Static analysis: graftlint"):
                              conditional (e.g. the compact_carry bf16
                              path) updated without an `.astype(...)`
                              guard — silent-promotion hazard
+  GL6  launch-wrap           device-dispatching calls (schedule_pods,
+                             batched_schedule, run_batched_cached,
+                             mesh_schedule, jit results invoked,
+                             block_until_ready) must execute under
+                             faults.run_launch/run_wave_launch/run_io
+  GL7  lock-order safety     static lock-acquisition graph over Lock/
+                             RLock/KeyedMutex holds: cycles, blocking
+                             cross-key KeyedMutex acquires, plain-lock
+                             holds spanning a device launch
+  GL8  boundary discipline   REST handlers and queue workers answer
+                             through STATUS_BY_CODE: no drifted status
+                             tables, no swallowing `except Exception`,
+                             no builtin raises escaping to a handler
+  GL9  durable-write         direct open(w/a)/os.write/fsync in
+                             resilience/, telemetry/, campaign/,
+                             replay/ must ride DurableJournal or a
+                             faults.run_io closure
+  GL10 metric-name drift     every simon_* name in code must resolve
+                             against a declared registry family and the
+                             ARCHITECTURE metric catalog; orphans and
+                             doc-only ghosts both flag
 """
 
 from __future__ import annotations
@@ -37,7 +58,8 @@ from typing import Any, Dict, List
 
 from open_simulator_tpu.errors import SimulationError
 
-RULE_CODES = ("GL0", "GL1", "GL2", "GL3", "GL4", "GL5")
+RULE_CODES = ("GL0", "GL1", "GL2", "GL3", "GL4", "GL5",
+              "GL6", "GL7", "GL8", "GL9", "GL10")
 
 
 @dataclasses.dataclass(frozen=True, order=True)

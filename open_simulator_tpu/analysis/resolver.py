@@ -30,7 +30,12 @@ SYNC_METHODS = {"item", "tolist", "numpy", "block_until_ready"}
 
 
 def import_map(module: Module) -> Dict[str, str]:
-    """Local name -> dotted module path, from the file's own imports."""
+    """Local name -> dotted module path, from the file's own imports.
+    Memoized on the module: the interprocedural rules resolve thousands
+    of call sites against the same parsed file."""
+    cached = getattr(module, "_import_map_cache", None)
+    if cached is not None:
+        return cached
     out: Dict[str, str] = {}
     for node in ast.walk(module.tree):
         if isinstance(node, ast.Import):
@@ -40,6 +45,7 @@ def import_map(module: Module) -> Dict[str, str]:
         elif isinstance(node, ast.ImportFrom) and node.module:
             for alias in node.names:
                 out[alias.asname or alias.name] = f"{node.module}.{alias.name}"
+    module._import_map_cache = out
     return out
 
 
@@ -387,7 +393,26 @@ def _decorator_static_argnames(dec: ast.Call) -> Set[str]:
 
 def traced_functions(module: Module) -> List[TracedFn]:
     imports = import_map(module)
-    defs = module_defs(module)
+    by_name: Dict[str, List[ast.AST]] = {}
+    for f in module.functions():
+        by_name.setdefault(f.name, []).append(f)
+
+    def lookup(name: str, at: ast.AST) -> Optional[ast.AST]:
+        """Scope-aware def lookup: with several same-named nested defs
+        (the exec-cache `lane` pair), pick the one sharing the innermost
+        enclosing function with the use site."""
+        cands = by_name.get(name, [])
+        if len(cands) <= 1:
+            return cands[0] if cands else None
+        scopes = [id(f) for f in enclosing_callables(module, at)] + [None]
+        best, best_rank = None, len(scopes)
+        for c in cands:
+            enc = module.enclosing_function(c)
+            key = id(enc) if enc is not None else None
+            if key in scopes and scopes.index(key) < best_rank:
+                best, best_rank = c, scopes.index(key)
+        return best if best is not None else cands[0]
+
     found: Dict[ast.AST, TracedFn] = {}
 
     def add(fn: ast.AST, evidence: str, extra_static: Set[str] = frozenset()):
@@ -427,12 +452,11 @@ def traced_functions(module: Module) -> List[TracedFn]:
         for arg in node.args[:1]:
             if isinstance(arg, ast.Lambda):
                 add(arg, f"{fname} argument")
-            elif isinstance(arg, ast.Name) and arg.id in defs:
-                add(defs[arg.id], f"{fname} argument")
+            elif isinstance(arg, ast.Name):
+                add(lookup(arg.id, node), f"{fname} argument")
             elif isinstance(arg, ast.Call) and is_partial(arg, imports) \
-                    and arg.args and isinstance(arg.args[0], ast.Name) \
-                    and arg.args[0].id in defs:
-                add(defs[arg.args[0].id], f"partial into {fname}")
+                    and arg.args and isinstance(arg.args[0], ast.Name):
+                add(lookup(arg.args[0].id, node), f"partial into {fname}")
     return list(found.values())
 
 
@@ -607,3 +631,413 @@ class TaintChecker:
                 emit(node, f"`.{node.func.attr}()` on a traced value",
                      node.func.attr)
         return out
+
+
+# ---- runtime-layer resolution (GL6-GL10) --------------------------------
+#
+# Shared machinery for the concurrency / fault-domain / boundary rules:
+# fault-wrapper recognition, device-dispatch classification, lock tokens
+# and their acquisition events, boundary-function detection, and the
+# SimulationError subclass universe. Everything below is name-based over
+# the parsed module set — same philosophy as the tensor rules: precise
+# about THIS repo's conventions, conservative about the rest.
+
+FAULT_WRAPPERS = frozenset({"run_launch", "run_io", "run_wave_launch"})
+
+# The wrappers that establish the *device* fault domain for GL7's
+# hold-spans-a-launch check. run_io is deliberately excluded: holding a
+# lock across serialized disk writes is the ledger/journal design, not a
+# hazard.
+LAUNCH_WRAPPERS = frozenset({"run_launch", "run_wave_launch"})
+
+# Device-dispatching entry points (the PR-14 audit list): calling any of
+# these fires compiled work on the accelerator.
+DISPATCH_FNS = frozenset({"schedule_pods", "batched_schedule",
+                          "run_batched_cached", "mesh_schedule"})
+
+
+def wrapper_name(call: ast.Call, imports: Dict[str, str]) -> str:
+    """'run_launch' (etc.) when `call` invokes a fault wrapper through
+    any alias or attribute path — `faults.run_io(...)`, `rl(...)` after
+    `from ...faults import run_launch as rl` — else ''."""
+    fname = full_name(call.func, imports)
+    last = fname.rsplit(".", 1)[-1]
+    return last if last in FAULT_WRAPPERS else ""
+
+
+def all_defs(module: Module) -> Dict[str, ast.FunctionDef]:
+    """Every def by bare name, nested included (module-level wins on
+    collision) — the lookup for locally-defined launch closures and
+    vmapped lane functions. Memoized on the module."""
+    cached = getattr(module, "_all_defs_cache", None)
+    if cached is not None:
+        return cached
+    out = dict(module_defs(module))
+    for fn in module.functions():
+        out.setdefault(fn.name, fn)
+    module._all_defs_cache = out
+    return out
+
+
+def wrapped_arg_names(module: Module) -> Set[str]:
+    """Names referenced inside the argument subtree of a fault-wrapper
+    call anywhere in the module. Covers both the closure handoff
+    (`faults.run_io("journal_append", write)`) and the thunk shape
+    (`faults.run_launch("schedule_pods", lambda: launch(None))`): in
+    either case the named callable runs inside the fault domain even
+    though its def precedes the call."""
+    imports = import_map(module)
+    out: Set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) and wrapper_name(node, imports):
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name):
+                        out.add(sub.id)
+    return out
+
+
+def enclosing_callables(module: Module, node: ast.AST) -> List[ast.AST]:
+    """def/lambda chain around `node`, innermost first."""
+    out: List[ast.AST] = []
+    cur = module.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            out.append(cur)
+        cur = module.parents.get(cur)
+    return out
+
+
+def inside_wrapper_arg(module: Module, node: ast.AST,
+                       imports: Dict[str, str]) -> bool:
+    """True when `node` sits in the argument subtree of a fault-wrapper
+    call (`run_launch(lambda: schedule_pods(...), "x")`)."""
+    cur = module.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.Call) and wrapper_name(cur, imports):
+            return True
+        cur = module.parents.get(cur)
+    return False
+
+
+def module_path_index(modules: List[Module]) -> Dict[str, Module]:
+    """Dotted import path -> parsed module, for cross-module resolution
+    (`open_simulator_tpu/server/exec_cache.py` ->
+    `open_simulator_tpu.server.exec_cache`)."""
+    out: Dict[str, Module] = {}
+    for m in modules:
+        if not m.rel.endswith(".py"):
+            continue
+        dotted = m.rel[:-3].replace("/", ".")
+        if dotted.endswith(".__init__"):
+            dotted = dotted[: -len(".__init__")]
+        out[dotted] = m
+    return out
+
+
+def resolve_def(name_expr: ast.AST, module: Module,
+                imports: Dict[str, str],
+                index: Dict[str, Module],
+                ) -> Optional[Tuple[Module, ast.FunctionDef]]:
+    """Resolve a call target to its def: module-local first, then across
+    the parsed module set through the import map."""
+    dotted = dotted_name(name_expr)
+    if not dotted:
+        return None
+    if "." not in dotted:
+        local = all_defs(module).get(dotted)
+        if local is not None:
+            return (module, local)
+    fname = full_name(name_expr, imports)
+    if "." in fname:
+        mod_path, _, leaf = fname.rpartition(".")
+        target = index.get(mod_path)
+        if target is not None:
+            d = module_defs(target).get(leaf)
+            if d is not None:
+                return (target, d)
+    return None
+
+
+def establishes_fault_domain(module: Module, fn: ast.FunctionDef,
+                             index: Dict[str, Module],
+                             _depth: int = 0,
+                             _seen: Optional[Set[int]] = None) -> bool:
+    """True when `fn`'s body (or a callee's, two levels deep) contains a
+    fault-wrapper call — the callee-owns-the-domain pattern that makes a
+    bare `run_batched_cached(...)` call site fine."""
+    memo = getattr(module, "_fault_domain_memo", None)
+    if memo is None:
+        memo = module._fault_domain_memo = {}
+    if _depth == 0 and id(fn) in memo:
+        return memo[id(fn)]
+    if _seen is None:
+        _seen = set()
+    if id(fn) in _seen or _depth > 2:
+        return False
+    _seen.add(id(fn))
+    imports = import_map(module)
+    result = False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and wrapper_name(node, imports):
+            result = True
+            break
+    if not result:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = resolve_def(node.func, module, imports, index)
+            if hit is not None and establishes_fault_domain(
+                    hit[0], hit[1], index, _depth + 1, _seen):
+                result = True
+                break
+    if _depth == 0:
+        memo[id(fn)] = result
+    return result
+
+
+# ---- lock tokens + acquisition events (GL7) -----------------------------
+
+LOCK_CTORS = {"Lock": "plain", "RLock": "reentrant", "KeyedMutex": "keyed"}
+
+
+@dataclass
+class LockToken:
+    name: str      # "NAME" (module global) or "Class.attr" (self-stored)
+    kind: str      # "plain" | "reentrant" | "keyed"
+    node: ast.AST  # construction site
+
+
+def lock_tokens(module: Module) -> Dict[str, LockToken]:
+    """Module-level `NAME = threading.Lock()` globals and
+    `self.attr = ...Lock()/KeyedMutex()` instance locks, keyed by token
+    name. Locks received as parameters are not tracked (documented
+    limitation)."""
+    imports = import_map(module)
+    out: Dict[str, LockToken] = {}
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        val = node.value
+        if not isinstance(val, ast.Call):
+            continue
+        last = full_name(val.func, imports).rsplit(".", 1)[-1]
+        kind = LOCK_CTORS.get(last)
+        if kind is None:
+            continue
+        tgt = node.targets[0]
+        if isinstance(tgt, ast.Name) and module.enclosing_function(node) is None:
+            out[tgt.id] = LockToken(tgt.id, kind, node)
+        elif isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+            cls = module.enclosing_class(node)
+            if cls is not None:
+                name = f"{cls.name}.{tgt.attr}"
+                out[name] = LockToken(name, kind, node)
+    return out
+
+
+def lock_token_of(expr: ast.AST, module: Module,
+                  tokens: Dict[str, LockToken]) -> Optional[LockToken]:
+    """The tracked token an expression denotes: a bare global name or a
+    `self.attr` inside the owning class."""
+    if isinstance(expr, ast.Name):
+        return tokens.get(expr.id)
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        cls = module.enclosing_class(expr)
+        if cls is not None:
+            return tokens.get(f"{cls.name}.{expr.attr}")
+    return None
+
+
+@dataclass
+class LockAcq:
+    """One blocking acquisition event inside a function."""
+
+    token: LockToken
+    key: Optional[str]          # normalized key text for keyed holds
+    node: ast.AST
+
+
+def qualname_of(module: Module, fn: ast.AST) -> str:
+    cls = module.enclosing_class(fn)
+    name = getattr(fn, "name", "<lambda>")
+    return f"{cls.name}.{name}" if cls is not None else name
+
+
+# ---- boundary functions (GL8) -------------------------------------------
+
+BUILTIN_EXCEPTIONS = frozenset({
+    "Exception", "BaseException", "ValueError", "TypeError", "RuntimeError",
+    "KeyError", "IndexError", "LookupError", "OSError", "IOError",
+    "NotImplementedError", "ArithmeticError", "ZeroDivisionError",
+    "AttributeError", "StopIteration",
+})
+
+
+def handler_classes(module: Module) -> Set[str]:
+    """Class names deriving (transitively, within the module) from an
+    `*HTTPRequestHandler` base."""
+    out: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for cls in module.classes():
+            if cls.name in out:
+                continue
+            for b in cls.bases:
+                last = dotted_name(b).rsplit(".", 1)[-1]
+                if last.endswith("HTTPRequestHandler") or last in out:
+                    out.add(cls.name)
+                    changed = True
+                    break
+    return out
+
+
+def boundary_functions(module: Module) -> Dict[ast.AST, str]:
+    """FunctionDef -> evidence string for every function that answers an
+    external caller: `do_*` REST handler methods, decorator-routed
+    handlers, and threads' `target=` queue workers."""
+    out: Dict[ast.AST, str] = {}
+    imports = import_map(module)
+    hcls = handler_classes(module)
+    defs = module_defs(module)
+    for fn in module.functions():
+        cls = module.enclosing_class(fn)
+        if fn.name.startswith("do_") and (cls is None or not hcls
+                                          or cls.name in hcls):
+            out.setdefault(fn, "REST handler method")
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if "route" in dotted_name(target).lower():
+                out.setdefault(fn, "decorator-routed handler")
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if full_name(node.func, imports).rsplit(".", 1)[-1] != "Thread":
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            if isinstance(kw.value, ast.Name) and kw.value.id in defs:
+                out.setdefault(defs[kw.value.id], "thread worker")
+            elif isinstance(kw.value, ast.Attribute) and \
+                    isinstance(kw.value.value, ast.Name) and \
+                    kw.value.value.id == "self":
+                cls = module.enclosing_class(node)
+                if cls is None:
+                    continue
+                for fn in module.functions():
+                    if fn.name == kw.value.attr and \
+                            module.enclosing_class(fn) is cls:
+                        out.setdefault(fn, "thread worker")
+    return out
+
+
+def boundary_delegates(module: Module,
+                       boundaries: Dict[ast.AST, str]) -> Dict[ast.AST, str]:
+    """One delegation level below the boundaries: `self._do_get()` or
+    bare-name calls from a boundary body to a same-module def. The
+    do_GET-dispatches-to-_do_get shape hid rest.py's broad-except
+    swallows from the boundary scan; GL8 runs only the swallow check on
+    delegates (not the escaping-raise check — a delegate's raise may be
+    caught by the caller's try)."""
+    defs = module_defs(module)
+    out: Dict[ast.AST, str] = {}
+    for fn, why in boundaries.items():
+        cls = module.enclosing_class(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            target: Optional[ast.AST] = None
+            if isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self" and cls is not None:
+                for cand in module.functions():
+                    if cand.name == node.func.attr and \
+                            module.enclosing_class(cand) is cls:
+                        target = cand
+                        break
+            elif isinstance(node.func, ast.Name):
+                target = defs.get(node.func.id)
+            if target is None or target in boundaries or target in out:
+                continue
+            out[target] = f"delegate of {why} `{fn.name}`"
+    return out
+
+
+def simulation_error_classes(modules: List[Module]) -> Set[str]:
+    """Transitive SimulationError subclass names across the module set
+    (name-based: `class CancelledError(SimulationError)` counts its
+    subclasses too)."""
+    names = {"SimulationError"}
+    changed = True
+    while changed:
+        changed = False
+        for m in modules:
+            for cls in m.classes():
+                if cls.name in names:
+                    continue
+                for b in cls.bases:
+                    if dotted_name(b).rsplit(".", 1)[-1] in names:
+                        names.add(cls.name)
+                        changed = True
+                        break
+    return names
+
+
+# ---- metric families (GL10) ---------------------------------------------
+
+METRIC_CTORS = frozenset({"counter", "gauge", "histogram", "callback_gauge"})
+
+
+def _module_str_constants(module: Module) -> Dict[str, str]:
+    """Module-level `NAME = "literal"` assignments (the
+    `PHASE_SECONDS = "simon_phase_seconds"` convention)."""
+    out: Dict[str, str] = {}
+    for stmt in module.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            val = const_str(stmt.value)
+            if val is not None:
+                out[stmt.targets[0].id] = val
+    return out
+
+
+def declared_metric_families(module: Module) -> List[Tuple[str, ast.AST]]:
+    """(family name, call node) for every registry constructor call whose
+    first argument is a `simon_*` string literal or a module constant
+    holding one."""
+    consts = _module_str_constants(module)
+    out: List[Tuple[str, ast.AST]] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        last = dotted_name(node.func).rsplit(".", 1)[-1]
+        if last not in METRIC_CTORS:
+            continue
+        arg = node.args[0]
+        name = const_str(arg)
+        if name is None and isinstance(arg, ast.Name):
+            name = consts.get(arg.id)
+        if name is not None and name.startswith("simon_"):
+            out.append((name, node))
+    return out
+
+
+def used_metric_names(module: Module) -> List[Tuple[str, ast.AST]]:
+    """Every `simon_*` string literal in the module, excluding bare
+    expression statements (docstrings and display-only strings)."""
+    out: List[Tuple[str, ast.AST]] = []
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Constant) and
+                isinstance(node.value, str)):
+            continue
+        if not node.value.startswith("simon_"):
+            continue
+        if isinstance(module.parents.get(node), ast.Expr):
+            continue
+        out.append((node.value, node))
+    return out

@@ -1,4 +1,4 @@
-"""graftlint rule registry: GL0-GL5.
+"""graftlint rule registry: GL0-GL10.
 
 Each rule is a function over a LintContext (every parsed module) that
 yields LintFindings with precise spans and remediation hints. The rules
@@ -29,6 +29,13 @@ from open_simulator_tpu.analysis.resolver import (
     signature_of,
     traced_functions,
 )
+from open_simulator_tpu.analysis.runtime_rules import (
+    check_gl6,
+    check_gl7,
+    check_gl8,
+    check_gl9,
+    check_gl10,
+)
 from open_simulator_tpu.analysis.walker import Module
 
 # xs keys the engine introduces host-side (not SnapshotArrays-backed) and
@@ -47,6 +54,11 @@ class LintContext:
     modules: List[Module]
     dead_flag_classes: Tuple[str, ...] = DEAD_FLAG_CLASSES
     backing_class: str = BACKING_CLASS
+    # runtime-layer rule inputs (GL10 reads the ARCHITECTURE metric
+    # catalog under `root`; doc-sync checks only fire on full-tree runs
+    # so a subset lint never flags families declared elsewhere)
+    root: Optional[str] = None
+    full_tree: bool = False
 
     def backing_fields(self, prefer: Module) -> Optional[Set[str]]:
         """Field set of the backing class: module-local first (fixtures
@@ -444,4 +456,26 @@ RULES: List[Rule] = [
          "conditional-dtype carry fields must be updated through "
          ".astype(...) guards",
          check_gl5),
+    Rule("GL6", "launch-wrap-discipline",
+         "device-dispatching calls (schedule_pods/batched_schedule/"
+         "run_batched_cached/mesh_schedule/jit results/block_until_ready) "
+         "must execute under faults.run_launch/run_wave_launch/run_io",
+         check_gl6),
+    Rule("GL7", "lock-order-safety",
+         "no lock-order cycles, no blocking cross-key KeyedMutex "
+         "acquires, no plain-lock holds spanning a device launch",
+         check_gl7),
+    Rule("GL8", "boundary-discipline",
+         "REST handlers and queue workers answer through STATUS_BY_CODE: "
+         "no drifted status tables, no swallowing excepts, no builtin "
+         "raises escaping to the handler return",
+         check_gl8),
+    Rule("GL9", "durable-write-discipline",
+         "direct open(w/a)/os.write/fsync in resilience/, telemetry/, "
+         "campaign/, replay/ must ride DurableJournal or faults.run_io",
+         check_gl9),
+    Rule("GL10", "metric-name-drift",
+         "every simon_* name in code must resolve against a declared "
+         "registry family and the ARCHITECTURE metric catalog",
+         check_gl10),
 ]

@@ -1,0 +1,776 @@
+"""graftlint runtime-layer rules: GL6-GL10.
+
+The tensor rules (GL1-GL5) pin the scan scheduler's trace-time
+contracts; the rules below pin the *runtime* invariants that PRs 6-16
+grew and that review history proves drift: the device fault domain
+(GL6), lock ordering in the threaded serving layer (GL7), the
+STATUS_BY_CODE error boundary (GL8), durable-write consolidation (GL9),
+and the metric-name contract between code and the ARCHITECTURE catalog
+(GL10). Each rule is anchored to a shipped incident:
+
+  GL6 <- PR 14: `block_until_ready` sat outside `faults.run_launch`, so
+         a device loss surfaced as an unclassified traceback.
+  GL7 <- PR 11: an AB-BA blocking cross-key `KeyedMutex.hold` between
+         eviction and rehydration deadlocked the session store.
+  GL8 <- PR 12: a hand-copied code->status dict in rest.py drifted from
+         serving.STATUS_BY_CODE and turned 429s into 400s.
+
+Like every graftlint pass this is pure `ast.parse` over source text —
+nothing here imports the code under analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from open_simulator_tpu.analysis.findings import LintFinding, finding_at
+from open_simulator_tpu.analysis.resolver import (
+    BUILTIN_EXCEPTIONS,
+    DISPATCH_FNS,
+    LAUNCH_WRAPPERS,
+    LockAcq,
+    LockToken,
+    boundary_delegates,
+    boundary_functions,
+    declared_metric_families,
+    establishes_fault_domain,
+    enclosing_callables,
+    full_name,
+    import_map,
+    inside_wrapper_arg,
+    lock_token_of,
+    lock_tokens,
+    module_defs,
+    module_path_index,
+    qualname_of,
+    resolve_def,
+    simulation_error_classes,
+    traced_functions,
+    used_metric_names,
+    wrapped_arg_names,
+    wrapper_name,
+)
+from open_simulator_tpu.analysis.walker import Module, const_str, dotted_name
+
+
+def _last_seg(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+# ---- GL6: launch-wrap discipline ----------------------------------------
+
+
+def _jit_result_names(module: Module,
+                      imports: Dict[str, str]) -> Dict[str, Set[int]]:
+    """Name -> scope ids for assignments from `jax.jit(...)` or
+    `<lowered>.compile()` — invoking such a name dispatches compiled
+    work. Scoped per enclosing function (0 = module level) so a `fn`
+    jitted in one function never taints an unrelated local `fn`."""
+    out: Dict[str, Set[int]] = {}
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt, val = node.targets[0], node.value
+        if not (isinstance(tgt, ast.Name) and isinstance(val, ast.Call)):
+            continue
+        last = _last_seg(full_name(val.func, imports))
+        if last in ("jit", "compile"):
+            scope = module.enclosing_function(node)
+            out.setdefault(tgt.id, set()).add(0 if scope is None
+                                              else id(scope))
+    return out
+
+
+def _dispatch_label(module: Module, node: ast.Call,
+                    imports: Dict[str, str],
+                    jit_names: Dict[str, Set[int]]) -> str:
+    """Human-readable label when `node` dispatches device work, else ''."""
+    last = _last_seg(full_name(node.func, imports))
+    if last in DISPATCH_FNS:
+        return last
+    if last == "block_until_ready":
+        return "block_until_ready"
+    if isinstance(node.func, ast.Name) and node.func.id in jit_names:
+        scopes = jit_names[node.func.id]
+        here = {0} | {id(fn) for fn in enclosing_callables(module, node)}
+        if scopes & here:
+            return f"{node.func.id} (jit/compile result)"
+    if isinstance(node.func, ast.Call):
+        inner = _last_seg(full_name(node.func.func, imports))
+        if inner in ("jit", "compile"):
+            return f"{inner}(...)(...) immediate invoke"
+    return ""
+
+
+def _gl6_sanctioned(module: Module, node: ast.Call,
+                    imports: Dict[str, str], traced_ids: Set[int],
+                    wrapped: Set[str],
+                    index: Dict[str, Module]) -> bool:
+    # (a) argument subtree of a wrapper call: run_launch(lambda: ..., "x")
+    if inside_wrapper_arg(module, node, imports):
+        return True
+    for fn in enclosing_callables(module, node):
+        # (b) enclosing callable traces: dispatch happens at the traced
+        # invoker, which carries its own wrapper
+        if id(fn) in traced_ids:
+            return True
+        # (c) enclosing def is later handed to a wrapper by name (the
+        # `def write(): ...; faults.run_io("op", write)` closure shape)
+        if getattr(fn, "name", None) in wrapped:
+            return True
+    # (d) the callee itself establishes the fault domain (bare
+    # `run_batched_cached(...)` is fine: the wrapper lives inside)
+    hit = resolve_def(node.func, module, imports, index)
+    if hit is not None and establishes_fault_domain(hit[0], hit[1], index):
+        return True
+    return False
+
+
+def _domain_sink_names(module: Module, imports: Dict[str, str],
+                       index: Dict[str, Module]) -> Set[str]:
+    """Names handed (anywhere in the arg subtree) to a call whose callee
+    establishes the fault domain — `_wave_scan(scan)` sanctions `scan`
+    when `_wave_scan` wraps its argument in run_wave_launch."""
+    out: Set[str] = set()
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        hit = resolve_def(node.func, module, imports, index)
+        if hit is None or not establishes_fault_domain(hit[0], hit[1], index):
+            continue
+        for arg in list(node.args) + [k.value for k in node.keywords]:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+    return out
+
+
+def check_gl6(ctx) -> List[LintFinding]:
+    index = module_path_index(ctx.modules)
+    out: List[LintFinding] = []
+    for m in ctx.modules:
+        imports = import_map(m)
+        traced_ids = {id(t.fn) for t in traced_functions(m)}
+        wrapped = wrapped_arg_names(m) | _domain_sink_names(m, imports,
+                                                            index)
+        jit_names = _jit_result_names(m, imports)
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            label = _dispatch_label(m, node, imports, jit_names)
+            if not label:
+                continue
+            if _gl6_sanctioned(m, node, imports, traced_ids, wrapped, index):
+                continue
+            out.append(finding_at(
+                node, m.rel, "GL6", label,
+                f"device dispatch `{label}` executes outside the fault "
+                "domain (faults.run_launch/run_wave_launch/run_io)",
+                "wrap the call: faults.run_launch(\"<fn>\", lambda: <call>) "
+                "— or move it inside the callee that already owns the "
+                "domain"))
+    return out
+
+
+# ---- GL7: lock-order safety ---------------------------------------------
+
+
+@dataclass
+class _FnLockInfo:
+    """Per-function lock summary: direct blocking acquisitions, direct
+    launch-call nodes, same-module callees, and (held, event) pairs."""
+
+    qualname: str
+    fn: ast.AST
+    acqs: List[LockAcq]
+    launches: List[Tuple[ast.AST, str]]
+    callees: Set[str]
+    edges: List[Tuple[LockAcq, LockAcq]]              # held -> acquired
+    spans: List[Tuple[LockAcq, ast.AST, str]]         # held plain over launch
+    held_calls: List[Tuple[Tuple[LockAcq, ...], str]]  # held -> callee
+
+
+def _classify_ctx(expr: ast.AST, module: Module,
+                  tokens: Dict[str, LockToken]) -> Tuple[str, Optional[LockAcq]]:
+    """Classify a with-item context expression: ('blocking', acq),
+    ('nonblocking', None) for try_hold, or ('other', None)."""
+    tok = lock_token_of(expr, module, tokens)
+    if tok is not None:
+        return "blocking", LockAcq(token=tok, key=None, node=expr)
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute):
+        tok = lock_token_of(expr.func.value, module, tokens)
+        if tok is not None and tok.kind == "keyed":
+            key = ast.unparse(expr.args[0]) if expr.args else None
+            if expr.func.attr == "hold":
+                return "blocking", LockAcq(token=tok, key=key, node=expr)
+            if expr.func.attr == "try_hold":
+                # non-blocking by contract: never a GL7 edge
+                return "nonblocking", None
+    return "other", None
+
+
+def _launch_label(node: ast.Call, imports: Dict[str, str]) -> str:
+    w = wrapper_name(node, imports)
+    if w in LAUNCH_WRAPPERS:
+        return w
+    last = _last_seg(full_name(node.func, imports))
+    if last in DISPATCH_FNS or last == "block_until_ready":
+        return last
+    return ""
+
+
+def _collect_fn_lock_info(module: Module, fn: ast.AST,
+                          tokens: Dict[str, LockToken],
+                          imports: Dict[str, str],
+                          defs: Dict[str, ast.FunctionDef]) -> _FnLockInfo:
+    info = _FnLockInfo(qualname=qualname_of(module, fn), fn=fn, acqs=[],
+                       launches=[], callees=set(), edges=[], spans=[],
+                       held_calls=[])
+    own_cls = module.enclosing_class(fn)
+
+    def note_acquire(acq: LockAcq, held: List[LockAcq]) -> None:
+        info.acqs.append(acq)
+        for h in held:
+            info.edges.append((h, acq))
+
+    def scan_expr(expr: ast.AST, held: List[LockAcq]) -> None:
+        """Walk an expression, skipping lambda bodies (deferred code)."""
+        if isinstance(expr, ast.Lambda):
+            return
+        if isinstance(expr, ast.Call):
+            # .acquire() / .release() on a tracked token
+            if isinstance(expr.func, ast.Attribute):
+                tok = lock_token_of(expr.func.value, module, tokens)
+                if tok is not None and expr.func.attr == "acquire":
+                    blocking = True
+                    for kw in expr.keywords:
+                        if kw.arg == "blocking" and \
+                                isinstance(kw.value, ast.Constant) and \
+                                kw.value.value is False:
+                            blocking = False
+                    if expr.args and isinstance(expr.args[0], ast.Constant) \
+                            and expr.args[0].value is False:
+                        blocking = False
+                    if blocking:
+                        acq = LockAcq(token=tok, key=None, node=expr)
+                        note_acquire(acq, held)
+                        held.append(acq)
+                elif tok is not None and expr.func.attr == "release":
+                    for i in range(len(held) - 1, -1, -1):
+                        if held[i].token.name == tok.name:
+                            del held[i]
+                            break
+            label = _launch_label(expr, imports)
+            if label:
+                info.launches.append((expr, label))
+                for h in held:
+                    if h.token.kind != "keyed":
+                        info.spans.append((h, expr, label))
+            # same-module helper call: bare name or self.method
+            callee = None
+            if isinstance(expr.func, ast.Name) and expr.func.id in defs:
+                callee = expr.func.id
+            elif isinstance(expr.func, ast.Attribute) and \
+                    isinstance(expr.func.value, ast.Name) and \
+                    expr.func.value.id == "self" and own_cls is not None:
+                callee = f"{own_cls.name}.{expr.func.attr}"
+            if callee is not None:
+                info.callees.add(callee)
+                if held:
+                    info.held_calls.append((tuple(held), callee))
+        for child in ast.iter_child_nodes(expr):
+            scan_expr(child, held)
+
+    def own_exprs(stmt: ast.stmt):
+        for _, val in ast.iter_fields(stmt):
+            if isinstance(val, ast.expr):
+                yield val
+            elif isinstance(val, list):
+                for v in val:
+                    if isinstance(v, ast.expr):
+                        yield v
+
+    def scan(stmts: List[ast.stmt], held: List[LockAcq]) -> None:
+        held = list(held)
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate analysis unit
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                new: List[LockAcq] = []
+                for item in stmt.items:
+                    kind, acq = _classify_ctx(item.context_expr, module,
+                                              tokens)
+                    if kind == "blocking" and acq is not None:
+                        note_acquire(acq, held + new)
+                        new.append(acq)
+                    elif kind == "other":
+                        scan_expr(item.context_expr, held + new)
+                scan(stmt.body, held + new)
+                continue
+            for expr in own_exprs(stmt):
+                scan_expr(expr, held)
+            for name in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, name, None)
+                if isinstance(sub, list) and sub and \
+                        isinstance(sub[0], ast.stmt):
+                    scan(sub, held)
+            for h in getattr(stmt, "handlers", []):
+                scan(h.body, held)
+
+    body = fn.body if isinstance(fn.body, list) else []
+    scan(body, [])
+    return info
+
+
+def check_gl7(ctx) -> List[LintFinding]:
+    out: List[LintFinding] = []
+    for m in ctx.modules:
+        tokens = lock_tokens(m)
+        if not tokens:
+            continue
+        imports = import_map(m)
+        defs = module_defs(m)
+        infos: Dict[str, _FnLockInfo] = {}
+        all_infos: List[_FnLockInfo] = []
+        for fn in m.functions():
+            info = _collect_fn_lock_info(m, fn, tokens, imports, defs)
+            all_infos.append(info)
+            infos.setdefault(info.qualname, info)
+            infos.setdefault(getattr(fn, "name", info.qualname), info)
+
+        # transitive summaries: what a callee (and its callees) acquires
+        # and whether it launches
+        def summarize(qn: str, seen: Set[str]) -> Tuple[List[LockAcq], bool]:
+            if qn in seen or qn not in infos:
+                return [], False
+            seen.add(qn)
+            info = infos[qn]
+            acqs = list(info.acqs)
+            launches = bool(info.launches)
+            for callee in info.callees:
+                sub_acqs, sub_launch = summarize(callee, seen)
+                acqs.extend(sub_acqs)
+                launches = launches or sub_launch
+            return acqs, launches
+
+        edges: List[Tuple[LockAcq, LockAcq]] = []
+        spans: List[Tuple[LockAcq, ast.AST, str]] = []
+        for info in all_infos:
+            edges.extend(info.edges)
+            spans.extend(info.spans)
+            for held, callee in info.held_calls:
+                sub_acqs, sub_launch = summarize(callee, set())
+                for acq in sub_acqs:
+                    for h in held:
+                        edges.append((h, acq))
+                if sub_launch:
+                    launch_node = (infos[callee].launches[0][0]
+                                   if infos[callee].launches else held[0].node)
+                    for h in held:
+                        if h.token.kind != "keyed":
+                            spans.append((h, launch_node, f"via {callee}()"))
+
+        seen_keys: Set[Tuple] = set()
+
+        def emit(node, symbol, message, hint):
+            key = (getattr(node, "lineno", 0), symbol)
+            if key in seen_keys:
+                return
+            seen_keys.add(key)
+            out.append(finding_at(node, m.rel, "GL7", symbol, message, hint))
+
+        # (1) blocking same-KeyedMutex nesting (the PR-11 AB-BA shape)
+        # and (2) plain-Lock self-nesting
+        graph: Dict[str, Set[str]] = {}
+        graph_edge_node: Dict[Tuple[str, str], ast.AST] = {}
+        for held, acq in edges:
+            if held.token.name == acq.token.name:
+                if held.token.kind == "keyed":
+                    if held.key is not None and held.key == acq.key:
+                        continue  # provably same key: reentrant, safe
+                    emit(acq.node, held.token.name,
+                         "blocking cross-key acquire of KeyedMutex "
+                         f"`{held.token.name}` while already holding a key "
+                         f"({held.key or '?'} -> {acq.key or '?'}): AB-BA "
+                         "deadlock shape",
+                         "use try_hold() for the second key (non-blocking) "
+                         "or release the first key before acquiring")
+                elif held.token.kind == "plain":
+                    emit(acq.node, held.token.name,
+                         f"nested blocking acquire of non-reentrant Lock "
+                         f"`{held.token.name}`: self-deadlock",
+                         "use threading.RLock, or restructure so the lock "
+                         "is acquired once")
+                continue
+            graph.setdefault(held.token.name, set()).add(acq.token.name)
+            graph_edge_node.setdefault((held.token.name, acq.token.name),
+                                       acq.node)
+
+        # (3) cycles among distinct tokens
+        def reachable(src: str, dst: str) -> bool:
+            stack, visited = [src], set()
+            while stack:
+                cur = stack.pop()
+                if cur == dst:
+                    return True
+                if cur in visited:
+                    continue
+                visited.add(cur)
+                stack.extend(graph.get(cur, ()))
+            return False
+
+        reported_pairs: Set[frozenset] = set()
+        for a, succs in sorted(graph.items()):
+            for b in sorted(succs):
+                pair = frozenset((a, b))
+                if pair in reported_pairs:
+                    continue
+                if reachable(b, a):
+                    reported_pairs.add(pair)
+                    node = graph_edge_node[(a, b)]
+                    emit(node, f"{a}<->{b}",
+                         f"lock-order cycle: `{a}` is acquired while "
+                         f"holding `{b}` and vice versa — deadlock when "
+                         "two threads interleave",
+                         "impose a single acquisition order (document it "
+                         "on the lock), or collapse to one lock")
+
+        # (4) plain/reentrant lock held across a device launch
+        for held, node, label in spans:
+            emit(node, held.token.name,
+                 f"`{held.token.name}` ({held.token.kind} lock) is held "
+                 f"across device launch `{label}`: one slow/retried launch "
+                 "stalls every thread behind the lock",
+                 "snapshot under the lock, launch outside it (the "
+                 "resident-cache _guard pattern)")
+    return out
+
+
+# ---- GL8: boundary discipline -------------------------------------------
+
+_GL8_ESCAPES = frozenset({
+    "status_for", "_status_for", "error_payload", "_err_payload",
+    "STATUS_BY_CODE", "classify",
+})
+
+
+def _is_broad_handler(h: ast.ExceptHandler) -> bool:
+    if h.type is None:
+        return True
+    return _last_seg(dotted_name(h.type)) in ("Exception", "BaseException")
+
+
+def _handler_swallows(h: ast.ExceptHandler, sim_errs: Set[str]) -> bool:
+    for node in ast.walk(h):
+        if isinstance(node, ast.Raise):
+            return False
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if _last_seg(dotted_name(node)) in _GL8_ESCAPES:
+                return False
+        if isinstance(node, ast.Call):
+            if _last_seg(dotted_name(node.func)) in sim_errs:
+                return False
+    return True
+
+
+def _raise_caught_locally(module: Module, fn: ast.AST,
+                          node: ast.Raise, exc_name: str) -> bool:
+    """True when the raise sits in the body of a Try (within `fn`) whose
+    handlers catch `exc_name` (or anything broader)."""
+    prev: ast.AST = node
+    cur = module.parents.get(node)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, ast.Try):
+            in_body = any(prev is s or prev in ast.walk(s)
+                          for s in cur.body)
+            if in_body:
+                for h in cur.handlers:
+                    if _is_broad_handler(h):
+                        return True
+                    caught = _last_seg(dotted_name(h.type)) \
+                        if h.type is not None else ""
+                    if isinstance(h.type, ast.Tuple):
+                        names = {_last_seg(dotted_name(e))
+                                 for e in h.type.elts}
+                    else:
+                        names = {caught}
+                    if exc_name in names or "Exception" in names:
+                        return True
+        prev, cur = cur, module.parents.get(cur)
+    return False
+
+
+def check_gl8(ctx) -> List[LintFinding]:
+    sim_errs = simulation_error_classes(ctx.modules)
+    out: List[LintFinding] = []
+    for m in ctx.modules:
+        # (a) a literal code->status table outside serving.py (PR-12)
+        if not m.rel.endswith("server/serving.py"):
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Dict) or len(node.keys) < 2:
+                    continue
+                keys = [const_str(k) if k is not None else None
+                        for k in node.keys]
+                if not all(k is not None and k.startswith("E_")
+                           for k in keys):
+                    continue
+                if not all(isinstance(v, ast.Constant)
+                           and isinstance(v.value, int)
+                           and 100 <= v.value <= 599
+                           for v in node.values):
+                    continue
+                out.append(finding_at(
+                    node, m.rel, "GL8", "code->status dict",
+                    "literal code->status table outside serving.py: this "
+                    "is the PR-12 drift (copies rot; 429 became 400)",
+                    "import serving.STATUS_BY_CODE / serving.status_for "
+                    "instead of copying the mapping"))
+        # (b)/(c) inside boundary functions — plus, for the swallow
+        # check only, one level of delegation (do_GET dispatching to
+        # self._do_get() must not hide the broad except)
+        bounds = boundary_functions(m)
+        scan = dict(bounds)
+        scan.update(boundary_delegates(m, bounds))
+        for fn, why in scan.items():
+            for node in ast.walk(fn):
+                if isinstance(node, ast.ExceptHandler) and \
+                        _is_broad_handler(node) and \
+                        _handler_swallows(node, sim_errs):
+                    out.append(finding_at(
+                        node, m.rel, "GL8", fn.name,
+                        f"broad except in {why} `{fn.name}` swallows the "
+                        "error without mapping it through STATUS_BY_CODE "
+                        "or a SimulationError",
+                        "answer with serving.status_for(e)/error_payload "
+                        "(or re-raise a SimulationError) so the caller "
+                        "sees a classified status"))
+                if fn not in bounds:
+                    continue  # delegates: swallow check only
+                if isinstance(node, ast.Raise) and node.exc is not None:
+                    exc = node.exc
+                    target = exc.func if isinstance(exc, ast.Call) else exc
+                    name = _last_seg(dotted_name(target))
+                    if name in BUILTIN_EXCEPTIONS and \
+                            not _raise_caught_locally(m, fn, node, name):
+                        out.append(finding_at(
+                            node, m.rel, "GL8", name,
+                            f"`raise {name}` in {why} `{fn.name}` reaches "
+                            "the handler return uncaught: the client gets "
+                            "an unclassified 500 instead of a "
+                            "STATUS_BY_CODE status",
+                            "raise a SimulationError subclass (its .code "
+                            "maps through STATUS_BY_CODE)"))
+    return out
+
+
+# ---- GL9: durable-write discipline --------------------------------------
+
+_GL9_DIRS = ("resilience/", "telemetry/", "campaign/", "replay/")
+_GL9_JOURNAL_BASES = ("DurableJournal",)
+
+
+def _durable_journal_classes(modules: List[Module]) -> Set[str]:
+    names = set(_GL9_JOURNAL_BASES)
+    changed = True
+    while changed:
+        changed = False
+        for m in modules:
+            for cls in m.classes():
+                if cls.name in names:
+                    continue
+                for b in cls.bases:
+                    if _last_seg(dotted_name(b)) in names:
+                        names.add(cls.name)
+                        changed = True
+                        break
+    return names
+
+
+def _write_label(node: ast.Call, imports: Dict[str, str]) -> str:
+    fname = full_name(node.func, imports)
+    if fname in ("os.write", "os.fsync"):
+        return fname
+    if fname in ("open", "io.open", "builtins.open"):
+        mode = None
+        if len(node.args) >= 2:
+            mode = const_str(node.args[1])
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = const_str(kw.value)
+        if mode is not None and any(c in mode for c in "wax+"):
+            return f'open(..., "{mode}")'
+    return ""
+
+
+def check_gl9(ctx) -> List[LintFinding]:
+    journal_cls = _durable_journal_classes(ctx.modules)
+    out: List[LintFinding] = []
+    for m in ctx.modules:
+        base = os.path.basename(m.rel)
+        if not (any(d in m.rel for d in _GL9_DIRS)
+                or base.startswith("gl9_")):
+            continue
+        imports = import_map(m)
+        wrapped = wrapped_arg_names(m)
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            label = _write_label(node, imports)
+            if not label:
+                continue
+            cls = m.enclosing_class(node)
+            if cls is not None and cls.name in journal_cls:
+                continue  # DurableJournal owns its frames + fsyncs
+            if inside_wrapper_arg(m, node, imports):
+                continue
+            if any(getattr(fn, "name", None) in wrapped
+                   for fn in enclosing_callables(m, node)):
+                continue  # closure handed to faults.run_io
+            out.append(finding_at(
+                node, m.rel, "GL9", label,
+                f"direct durable write `{label}` bypasses DurableJournal/"
+                "faults.run_io: no torn-tail framing, no ENOSPC rung, no "
+                "storage-fault injection coverage",
+                "wrap the write in a closure and hand it to "
+                'faults.run_io("<fn>", write) — or append through a '
+                "DurableJournal"))
+    return out
+
+
+# ---- GL10: metric-name drift --------------------------------------------
+
+# graftlint: disable=GL10 the scraper's own pattern literal is not a metric
+_METRIC_TOKEN_RE = re.compile(r"simon_[A-Za-z0-9_{},*]*")
+
+
+def _expand_braces(tok: str) -> List[str]:
+    mt = re.match(r"^(.*)\{([^}]*)\}(.*)$", tok)
+    if not mt:
+        return [tok]
+    out: List[str] = []
+    for alt in mt.group(2).split(","):
+        out.extend(_expand_braces(mt.group(1) + alt + mt.group(3)))
+    return out
+
+
+@dataclass
+class MetricDoc:
+    """simon_* tokens scraped from ARCHITECTURE.md: (name, wildcard);
+    `catalog` restricts to the §8a 'Metric catalog:' table and carries
+    line numbers for ghost findings."""
+
+    tokens: List[Tuple[str, bool]]
+    catalog: List[Tuple[str, bool, int]]
+
+
+def load_metric_doc(root: str) -> Optional[MetricDoc]:
+    path = os.path.join(root, "ARCHITECTURE.md")
+    if not os.path.isfile(path):
+        return None
+    tokens: List[Tuple[str, bool]] = []
+    catalog: List[Tuple[str, bool, int]] = []
+    in_catalog = False
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, start=1):
+            if "Metric catalog" in line:
+                in_catalog = True
+            elif in_catalog and line.startswith("###"):
+                in_catalog = False
+            for raw in _METRIC_TOKEN_RE.findall(line):
+                for name in _expand_braces(raw):
+                    name = name.rstrip(",}{")
+                    wildcard = name.endswith(("_", "*"))
+                    name = name.rstrip("*")
+                    if not name.startswith("simon_") or name == "simon_":
+                        # the bare `simon_*` prose wildcard would match
+                        # every family and void the doc-sync checks
+                        continue
+                    tokens.append((name, wildcard))
+                    if in_catalog:
+                        catalog.append((name, wildcard, lineno))
+    return MetricDoc(tokens=tokens, catalog=catalog)
+
+
+def _doc_matches(name: str, tokens: List[Tuple[str, bool]]) -> bool:
+    for tok, wild in tokens:
+        if tok == name:
+            return True
+        if wild and name.startswith(tok):
+            return True
+    return False
+
+
+def _resolves(used: str, family: str) -> bool:
+    return (family == used or family.startswith(used)
+            or used.startswith(family))
+
+
+def check_gl10(ctx) -> List[LintFinding]:
+    declared: List[Tuple[str, ast.AST, Module]] = []
+    for m in ctx.modules:
+        for name, node in declared_metric_families(m):
+            declared.append((name, node, m))
+    declared_names = sorted({name for name, _, _ in declared})
+    doc = load_metric_doc(ctx.root) if getattr(ctx, "root", None) else None
+    out: List[LintFinding] = []
+
+    # orphans: a simon_* literal resolving against no declared family
+    # (and no documented token)
+    for m in ctx.modules:
+        for used, node in used_metric_names(m):
+            if any(_resolves(used, f) for f in declared_names):
+                continue
+            if doc is not None and _doc_matches(used, doc.tokens):
+                continue
+            out.append(finding_at(
+                node, m.rel, "GL10", used,
+                f"metric name `{used}` resolves against no declared "
+                "registry family: scrapes and ledger greps will silently "
+                "match nothing",
+                "declare the family via telemetry.registry.counter/gauge/"
+                "histogram, or fix the drifted name"))
+
+    if getattr(ctx, "full_tree", False) and doc is not None:
+        # declared but absent from the ARCHITECTURE metric docs
+        seen: Set[str] = set()
+        for name, node, m in declared:
+            if name in seen:
+                continue
+            seen.add(name)
+            if not _doc_matches(name, doc.tokens):
+                out.append(finding_at(
+                    node, m.rel, "GL10", name,
+                    f"metric family `{name}` is declared in code but "
+                    "missing from the ARCHITECTURE.md metric catalog",
+                    "add a catalog row (§ telemetry) documenting the "
+                    "family and its labels"))
+        # catalog ghosts: documented rows matching no declared family
+        for tok, wild, lineno in catalog_entries(doc):
+            hit = any(tok == f or (wild and f.startswith(tok))
+                      for f in declared_names)
+            if not hit:
+                out.append(LintFinding(
+                    path="ARCHITECTURE.md", line=lineno, col=1,
+                    code="GL10", symbol=tok,
+                    message=f"metric catalog documents `{tok}` but no "
+                    "registry family with that name is declared in code "
+                    "(doc-only ghost)",
+                    hint="delete the stale row or restore the metric"))
+    return out
+
+
+def catalog_entries(doc: MetricDoc) -> List[Tuple[str, bool, int]]:
+    """Catalog rows deduped by name (first line wins)."""
+    seen: Set[str] = set()
+    out: List[Tuple[str, bool, int]] = []
+    for tok, wild, lineno in doc.catalog:
+        if tok in seen:
+            continue
+        seen.add(tok)
+        out.append((tok, wild, lineno))
+    return out

@@ -229,6 +229,8 @@ def write_synthetic_fleet(root: str, n_clusters: int = 3,
     (the executable-sharing property §9/§13 campaigns exploit). The last
     ``malformed`` files are deliberately truncated mid-object — the
     quarantine fixtures for smoke and tests."""
+    from open_simulator_tpu.resilience import faults
+
     os.makedirs(root, exist_ok=True)
     paths = []
     for ci in range(n_clusters):
@@ -236,9 +238,12 @@ def write_synthetic_fleet(root: str, n_clusters: int = 3,
         path = os.path.join(root, name + ".json")
         paths.append(path)
         if ci >= n_clusters - malformed:
-            # cut off mid-write: the classic torn dump
-            with open(path, "w", encoding="utf-8") as f:
-                f.write('{"kind": "List", "items": [{"kind": "Node", ')
+            def write_torn(p: str = path) -> None:
+                # cut off mid-write: the classic torn dump
+                with open(p, "w", encoding="utf-8") as f:
+                    f.write('{"kind": "List", "items": [{"kind": "Node", ')
+
+            faults.run_io("fleet_fixture", write_torn)
             continue
         # two shapes across the fleet -> two exec-cache buckets
         n_n = nodes if ci % 2 == 0 else max(2, nodes // 2)
@@ -274,7 +279,10 @@ def write_synthetic_fleet(root: str, n_clusters: int = 3,
             if running:
                 pod["spec"]["nodeName"] = f"{name}-n{i % n_n}"
             items.append(pod)
-        with open(path, "w", encoding="utf-8") as f:
-            json.dump({"kind": "List", "apiVersion": "v1", "items": items},
-                      f, indent=1)
+        def write_dump(p: str = path, payload: List[Dict] = items) -> None:
+            with open(p, "w", encoding="utf-8") as f:
+                json.dump({"kind": "List", "apiVersion": "v1",
+                           "items": payload}, f, indent=1)
+
+        faults.run_io("fleet_fixture", write_dump)
     return paths

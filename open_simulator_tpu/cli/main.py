@@ -11,6 +11,7 @@ import contextlib
 import logging
 import os
 import sys
+import time
 
 from open_simulator_tpu import __version__
 from open_simulator_tpu.errors import SimulationError
@@ -541,24 +542,41 @@ def build_parser() -> argparse.ArgumentParser:
 
     lt = sub.add_parser(
         "lint",
-        help="run graftlint: repo-specific static trace-safety and "
-             "engine-contract analysis (rules GL1-GL5)",
+        help="run graftlint: repo-specific static trace-safety, "
+             "engine-contract, and runtime-discipline analysis "
+             "(rules GL1-GL10)",
         description="graftlint: pure-AST static analysis of the scan "
                     "scheduler's cross-layer contracts — xs-leaf "
                     "wiring (GL1), partial-into-scan arity (GL2), dead "
                     "config flags (GL3), trace safety (GL4), compact-"
-                    "carry dtype hygiene (GL5). Exits 0 on a clean "
+                    "carry dtype hygiene (GL5) — and the runtime "
+                    "layer's disciplines: launch fault-domain wrapping "
+                    "(GL6), lock ordering (GL7), error-boundary status "
+                    "mapping (GL8), durable-write consolidation (GL9), "
+                    "metric-name/doc sync (GL10). Exits 0 on a clean "
                     "tree, 1 on findings. Catalog: ARCHITECTURE.md §7.")
     lt.add_argument(
         "paths", nargs="*", metavar="PATH",
         help="files/dirs to lint, relative to the repo root "
              "(default: the product tree — open_simulator_tpu/, tools/, "
              "bench.py)")
-    lt.add_argument("--format", choices=("text", "json"), default="text",
-                    help="finding output format")
+    lt.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text", help="finding output format")
     lt.add_argument("--select", default="",
                     help="comma list of rule codes to run (e.g. GL1,GL4); "
                          "default all")
+    lt.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="report only findings in files changed vs REF "
+                         "(default HEAD) plus untracked files; the "
+                         "analysis still resolves against the full tree "
+                         "so interprocedural rules stay accurate. Falls "
+                         "back to full-tree reporting when git is "
+                         "unavailable; exits immediately when nothing "
+                         "in scope changed")
+    lt.add_argument("--jobs", type=int, default=0,
+                    help="parse the lint set across N processes "
+                         "(0/1 = serial)")
     lt.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
     lt.add_argument("--output-file", default="")
@@ -995,6 +1013,10 @@ def main(argv=None) -> int:
             format_rules,
             format_text,
         )
+        from open_simulator_tpu.analysis.report import (
+            changed_files,
+            format_sarif,
+        )
 
         if args.list_rules:
             print(format_rules())
@@ -1007,15 +1029,44 @@ def main(argv=None) -> int:
             print(f"error: unknown rule code(s): {', '.join(unknown)} "
                   f"(known: {', '.join(RULE_CODES)})", file=sys.stderr)
             return 2
+        paths = args.paths or None
+        report_paths = None
+        if args.changed is not None and not args.paths:
+            changed = changed_files(ref=args.changed)
+            if changed is not None:
+                if not changed:
+                    # nothing in scope changed: a clean verdict, NOT a
+                    # fall-through to the full default tree
+                    print(format_text([]) if args.format == "text"
+                          else (format_json([]) if args.format == "json"
+                                else format_sarif([])))
+                    return 0
+                # analyze the FULL tree (interprocedural facts need it),
+                # report only findings in the changed files
+                report_paths = changed
+        t0 = time.perf_counter()
         try:
-            assert_clean(paths=args.paths or None, codes=codes or None)
+            assert_clean(paths=paths, codes=codes or None, jobs=args.jobs,
+                         report_paths=report_paths)
             findings = []
         except LintError as e:
             findings = e.findings
         except (OSError, SyntaxError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
+        wall = time.perf_counter() - t0
+        from open_simulator_tpu.telemetry import ledger
+
+        ledger.append_event("lint", tags={
+            "findings": len(findings),
+            "rules": ",".join(codes) if codes else "all",
+            "scope": ("changed" if args.changed is not None and not args.paths
+                      else ("paths" if args.paths else "full")),
+            "files": (len(report_paths) if report_paths is not None
+                      else (len(paths) if paths else None)),
+        }, wall_s=wall)
         text = (format_json(findings) if args.format == "json"
+                else format_sarif(findings) if args.format == "sarif"
                 else format_text(findings))
         if args.output_file:
             with open(args.output_file, "w", encoding="utf-8") as f:

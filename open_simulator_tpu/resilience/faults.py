@@ -118,6 +118,8 @@ KNOWN_FNS = frozenset({
     "compile",           # AOT lower().compile() boundary (exec_cache)
     "journal_append",    # durable journal frames (resilience/journal.py)
     "ledger_append",     # run-ledger writes + rotation (telemetry/ledger)
+    "trace_export",      # chrome-trace dumps (telemetry/spans.py)
+    "fleet_fixture",     # synthetic fleet dumps (campaign/fleet.py)
 })
 
 

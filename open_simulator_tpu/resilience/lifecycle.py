@@ -534,6 +534,7 @@ class AdmissionQueue:
         crashed = False
         try:
             self._loop()
+        # graftlint: disable=GL8 loop-crash guard, not a response path: it logs and respawns the worker; job errors are mapped onto the Job upstream
         except BaseException:  # noqa: BLE001 — a crash of the LOOP (not a
             # job: job exceptions are captured onto the job) must not
             # strand the queue; log it and hand off to a replacement

@@ -994,7 +994,8 @@ def _make_handler(server: SimulationServer):
                     self._send(_status_for(e), _err_payload(e))
                 except Exception as e:  # noqa: BLE001
                     server._stats["errors"] += 1
-                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                    err = _internal(e)
+                    self._send(_status_for(err), _err_payload(err))
             elif self.path == "/api/runs" or self.path.startswith("/api/runs?") \
                     or self.path.startswith("/api/runs/"):
                 from urllib.parse import parse_qs, unquote, urlparse
@@ -1011,7 +1012,8 @@ def _make_handler(server: SimulationServer):
                     self._send(_status_for(e), _err_payload(e))
                 except Exception as e:  # noqa: BLE001
                     server._stats["errors"] += 1
-                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                    err = _internal(e)
+                    self._send(_status_for(err), _err_payload(err))
             elif self.path == "/api/trace" or self.path.startswith("/api/trace?"):
                 # Chrome-trace JSON of the last POST request's span tree —
                 # the server-side mirror of --trace-out, without toggling
@@ -1051,7 +1053,8 @@ def _make_handler(server: SimulationServer):
                     self._send(_status_for(e), _err_payload(e))
                 except Exception as e:  # noqa: BLE001
                     server._stats["errors"] += 1
-                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                    err = _internal(e)
+                    self._send(_status_for(err), _err_payload(err))
             elif self.path == "/debug/stats":
                 # profiling surface, the gin pprof analog
                 # (/root/reference/pkg/server/server.go:148-152): process +
@@ -1059,7 +1062,8 @@ def _make_handler(server: SimulationServer):
                 try:
                     self._send(200, server.debug_stats())
                 except Exception as e:  # noqa: BLE001
-                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                    err = _internal(e)
+                    self._send(_status_for(err), _err_payload(err))
             elif self.path == "/debug/profile" or self.path.startswith("/debug/profile?"):
                 # capture a jax profiler trace of the next simulation(s):
                 # /debug/profile?dir=/tmp/simprof starts, a second call
@@ -1071,7 +1075,8 @@ def _make_handler(server: SimulationServer):
                 try:
                     self._send(200, server.toggle_profile((q.get("dir") or [""])[0]))
                 except Exception as e:  # noqa: BLE001
-                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                    err = _internal(e)
+                    self._send(_status_for(err), _err_payload(err))
             else:
                 self._send(404, {"error": "not found"})
 
@@ -1108,7 +1113,8 @@ def _make_handler(server: SimulationServer):
                 self._send(_status_for(e), _err_payload(e))
             except Exception as e:  # noqa: BLE001
                 server._stats["errors"] += 1
-                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                err = _internal(e)
+                self._send(_status_for(err), _err_payload(err))
 
         def _resolve_post(self):
             routes = {"/api/deploy-apps": server.deploy_apps,
@@ -1147,29 +1153,32 @@ def _make_handler(server: SimulationServer):
                 # rejected BEFORE the body is read: an oversized payload
                 # costs the server a header parse, nothing more
                 server._stats["errors"] += 1
-                self._send(413, _err_payload(SimulationError(
+                err = SimulationError(
                     f"request body of {length} bytes exceeds the "
                     f"{server.max_body_bytes}-byte cap",
                     code="E_PAYLOAD_TOO_LARGE", ref="request",
                     field="Content-Length",
-                    hint="split the request or raise --max-body-mib")))
+                    hint="split the request or raise --max-body-mib")
+                self._send(_status_for(err), _err_payload(err))
                 return
             try:
                 body = json.loads(self.rfile.read(length) or b"{}")
             except json.JSONDecodeError as e:
-                self._send(400, _err_payload(SimulationError(
+                err = SimulationError(
                     f"bad json: {e}", code="E_BAD_REQUEST", ref="request",
-                    hint="the body must be a JSON object")))
+                    hint="the body must be a JSON object")
+                self._send(_status_for(err), _err_payload(err))
                 return
             if not isinstance(body, dict):
                 # valid JSON but not an object (42, [], "x"): every field
                 # read below assumes a dict — reject structurally instead
                 # of crashing the handler thread
-                self._send(400, _err_payload(SimulationError(
+                err = SimulationError(
                     f"request body must be a JSON object, got "
                     f"{type(body).__name__}",
                     code="E_BAD_REQUEST", ref="request",
-                    hint='wrap the payload in an object: {"apps": [...]}')))
+                    hint='wrap the payload in an object: {"apps": [...]}')
+                self._send(_status_for(err), _err_payload(err))
                 return
             # (no draining pre-check here: begin_drain closes the queue,
             # so a draining server rejects at submit with the same 503
@@ -1182,16 +1191,18 @@ def _make_handler(server: SimulationServer):
                 try:
                     client_deadline = float(raw_deadline)
                 except (TypeError, ValueError):
-                    self._send(400, _err_payload(SimulationError(
+                    err = SimulationError(
                         f"deadline_s must be a number, got {raw_deadline!r}",
                         code="E_BAD_REQUEST", ref="request",
-                        field="deadline_s", hint='e.g. {"deadline_s": 30}')))
+                        field="deadline_s", hint='e.g. {"deadline_s": 30}')
+                    self._send(_status_for(err), _err_payload(err))
                     return
                 if client_deadline <= 0:
-                    self._send(400, _err_payload(SimulationError(
+                    err = SimulationError(
                         f"deadline_s must be positive, got {client_deadline}",
                         code="E_BAD_REQUEST", ref="request",
-                        field="deadline_s", hint='e.g. {"deadline_s": 30}')))
+                        field="deadline_s", hint='e.g. {"deadline_s": 30}')
+                    self._send(_status_for(err), _err_payload(err))
                     return
                 deadline_s = min(deadline_s, client_deadline)
             token = lifecycle.CancelToken(deadline_s)
@@ -1279,7 +1290,8 @@ def _make_handler(server: SimulationServer):
             except Exception as e:  # noqa: BLE001 — preparation bugs are
                 # this request's 500; the queue and cache are untouched
                 server._stats["errors"] += 1
-                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                err = _internal(e)
+                self._send(_status_for(err), _err_payload(err))
                 return
             server._trace_mark = RECORDER.mark()
             if callable(prepared):
@@ -1330,20 +1342,21 @@ def _make_handler(server: SimulationServer):
                     self._send(*resp)
                     return
                 server._stats["errors"] += 1
-                self._send(504, _err_payload(lifecycle.CancelledError(
+                err = lifecycle.CancelledError(
                     f"request exceeded the {deadline_s:.1f}s deadline",
                     code="E_DEADLINE", ref="request",
                     hint="shrink the request, raise --request-timeout / "
                          "deadline_s, or resume a checkpointed sweep; the "
-                         "worker stops at its next round boundary")))
+                         "worker stops at its next round boundary")
+                self._send(_status_for(err), _err_payload(err))
                 return
             if job.error is not None:
                 # work() catches Exception itself, so this is the escape
                 # hatch for BaseException-grade failures — the queue
                 # worker survived it; the client still gets an answer
                 server._stats["errors"] += 1
-                self._send(500, {"error": f"{type(job.error).__name__}: "
-                                          f"{job.error}"})
+                err = _internal(job.error)
+                self._send(_status_for(err), _err_payload(err))
                 return
             if job.result is None:
                 # skipped before execution: the token was cancelled while
@@ -1364,6 +1377,16 @@ def _make_handler(server: SimulationServer):
 # — a second hand-maintained copy here had already drifted on E_AUDIT
 _err_payload = serving.error_payload
 _status_for = serving.status_for
+
+
+def _internal(e: BaseException) -> SimulationError:
+    """Wrap an unclassified handler exception so even server bugs answer
+    through STATUS_BY_CODE (E_INTERNAL -> 500) with the structured error
+    shape, instead of a hand-built {"error": ...} body (the PR-12 drift
+    class, GL8)."""
+    return SimulationError(
+        f"{type(e).__name__}: {e}", code="E_INTERNAL", ref="server",
+        hint="unexpected server-side failure; see the server log")
 
 
 def serve(address: str = "127.0.0.1", port: int = 8899, cluster_config: str = "",

@@ -107,6 +107,8 @@ STATUS_BY_CODE = {
     "E_NO_RUN": 404,
     "E_NO_SESSION": 404,   # unknown/closed digital-twin session id
     "E_AUDIT": 500,        # the engine's own invariants failed — server bug
+    "E_INTERNAL": 500,     # unclassified handler exception (wrapped so
+                           # even surprises answer through this table)
     # device fault domain (resilience/faults.py): classified runtime
     # failures that outlived the retry schedule AND the degradation
     # ladder — structured 5xx, never a bare traceback. 503 where another

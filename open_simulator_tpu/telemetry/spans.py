@@ -139,8 +139,17 @@ class SpanRecorder:
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
     def export_chrome_trace(self, path: str) -> str:
-        with open(path, "w", encoding="utf-8") as f:
-            json.dump(self.chrome_trace(), f)
+        from open_simulator_tpu.resilience import faults
+
+        payload = self.chrome_trace()
+
+        def write() -> None:
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+
+        # ride the storage fault domain (GL9): retries + the ENOSPC/EIO
+        # classification rung, same as ledger/journal writes
+        faults.run_io("trace_export", write)
         return path
 
 

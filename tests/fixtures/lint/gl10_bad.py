@@ -1,0 +1,16 @@
+"""GL10 fixture (bad): a drifted metric name.
+
+The family is declared as `simon_fixture_runs_total`; the dashboard
+helper greps `simon_fixture_run_total` (dropped `s`). The scrape
+silently matches nothing — the exact failure mode GL10 pins.
+"""
+
+from open_simulator_tpu.telemetry import counter
+
+
+def declare():
+    return counter("simon_fixture_runs_total", "fixture runs")
+
+
+def scrape(registry):
+    return registry.collect("simon_fixture_run_total")   # drifted name

@@ -1,0 +1,27 @@
+"""GL10 fixture (clean): every used metric name resolves to a family.
+
+Families are declared two sanctioned ways — a string literal first
+argument, and the module-constant convention
+(`FAMILY = "simon_..."` handed to the constructor). Consumers may also
+address a family by prefix (ledger greps do). This file must produce
+ZERO findings under every rule.
+"""
+
+from open_simulator_tpu.telemetry import counter, histogram
+
+FIXTURE_SECONDS = "simon_fixture_seconds"
+
+
+def declare():
+    return (
+        counter("simon_fixture_runs_total", "fixture runs", labelnames=("kind",)),
+        histogram(FIXTURE_SECONDS, "fixture wall time"),
+    )
+
+
+def record(registry, dur):
+    runs, seconds = declare()
+    runs.labels(kind="ok").inc()
+    seconds.observe(dur)
+    # prefix addressing (how the run ledger greps a family's series)
+    return registry.collect("simon_fixture")

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from open_simulator_tpu.resilience import faults
 from open_simulator_tpu.telemetry import counter
 
 
@@ -37,7 +38,8 @@ def run_and_record(requests, capacities, ledger_path, surface="fixture"):
         repr((tuple(req.shape), str(req.dtype), tuple(cap.shape))).encode()
     ).hexdigest()[:16]
     t0 = time.perf_counter()
-    out = jax.jit(_traced_assign)(req, cap)
+    out = faults.run_launch("fixture_assign",
+                            lambda: jax.jit(_traced_assign)(req, cap))
     assign = np.asarray(out)  # device -> host OUTSIDE the jit, blocks
     wall = time.perf_counter() - t0  # host timing around the call, host-side
     digest = hashlib.sha256(np.ascontiguousarray(assign).tobytes()).hexdigest()[:16]

@@ -1,0 +1,60 @@
+"""GL6 fixture (clean): every device dispatch rides the fault domain.
+
+The four sanctioned shapes, one per function below:
+
+  (a) dispatch inside a wrapper's argument subtree (thunk or lambda),
+      including through an *aliased* import of the wrapper;
+  (b) a named closure handed to the wrapper after its def;
+  (c) a callee that owns the domain internally, called bare;
+  (d) dispatch from inside a traced (jit) function — the traced invoker
+      carries its own wrapper at its call site.
+
+This file must produce ZERO findings under every rule; the negative
+example (the PR-14 unwrapped block) lives in
+gl6_regression_unwrapped.py.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from open_simulator_tpu.resilience import faults
+from open_simulator_tpu.resilience.faults import run_launch as rl
+
+
+def wrapped_thunk(state):
+    # (a) the canonical shape: the dispatch is the wrapper's argument
+    return faults.run_launch("batched_schedule",
+                             lambda: batched_schedule(state))
+
+
+def wrapped_via_alias(out):
+    # (a) through an import alias: `rl` still resolves to run_launch
+    return rl("sync", lambda: out.block_until_ready())
+
+
+def closure_handoff(state):
+    # (b) the def precedes the wrapper call; the name is still sanctioned
+    def launch():
+        return schedule_pods(state)
+
+    return faults.run_launch("schedule_pods", launch)
+
+
+def run_batched_cached(state):
+    # (c) callee-owns-the-domain: the wrapper lives inside this def, so a
+    # bare `run_batched_cached(...)` call site (below) is fine
+    return faults.run_launch("batched", lambda: batched_schedule(state))
+
+
+def bare_call_to_domain_owner(state):
+    return run_batched_cached(state)
+
+
+@jax.jit
+def schedule_pods(xs):
+    # (d) traced body: dispatch happens at the traced invoker's site
+    return jnp.sum(xs)
+
+
+def batched_schedule(state):
+    return schedule_pods(jnp.asarray(state))

@@ -1,0 +1,22 @@
+"""GL6 regression fixture: the PR-14 incident shape.
+
+The serving path jitted a kernel, invoked it, and then called
+`block_until_ready()` directly — outside `faults.run_launch` — so a
+device loss during the sync surfaced as an unclassified traceback
+instead of a structured E_DEVICE_LOST with a retry/degrade rung. Both
+the bare jit-result invoke and the naked sync must flag GL6.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _kernel(xs):
+    return jnp.sum(xs)
+
+
+def serve_once(xs):
+    fn = jax.jit(_kernel)
+    out = fn(xs)              # jit result invoked outside the domain
+    out.block_until_ready()   # the PR-14 line: naked device sync
+    return out

@@ -1,0 +1,47 @@
+"""GL7 fixture (bad): the remaining lock hazards.
+
+  * a lock-order CYCLE between two module locks (A->B here, B->A there);
+  * self-nesting a non-reentrant threading.Lock (self-deadlock);
+  * a plain lock held ACROSS a device launch — directly, and
+    transitively through a helper call.
+"""
+
+import threading
+
+from open_simulator_tpu.resilience import faults
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+
+
+def forward(table):
+    with LOCK_A:
+        with LOCK_B:          # A -> B
+            return dict(table)
+
+
+def backward(table):
+    with LOCK_B:
+        with LOCK_A:          # B -> A: cycle with forward()
+            return dict(table)
+
+
+def double_acquire():
+    with LOCK_A:
+        with LOCK_A:          # non-reentrant self-nest: deadlock
+            return True
+
+
+def launch_under_lock(state):
+    with LOCK_A:
+        # the whole fleet stalls behind LOCK_A while the device retries
+        return faults.run_launch("batched", lambda: sum(state))
+
+
+def _helper_launch(state):
+    return faults.run_launch("batched", lambda: sum(state))
+
+
+def launch_under_lock_via_helper(state):
+    with LOCK_B:
+        return _helper_launch(state)   # transitive span through helper

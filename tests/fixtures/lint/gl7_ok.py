@@ -1,0 +1,70 @@
+"""GL7 fixture (clean): the sanctioned locking patterns.
+
+  * consistent acquisition ORDER across two module locks (A before B,
+    everywhere) — edges but no cycle;
+  * `try_hold` for the second key of a KeyedMutex — non-blocking by
+    contract, so it is never a lock-order edge (the PR-11 fix);
+  * snapshot-under-the-lock, launch-outside-it (the resident-cache
+    _guard pattern);
+  * a self-stored lock acquired through a helper method while the
+    caller holds nothing.
+
+This file must produce ZERO findings under every rule.
+"""
+
+import threading
+
+from open_simulator_tpu.resilience import faults
+from open_simulator_tpu.resilience.lifecycle import KeyedMutex
+
+_STATS_LOCK = threading.Lock()
+_TABLE_LOCK = threading.Lock()
+SESSIONS = KeyedMutex()
+
+
+def ordered_everywhere(stats, table):
+    # single documented order: _STATS_LOCK then _TABLE_LOCK
+    with _STATS_LOCK:
+        with _TABLE_LOCK:
+            table.update(stats)
+
+
+def same_order_elsewhere(table):
+    with _STATS_LOCK:
+        with _TABLE_LOCK:
+            return dict(table)
+
+
+def evict_then_rehydrate(src, dst):
+    # PR-11 fix shape: the second key is try_hold (non-blocking), so no
+    # cross-key blocking edge exists
+    with SESSIONS.hold(src):
+        with SESSIONS.try_hold(dst) as got:
+            if not got:
+                return False
+    return True
+
+
+def snapshot_then_launch(state):
+    # snapshot under the lock, dispatch outside it
+    with _STATS_LOCK:
+        snap = dict(state)
+    return faults.run_launch("batched", lambda: batched_schedule(snap))
+
+
+def batched_schedule(snap):
+    return faults.run_launch("inner", lambda: len(snap))
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def _locked_push(self, item):
+        # helper owns the acquisition; callers hold nothing
+        with self._lock:
+            self._items.append(item)
+
+    def add(self, item):
+        self._locked_push(item)

@@ -1,0 +1,27 @@
+"""GL7 regression fixture: the PR-11 session-store deadlock.
+
+Eviction held one session's key and then *blocking*-acquired a second
+key of the same KeyedMutex while rehydration did the opposite — the
+classic AB-BA interleave. Two threads evicting A->B and B->A deadlock.
+The shipped fix switched the second acquire to try_hold; this fixture
+keeps the broken blocking shape and must flag GL7.
+"""
+
+from open_simulator_tpu.resilience.lifecycle import KeyedMutex
+
+
+class SessionStore:
+    def __init__(self):
+        self._mu = KeyedMutex()
+        self._resident = {}
+
+    def evict_into(self, victim, target):
+        with self._mu.hold(victim):
+            snap = self._resident.pop(victim, None)
+            with self._mu.hold(target):  # blocking cross-key: AB-BA
+                self._resident[target] = snap
+
+    def rehydrate_from(self, target, victim):
+        with self._mu.hold(target):
+            with self._mu.hold(victim):  # opposite order on other thread
+                self._resident[target] = self._resident.get(victim)

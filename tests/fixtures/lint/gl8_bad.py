@@ -1,0 +1,85 @@
+"""GL8 fixture (bad): boundary functions that lose the error taxonomy.
+
+  * a `do_*` handler whose broad except swallows the error (the client
+    sees nothing classified);
+  * a decorator-routed handler — wrapped in a SECOND decorator, which
+    must not hide it from boundary detection — doing the same;
+  * a handler raising a bare builtin that escapes to the return
+    (unclassified 500);
+  * a thread worker swallowing everything with `pass`;
+  * a `do_*` handler that just dispatches to `self._do_delete()`, whose
+    broad except swallows — one delegation level must not hide it.
+"""
+
+import functools
+import threading
+from http.server import BaseHTTPRequestHandler
+
+
+class FixtureHandler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        try:
+            body = self._answer()
+        except Exception:
+            body = {"ok": False}   # swallowed: no status mapping
+        self._send(200, body)
+
+    def do_POST(self):
+        raw = self.rfile.read(16)
+        if not raw:
+            raise ValueError("empty body")   # escapes: unclassified 500
+        self._send(200, {"n": int(raw)})
+
+    def do_DELETE(self):
+        self._do_delete()
+
+    def _do_delete(self):
+        try:
+            self._answer()
+        except Exception:
+            self._send(500, {"error": "delete failed"})   # unclassified
+
+    def _answer(self):
+        return {"ok": True}
+
+    def _send(self, status, payload):
+        self.send_response(status)
+
+
+def observed(fn):
+    @functools.wraps(fn)
+    def wrap(*a, **kw):
+        return fn(*a, **kw)
+
+    return wrap
+
+
+def route(path):
+    def wrap(fn):
+        return fn
+
+    return wrap
+
+
+@observed
+@route("/simulate")
+def simulate_endpoint(body):
+    try:
+        return {"result": body["cluster"]}
+    except Exception:
+        return {"ok": False}   # swallowed at a routed boundary
+
+
+def _worker(queue):
+    while True:
+        job = queue.get()
+        try:
+            job()
+        except Exception:
+            pass   # the queue worker eats the taxonomy
+
+
+def start(queue):
+    t = threading.Thread(target=_worker, daemon=True)
+    t.start()
+    return t

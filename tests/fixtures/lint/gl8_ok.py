@@ -1,0 +1,82 @@
+"""GL8 fixture (clean): every boundary answers through the status map.
+
+  * a broad except in a `do_*` handler that maps the error through
+    `status_for` / `error_payload` (never a silent swallow);
+  * a decorator-routed handler that re-raises as a SimulationError
+    subclass (its .code maps through STATUS_BY_CODE upstream);
+  * a builtin raise that is fine because a LOCAL try/except catches it
+    before the handler returns;
+  * a thread worker that classifies via `classify` before logging.
+
+This file must produce ZERO findings under every rule.
+"""
+
+import threading
+from http.server import BaseHTTPRequestHandler
+
+from open_simulator_tpu.errors import SimulationError
+from open_simulator_tpu.server.serving import error_payload, status_for
+
+
+class FixtureBadRequest(SimulationError):
+    code = "E_VALIDATION"
+
+
+class FixtureHandler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        try:
+            body = self._answer()
+        except Exception as e:  # mapped, not swallowed
+            self._send(status_for(e), error_payload(e))
+            return
+        self._send(200, body)
+
+    def do_POST(self):
+        raw = self.rfile.read(16)
+        try:
+            if not raw:
+                raise ValueError("empty body")  # caught just below
+            n = int(raw)
+        except ValueError:
+            # the builtin never escapes: re-raised as a classified error
+            raise FixtureBadRequest("body must be an integer")
+        self._send(200, {"n": n})
+
+    def _answer(self):
+        return {"ok": True}
+
+    def _send(self, status, payload):
+        self.send_response(status)
+
+
+def route(path):
+    def wrap(fn):
+        return fn
+
+    return wrap
+
+
+@route("/simulate")
+def simulate_endpoint(body):
+    if "cluster" not in body:
+        raise FixtureBadRequest("missing cluster")
+    return {"ok": True}
+
+
+def classify(e):
+    return "E_BACKEND"
+
+
+def _worker(queue, log):
+    while True:
+        job = queue.get()
+        try:
+            job()
+        except Exception as e:  # classified before logging
+            log.append(classify(e))
+
+
+def start(queue, log):
+    t = threading.Thread(target=_worker, args=(queue, log), daemon=True)
+    t.start()
+    return t

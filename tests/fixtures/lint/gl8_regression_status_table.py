@@ -1,0 +1,21 @@
+"""GL8 regression fixture: the PR-12 drifted status table.
+
+rest.py once carried its own copy of the code->status mapping. It
+drifted: serving.py learned E_BUSY -> 429 for the admission queue, the
+copy still said 400, and load-shed clients saw "bad request" instead of
+"retry later". The literal table below reproduces that exact drift and
+must flag GL8 — the only legal home for the mapping is
+serving.STATUS_BY_CODE.
+"""
+
+# the hand-copied map (note E_BUSY: the live table says 429)
+_STATUS = {
+    "E_VALIDATION": 400,
+    "E_SOURCE": 400,
+    "E_BUSY": 400,
+    "E_BACKEND": 500,
+}
+
+
+def status_of(code):
+    return _STATUS.get(code, 500)

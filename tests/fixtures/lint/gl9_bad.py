@@ -1,0 +1,23 @@
+"""GL9 fixture (bad): direct durable writes bypassing the fault domain.
+
+Each write below skips DurableJournal/faults.run_io: no torn-tail
+framing, no checkpointing_disabled rung when the disk fills, and the
+storage fault injector never sees it — exactly the drift GL9 exists to
+stop in resilience/, telemetry/, campaign/ and replay/ (this file opts
+in via its `gl9_` name prefix).
+"""
+
+import json
+import os
+
+
+def dump_state(path, payload):
+    with open(path, "w", encoding="utf-8") as f:   # direct "w" open
+        json.dump(payload, f)
+
+
+def append_row(path, line):
+    fd = os.open(path, os.O_WRONLY | os.O_APPEND)
+    os.write(fd, line.encode())                    # raw os.write
+    os.fsync(fd)                                   # raw os.fsync
+    os.close(fd)

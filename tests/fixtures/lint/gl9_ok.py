@@ -1,0 +1,43 @@
+"""GL9 fixture (clean): durable writes ride the storage fault domain.
+
+(The `gl9_` filename prefix opts this file into GL9's path scope, which
+in the product tree covers resilience/, telemetry/, campaign/ and
+replay/.)
+
+  * the closure-handoff shape: the write is defined locally and handed
+    to `faults.run_io`, which owns retries and the ENOSPC/EIO rung;
+  * a DurableJournal subclass writing directly — the journal IS the
+    sanctioned owner of frames and fsyncs;
+  * read-mode opens, which are never durable writes.
+
+This file must produce ZERO findings under every rule.
+"""
+
+import json
+import os
+
+from open_simulator_tpu.resilience import faults
+from open_simulator_tpu.resilience.journal import DurableJournal
+
+
+def export_report(path, payload):
+    def write():
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+
+    faults.run_io("fixture_export", write)
+    return path
+
+
+class FixtureJournal(DurableJournal):
+    def flush_frame(self, frame):
+        # the journal owns its framing + fsync discipline
+        with open(self._path, "a", encoding="utf-8") as f:
+            f.write(frame)
+            f.flush()
+            os.fsync(f.fileno())
+
+
+def load_report(path):
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)

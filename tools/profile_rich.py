@@ -1,3 +1,4 @@
+# graftlint: disable-file=GL6 profiling tool times raw dispatch; wrapping in the fault domain would skew the trace
 """Profile the all-ops north-star while body: per-op time + kernel counts.
 
 Scratch tool (not part of the package): parses the device trace json

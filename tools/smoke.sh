@@ -5,12 +5,24 @@
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== graftlint (static trace-safety / engine-contract analysis) =="
-python -m open_simulator_tpu.cli lint
+echo "== graftlint (static trace-safety / engine-contract / runtime analysis) =="
+# full tree, all rules — the --changed subset is for pre-commit only
+lint_t0=$(date +%s)
+python -m open_simulator_tpu.cli lint --jobs 4
 rc=$?
+lint_wall=$(( $(date +%s) - lint_t0 ))
 if [ "$rc" -ne 0 ]; then
   echo "smoke FAILED: graftlint exited $rc" >&2
   exit "$rc"
+fi
+# wall-clock budget: the lint stage must stay interactive. The full-repo
+# run is ~10-15s warm; 90s flags a pathological regression (e.g. a rule
+# going quadratic over the module set) without tripping on cold CI disks.
+LINT_BUDGET_S=${LINT_BUDGET_S:-90}
+echo "graftlint wall: ${lint_wall}s (budget ${LINT_BUDGET_S}s)"
+if [ "$lint_wall" -gt "$LINT_BUDGET_S" ]; then
+  echo "smoke FAILED: graftlint took ${lint_wall}s > budget ${LINT_BUDGET_S}s" >&2
+  exit 1
 fi
 
 echo
