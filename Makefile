@@ -4,7 +4,7 @@
 
 .PHONY: test lint smoke bench bench-smoke bench-regress lifecycle-smoke \
 	multichip-smoke campaign-smoke replay-smoke session-smoke serve-smoke \
-	tune-smoke fault-smoke journal-smoke
+	tune-smoke fault-smoke journal-smoke trace-smoke
 
 test:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -106,6 +106,16 @@ fault-smoke:
 # SIGTERM under the plan still exits 0
 journal-smoke:
 	env JAX_PLATFORMS=cpu python tools/journal_smoke.py
+
+# causal-tracing gate (telemetry/context.py): a real server must echo a
+# client X-Simon-Trace-Id and reconstruct the request's causal timeline
+# (queue wait, coalesced launch, durable journal appends) from the black
+# box; /debug/executables lists harvested XLA costs; a deterministic
+# OOM plan yields a structured 503 whose timeline records the ladder
+# rungs and attempts plus a trace:dump ledger event; SIGTERM under
+# traced load still exits 0
+trace-smoke:
+	env JAX_PLATFORMS=cpu python tools/trace_smoke.py
 
 # regression gate over the run ledger (SIMON_LEDGER_DIR or
 # BENCH_LEDGER_DIR=... make bench-regress): the newest bench record per

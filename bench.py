@@ -51,6 +51,22 @@ def shape_label(nodes: int, pods: int, scenarios: int, rich: bool = False) -> st
     return f"{nodes}n_x{pods}p_x{scenarios}s" + ("_allops" if rich else "")
 
 
+def exec_costs() -> dict:
+    """Per-executable XLA cost profile for the tracked bench line:
+    {fn: {flops, bytes_accessed, peak_hbm_bytes, compile_s}} as harvested
+    at compile time by the executable cache. Empty on backends whose
+    cost_analysis() yields nothing — the key still rides along so the
+    regression gate sees the same shape everywhere."""
+    from open_simulator_tpu.engine.exec_cache import EXEC_CACHE
+
+    out = {}
+    for fn, cost in EXEC_CACHE.cost_snapshot().items():
+        out[fn] = {k: cost[k] for k in
+                   ("flops", "bytes_accessed", "peak_hbm_bytes",
+                    "compile_s") if k in cost}
+    return out
+
+
 def run_batched(snapshot, n_scenarios: int, fail_reasons: bool = False,
                 shape: str = "", preset: str = ""):
     """Time the capacity-sweep product path: what-if lanes run with
@@ -541,6 +557,7 @@ def main():
             "quarantined": report["totals"]["quarantined"],
             "completed": report["totals"]["completed"],
             "report_digest": report["digest"],
+            "exec_costs": exec_costs(),
         }))
         return
     if args.preset == "replay":
@@ -563,6 +580,7 @@ def main():
             "steps": steps,
             "pending_final": report["totals"]["pending"],
             "report_digest": report["digest"],
+            "exec_costs": exec_costs(),
         }))
         return
     if args.preset == "session":
@@ -584,6 +602,7 @@ def main():
             "events": n_events,
             "reuse_ratio": n_events // preset["sessions"],
             "trajectory_digest": digest,
+            "exec_costs": exec_costs(),
         }))
         return
     if args.preset == "tune":
@@ -605,6 +624,7 @@ def main():
             "rounds": report["rounds_run"],
             "pareto_points": len(report["pareto"]),
             "tune_digest": report["digest"],
+            "exec_costs": exec_costs(),
         }))
         return
     if args.preset == "serve":
@@ -626,6 +646,7 @@ def main():
             "launches": n_launches,
             "reuse_ratio": n_probes,
             "placement_digest": digest,
+            "exec_costs": exec_costs(),
         }))
         return
     for k in ("nodes", "pods", "scenarios", "max_new"):
@@ -730,6 +751,7 @@ def main():
         out["pools_scenarios_per_sec_per_chip"] = round(
             pl["scenarios"] / pl_dt, 2)
         out["pools_wave_stats"] = pl_stats
+    out["exec_costs"] = exec_costs()
     print(json.dumps(out))
 
 

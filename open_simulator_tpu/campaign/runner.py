@@ -402,6 +402,11 @@ def _run_one(entry: ClusterEntry, apps, opts: CampaignOptions,
                "field": "", "hint": "file the dump as a repro",
                "message": f"{type(e).__name__}: {e}"}
     clusters_total.labels(outcome="quarantined").inc()
+    from open_simulator_tpu.telemetry import context
+
+    context.BLACKBOX.record("quarantine", site="campaign",
+                            cluster=entry.name, code=err.get("code"),
+                            attempts=attempts["n"])
     _log.warning("campaign %s: cluster %s quarantined [%s] after %d "
                  "attempt(s): %s", campaign_id, entry.name,
                  err.get("code"), attempts["n"], err.get("message"))

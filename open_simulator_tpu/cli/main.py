@@ -471,6 +471,34 @@ def build_parser() -> argparse.ArgumentParser:
     sn_cl = sn_sub.add_parser("close", help="close a session")
     sn_cl.add_argument("session", metavar="SESSION_ID")
 
+    tr = sub.add_parser(
+        "trace",
+        help="follow one request through a running server: the black-box "
+             "causal timeline for a trace id",
+        description="Client for the server's black-box flight recorder "
+                    "(telemetry/context.py, ARCHITECTURE.md section 20): "
+                    "every HTTP request gets a trace id — client-supplied "
+                    "via the X-Simon-Trace-Id header or minted by the "
+                    "server and echoed back on the response — and every "
+                    "queue transition, coalesced launch, fault-ladder "
+                    "rung, journal append, and structured error it "
+                    "causes is stamped with that id in a bounded "
+                    "in-memory ring. `show` asks GET /api/trace/<id> for "
+                    "the reconstructed causal timeline. The ring is "
+                    "bounded: old traces age out.")
+    tr.add_argument("--server", default="http://127.0.0.1:8899",
+                    help="base URL of a running simon-tpu server")
+    tr_sub = tr.add_subparsers(dest="trace_command")
+    tr_sh = tr_sub.add_parser(
+        "show", help="print the causal timeline for one trace id")
+    tr_sh.add_argument("trace_id", metavar="TRACE_ID",
+                       help="trace id (from the X-Simon-Trace-Id response "
+                            "header, an access-log line, or a run "
+                            "record's trace tag)")
+    tr_sh.add_argument("--json", action="store_true",
+                       help="emit the raw timeline JSON instead of the "
+                            "rendered table")
+
     tn = sub.add_parser(
         "tune",
         help="scheduler-policy search on the lane axis: Pareto set over "
@@ -964,6 +992,60 @@ def _session_main(args) -> int:
     return 0 if status < 400 else 1
 
 
+def _trace_main(args) -> int:
+    """simon-tpu trace show <id>: render a request's causal timeline."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    if not args.trace_command:
+        print("error: pick a subcommand: trace {show}", file=sys.stderr)
+        return 2
+    base = args.server.rstrip("/")
+    from urllib.parse import quote
+
+    req = urllib.request.Request(
+        base + "/api/trace/" + quote(args.trace_id, safe=""),
+        method="GET")
+    try:
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                status, out = r.status, _json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                status, out = e.code, _json.loads(e.read())
+            except _json.JSONDecodeError:
+                status, out = e.code, {"error": str(e)}
+    except (OSError, urllib.error.URLError) as e:
+        print(f"error: cannot reach {base}: {e}", file=sys.stderr)
+        return 1
+    if status >= 400 or args.json:
+        print(_json.dumps(out, indent=2, sort_keys=True))
+        return 0 if status < 400 else 1
+    # rendered timeline: one line per black-box event, relative time
+    summary = out.get("summary") or {}
+    print(f"trace {out.get('trace_id')}  "
+          f"status={summary.get('status')} "
+          f"error={summary.get('error_code') or '-'} "
+          f"queue_wait_ms={summary.get('queue_wait_ms')} "
+          f"launches={summary.get('launches')} "
+          f"attempts={summary.get('attempts')} "
+          f"journal_appends={summary.get('journal_appends')}")
+    rungs = summary.get("rungs") or []
+    if rungs:
+        print("  rungs: " + ", ".join(
+            f"{r.get('fn')}:{r.get('rung')}[{r.get('code')}]"
+            for r in rungs))
+    for ev in out.get("events") or []:
+        ev = dict(ev)
+        kind = ev.pop("kind", "?")
+        dt = ev.pop("dt_ms", 0.0)
+        ev.pop("traces", None)
+        detail = " ".join(f"{k}={v}" for k, v in ev.items())
+        print(f"  {dt:>10.3f}ms  {kind:<10} {detail}")
+    return 0
+
+
 def main(argv=None) -> int:
     _init_logging()
     parser = build_parser()
@@ -998,6 +1080,9 @@ def main(argv=None) -> int:
 
     if args.command == "session":
         return _session_main(args)
+
+    if args.command == "trace":
+        return _trace_main(args)
 
     if args.command == "tune":
         return _tune_main(args)

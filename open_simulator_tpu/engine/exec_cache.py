@@ -49,13 +49,14 @@ tests/fixtures/lint/gl4_execcache_ok.py).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import logging
 import os
 import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -229,6 +230,58 @@ def unpad_output(out, n_pods: int):
 
 # ---- AOT executable cache ----------------------------------------------
 
+# the XLA cost fields harvested per executable (ISSUE 18): flops and
+# bytes accessed from compiled.cost_analysis(), the peak-HBM estimate
+# assembled from memory_analysis() sizes (arguments + outputs + temp
+# scratch, minus donated aliasing)
+_COST_FIELDS = ("flops", "bytes_accessed", "peak_hbm_bytes")
+
+
+def harvest_cost(compiled) -> Dict[str, Any]:
+    """Read the per-executable XLA cost profile, defensively.
+
+    `cost_analysis()` returns a dict on current jax, a one-element list
+    on older versions, and raises/returns None on backends that do not
+    implement it (CPU included on some versions); `memory_analysis()`
+    mirrors that. Harvest failures yield an empty profile — cost
+    accounting must never fail a compile."""
+    out: Dict[str, Any] = {}
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — backend-optional API
+        ca = None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if isinstance(ca, dict):
+        if ca.get("flops") is not None:
+            out["flops"] = float(ca["flops"])
+        ba = ca.get("bytes accessed", ca.get("bytes_accessed"))
+        if ba is not None:
+            out["bytes_accessed"] = float(ba)
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — backend-optional API
+        ma = None
+    if ma is not None:
+        sizes: Dict[str, float] = {}
+        for attr in ("argument_size_in_bytes", "output_size_in_bytes",
+                     "temp_size_in_bytes", "alias_size_in_bytes",
+                     "generated_code_size_in_bytes"):
+            v = getattr(ma, attr, None)
+            if isinstance(v, (int, float)):
+                sizes[attr] = float(v)
+        if sizes:
+            out["memory"] = sizes
+            # live-at-once estimate: arguments + outputs + scratch, with
+            # donated buffers (aliased into outputs) counted once
+            out["peak_hbm_bytes"] = max(0.0, (
+                sizes.get("argument_size_in_bytes", 0.0)
+                + sizes.get("output_size_in_bytes", 0.0)
+                + sizes.get("temp_size_in_bytes", 0.0)
+                - sizes.get("alias_size_in_bytes", 0.0)))
+    return out
+
+
 def _shape_sig(arrs) -> Tuple:
     out = []
     for f in dataclasses.fields(arrs):
@@ -250,7 +303,12 @@ class ExecutableCache:
     def __init__(self, capacity: int = 8):
         self.capacity = max(1, int(capacity))
         self._entries: "OrderedDict[Tuple, Any]" = OrderedDict()
+        # parallel store: cached values must stay directly callable, so
+        # the harvested cost profile lives beside the executable, keyed
+        # and evicted identically
+        self._costs: Dict[Tuple, Dict[str, Any]] = {}
         self._lock = threading.Lock()
+        self._hooks_installed = False
 
     def _count(self, fn_name: str, event: str) -> None:
         from open_simulator_tpu.telemetry import counter
@@ -284,19 +342,100 @@ class ExecutableCache:
         t0 = time.perf_counter()
         with span("compile", fn=fn_name):
             compiled = build()
+        compile_s = time.perf_counter() - t0
         _log.debug("compiled %s in %.3fs (cache size %d)", fn_name,
-                   time.perf_counter() - t0, len(self._entries) + 1)
+                   compile_s, len(self._entries) + 1)
+        # harvest the XLA cost profile at compile time — one host-side
+        # read per compile, amortized over every cached launch
+        cost = harvest_cost(compiled)
+        cost["fn"] = fn_name
+        cost["compile_s"] = round(compile_s, 6)
+        self._install_hooks()
+        from open_simulator_tpu.telemetry.context import BLACKBOX
+
+        BLACKBOX.record("compile", fn=fn_name,
+                        compile_ms=round(compile_s * 1000.0, 3),
+                        flops=cost.get("flops"),
+                        peak_hbm_bytes=cost.get("peak_hbm_bytes"))
         with self._lock:
             self._entries[key] = compiled
             self._entries.move_to_end(key)
+            self._costs[key] = cost
             while len(self._entries) > self.capacity:
-                self._entries.popitem(last=False)
+                k, _ = self._entries.popitem(last=False)
+                self._costs.pop(k, None)
                 self._count(fn_name, "eviction")
         return compiled
+
+    def _install_hooks(self) -> None:
+        """Register the simon_exec_cost_* callback gauges + the ledger
+        cost provider, once, lazily (at the first compile — a process
+        that never compiles never touches the registry)."""
+        if self._hooks_installed:
+            return
+        self._hooks_installed = True
+        from open_simulator_tpu.telemetry import gauge, ledger
+
+        def sample(field):
+            def cb():
+                return {(fn,): v[field]
+                        for fn, v in self.cost_snapshot().items()
+                        if isinstance(v.get(field), (int, float))}
+            return cb
+
+        gauge("simon_exec_cost_flops",
+              "XLA cost_analysis flops of the newest cached executable "
+              "per launch fn", labelnames=("fn",)).set_callback(
+                  sample("flops"))
+        gauge("simon_exec_cost_bytes_accessed",
+              "XLA cost_analysis bytes accessed of the newest cached "
+              "executable per launch fn", labelnames=("fn",)).set_callback(
+                  sample("bytes_accessed"))
+        gauge("simon_exec_cost_peak_hbm_bytes",
+              "estimated live-at-once device bytes (args + outputs + "
+              "temp - aliased) of the newest cached executable per "
+              "launch fn", labelnames=("fn",)).set_callback(
+                  sample("peak_hbm_bytes"))
+        ledger.set_cost_provider(self.cost_snapshot)
+
+    def cost_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-fn cost summary ({fn: {flops, bytes_accessed,
+        peak_hbm_bytes, compile_s, entries}}; the newest entry's profile
+        wins when a fn holds several shapes). Feeds the gauges, the
+        ledger cost section, and bench JSON lines."""
+        with self._lock:
+            costs = [dict(c) for c in self._costs.values()]
+        out: Dict[str, Dict[str, Any]] = {}
+        for cost in costs:  # insertion-ordered: newest last
+            fn = cost.pop("fn", "?")
+            cost.pop("memory", None)
+            agg = out.setdefault(fn, {"entries": 0})
+            entries = agg["entries"] + 1
+            agg.update(cost)
+            agg["entries"] = entries
+        return out
+
+    def debug_entries(self) -> List[Dict[str, Any]]:
+        """One row per cached executable (GET /debug/executables): the
+        launch fn, a stable digest of the cache key, and the full
+        harvested cost profile."""
+        with self._lock:
+            items = [(k, dict(self._costs.get(k, {})))
+                     for k in self._entries.keys()]
+        rows = []
+        for key, cost in items:
+            fn = cost.pop("fn", key[0] if key else "?")
+            rows.append({
+                "fn": fn,
+                "key": hashlib.sha256(repr(key).encode()).hexdigest()[:12],
+                "cost": cost,
+            })
+        return rows
 
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._costs.clear()
 
     def __len__(self) -> int:
         with self._lock:

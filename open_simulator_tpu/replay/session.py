@@ -746,6 +746,11 @@ class ReplaySession:
     def _quarantine(self, name: str, base_step: int, err: Dict[str, Any],
                     partial: Optional[Dict[str, Any]] = None
                     ) -> Dict[str, Any]:
+        from open_simulator_tpu.telemetry import context
+
+        context.BLACKBOX.record("quarantine", site="session",
+                                session=self.session_id, fork=name,
+                                code=err.get("code"))
         _log.warning("session %s: fork %s quarantined [%s]: %s",
                      self.session_id, name, err.get("code"),
                      err.get("message") or err.get("error"))

@@ -268,10 +268,14 @@ def record_fault(fn: str, fc: FaultClass) -> None:
 def record_rung(fn: str, rung: str, code: str = "") -> None:
     """Count + ledger-record one degradation-ladder rung. The ledger
     event is the persistent witness the smoke/tests read back: which
-    launch degraded, which rung caught it, for which fault code."""
-    from open_simulator_tpu.telemetry import ledger
+    launch degraded, which rung caught it, for which fault code. The
+    black-box event ties the rung to the REQUEST(S) whose launch walked
+    it (the ambient trace scope — the member tuple for a coalesced
+    group), so `GET /api/trace/<id>` shows the degradation inline."""
+    from open_simulator_tpu.telemetry import context, ledger
 
     _metrics()[2].labels(fn=fn, rung=rung).inc()
+    context.BLACKBOX.record("rung", fn=fn, rung=rung, code=code)
     ledger.append_event("fault", tags={"fn": fn, "rung": rung,
                                        "code": code})
     _log.warning("device fault domain: %s degraded via rung %r (%s)",
@@ -581,8 +585,17 @@ def run_launch(fn: str, launch: Callable[[], T], *, retries: int = 2,
     Unclassified exceptions and ``SimulationError``\\ s (cancellation
     included) pass through untouched."""
     from open_simulator_tpu.resilience.retry import run_with_retries
+    from open_simulator_tpu.telemetry.context import BLACKBOX
+
+    # attempt numbers in the flight recorder: a retried transient shows
+    # up as attempt 0, 1, ... in the request's timeline (the ambient
+    # trace scope tags each event)
+    counter = {"n": 0}
 
     def attempt() -> T:
+        n = counter["n"]
+        counter["n"] = n + 1
+        BLACKBOX.record("attempt", fn=fn, attempt=n)
         maybe_inject(fn)
         return launch()
 
@@ -598,6 +611,8 @@ def run_launch(fn: str, launch: Callable[[], T], *, retries: int = 2,
         if fc is None:
             raise
         record_fault(fn, fc)
+        BLACKBOX.record("fault", fn=fn, code=fc.code,
+                        transient=fc.transient, attempts=counter["n"])
         raise DeviceFault(
             f"{type(e).__name__}: {e}", code=fc.code,
             transient=fc.transient, fn=fn,

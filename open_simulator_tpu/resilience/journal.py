@@ -468,6 +468,12 @@ class DurableJournal:
             return
         self._seq += 1
         _metrics()[0].labels(kind=self.KIND).inc()
+        # flight-recorder witness: the durable write is part of the
+        # request's causal timeline (tagged with the ambient trace scope)
+        from open_simulator_tpu.telemetry import context as _trace_ctx
+
+        _trace_ctx.BLACKBOX.record("journal", journal=self.KIND,
+                                   seq=self._seq - 1)
 
     def _disable(self, code: str, err: Exception) -> None:
         from open_simulator_tpu.resilience import faults

@@ -357,7 +357,7 @@ class Job:
 
     __slots__ = ("fn", "token", "label", "done", "result", "error",
                  "queued_at", "abandoned", "group_key", "group_fn",
-                 "payload")
+                 "payload", "trace")
 
     def __init__(self, fn: Optional[Callable[[], Any]],
                  token: Optional[CancelToken], label: str,
@@ -375,6 +375,13 @@ class Job:
         self.group_key = group_key
         self.group_fn = group_fn
         self.payload = payload
+        # the submitter's trace id, captured at construction — contextvars
+        # do not cross the worker-thread hop, so the queue carries the
+        # identity and the worker re-enters trace_scope before running
+        # (ARCHITECTURE.md §20)
+        from open_simulator_tpu.telemetry import context as _trace_ctx
+
+        self.trace: Optional[str] = _trace_ctx.current_trace()
 
     def wait(self, timeout: Optional[float]) -> bool:
         return self.done.wait(timeout)
@@ -383,6 +390,12 @@ class Job:
         """The submitter gave up (deadline). The worker still accounts
         the job, but skips execution if it has not started yet."""
         self.abandoned = True
+
+
+def _blackbox():
+    from open_simulator_tpu.telemetry import context
+
+    return context.BLACKBOX
 
 
 def _queue_metrics():
@@ -464,6 +477,9 @@ class AdmissionQueue:
                 shed = _queue_metrics()[3]
                 shed.inc()
                 ra = self._retry_after_locked()
+                _blackbox().record("shed", trace=job.trace, label=job.label,
+                                   depth=len(self._jobs),
+                                   retry_after_s=float(ra))
                 raise QueueFullError(
                     f"admission queue is full ({self.depth} queued)",
                     retry_after_s=ra, ref="server",
@@ -471,6 +487,9 @@ class AdmissionQueue:
             self._jobs.append(job)
             depth_g = _queue_metrics()[0]
             depth_g.set(len(self._jobs))
+            _blackbox().record("enqueue", trace=job.trace, label=job.label,
+                               depth=len(self._jobs),
+                               coalescible=job.group_key is not None)
             self._ensure_workers()
             self._cv.notify()
         return job
@@ -582,25 +601,35 @@ class AdmissionQueue:
                 # the queue — executing it would burn the device for a
                 # response nobody is waiting for
                 jobs_total.labels(outcome="skipped").inc()
+                _blackbox().record("skip", trace=job.trace, label=job.label)
                 job.result = None
                 job.done.set()
             else:
                 runnable.append(job)
         if not runnable:
             return
+        from open_simulator_tpu.telemetry.context import trace_scope
+
         leader = runnable[0]
         t0 = time.monotonic()
         try:
             if leader.group_fn is not None:
                 coalesce_h.observe(len(runnable))
-                leader.group_fn(runnable)
+                # the launch runs under the TUPLE of member traces: one
+                # physical launch, N logical requests — rungs/retries/
+                # journal frames recorded inside land in every member's
+                # timeline (§20)
+                with trace_scope(tuple(j.trace for j in runnable
+                                       if j.trace)):
+                    leader.group_fn(runnable)
                 for job in runnable:
                     jobs_total.labels(
                         outcome="error" if job.error is not None
                         else "done").inc()
             else:
                 try:
-                    leader.result = leader.fn()
+                    with trace_scope(leader.trace):
+                        leader.result = leader.fn()
                 except BaseException as e:  # noqa: BLE001 — a poisoned job
                     # must not kill its worker and strand the jobs queued
                     # behind it; the exception goes back via .error
@@ -648,6 +677,10 @@ class AdmissionQueue:
             now = time.monotonic()
             for job in group:
                 wait_h.observe(now - job.queued_at)
+                _blackbox().record(
+                    "dequeue", trace=job.trace, label=job.label,
+                    wait_ms=round((now - job.queued_at) * 1000.0, 3),
+                    group=len(group))
             try:
                 self._run_group(group, jobs_total, coalesce_h)
             finally:

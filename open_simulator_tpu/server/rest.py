@@ -25,7 +25,24 @@ Endpoint parity with the reference (pkg/server/server.go:148-314):
                              404 E_NO_RUN when absent
   GET  /api/trace         -> Chrome-trace (Perfetto) JSON of the LAST
                              POST request's span tree — the server-side
-                             mirror of the CLI --trace-out flag
+                             mirror of the CLI --trace-out flag (the
+                             window comes from the black-box ring's
+                             newest request event, so concurrent
+                             --workers N never clobber each other)
+  GET  /api/trace/<id>    -> the causal timeline of ONE request by its
+                             trace id (accepted inbound via the
+                             X-Simon-Trace-Id header, or minted and
+                             echoed back on every response): queue
+                             admission + wait, coalesced siblings, the
+                             launch, fault rungs walked with attempt
+                             numbers, journal appends, evictions, and
+                             the final status — reconstructed from the
+                             always-on black-box event ring
+                             (telemetry/context.py, ARCHITECTURE.md §20)
+  GET  /debug/executables -> per-executable XLA cost profiles of the AOT
+                             cache (flops / bytes accessed / peak-HBM
+                             estimate per entry, harvested at compile
+                             time)
   POST /api/deploy-apps   -> simulate deploying new apps (+ optional new nodes)
   POST /api/simulate      -> the inference-grade probe (server/serving.py,
                              ARCHITECTURE.md §16): one scheduling lane
@@ -188,7 +205,7 @@ access_log = logging.getLogger("simon-tpu.http")
 # so a scanner can't inflate the label cardinality)
 _KNOWN_PATHS = frozenset({
     "/healthz", "/readyz", "/test", "/metrics", "/debug/stats",
-    "/debug/profile",
+    "/debug/profile", "/debug/executables",
     "/api/explain", "/api/deploy-apps", "/api/scale-apps", "/api/chaos",
     "/api/capacity", "/api/simulate", "/api/campaign", "/api/replay",
     "/api/runs", "/api/trace", "/api/session", "/api/tune",
@@ -266,9 +283,10 @@ class SimulationServer:
         # full (untrimmed) result of the last simulation: the explain
         # endpoint decodes it without re-running anything
         self._last_result: Optional[SimulateResult] = None
-        # span-window marker of the last POST request (GET /api/trace
-        # dumps exactly that request's span tree)
-        self._trace_mark = None
+        # NOTE: the old per-server `_trace_mark` (a single mutable slot
+        # every POST overwrote) is retired — span-window markers now ride
+        # the black-box "request" events, one per request, so concurrent
+        # workers never clobber each other's GET /api/trace window
         if ledger_dir:
             telemetry.ledger.configure(ledger_dir)
         # digital-twin sessions (replay/session.py): resident journaled
@@ -329,8 +347,12 @@ class SimulationServer:
         # cluster lands on the same digest); gauges drain to 0
         resident = self._snapshots.stats()
         self._snapshots.drop_all()
-        from open_simulator_tpu.telemetry import ledger
+        from open_simulator_tpu.telemetry import context, ledger
 
+        # the black box auto-dumps on drain: the flight recorder's last
+        # word lands in run history beside the drain record
+        context.BLACKBOX.record("drain", clean=bool(clean))
+        context.dump_to_ledger(None, "drain")
         run_id = ledger.append_event(
             "server:drain",
             tags={"requests": self._stats["requests"],
@@ -353,6 +375,9 @@ class SimulationServer:
 
         import jax
 
+        from open_simulator_tpu.telemetry import context
+        from open_simulator_tpu.telemetry.spans import RECORDER
+
         ru = resource.getrusage(resource.RUSAGE_SELF)
         return {
             **self._stats,
@@ -363,6 +388,11 @@ class SimulationServer:
             "profiling_to": self._profile_dir or None,
             "queue": self._queue.stats(),
             "resident_snapshots": self._snapshots.stats(),
+            # observability self-accounting: span-recorder overflow (the
+            # chrome-trace window silently lost its oldest records) and
+            # the black-box ring's fill/drop state
+            "spans_dropped": RECORDER.dropped,
+            "blackbox": context.BLACKBOX.stats(),
         }
 
     def toggle_profile(self, trace_dir: str = "") -> Dict[str, Any]:
@@ -921,6 +951,8 @@ def _make_handler(server: SimulationServer):
 
         def _account(self, status: int) -> None:
             """Access log + request metrics, once per response."""
+            from open_simulator_tpu.telemetry import context
+
             dur_s = time.perf_counter() - getattr(
                 self, "_t0", time.perf_counter())
             path = self.path.split("?", 1)[0]
@@ -929,20 +961,32 @@ def _make_handler(server: SimulationServer):
                 label = "/api/runs"
             elif path.startswith("/api/session/"):
                 label = "/api/session"  # session-id cardinality collapses
+            elif path.startswith("/api/trace/"):
+                label = "/api/trace"  # trace-id cardinality collapses
             else:
                 label = path if path in _KNOWN_PATHS else "other"
             method = self.command or "-"
             req_total.labels(method=method, path=label,
                              status=str(status)).inc()
             req_seconds.labels(path=label).observe(dur_s)
-            access_log.debug("%s %s -> %d %.1fms", method, path, status,
-                             dur_s * 1000.0)
+            trace = getattr(self, "_trace", None)
+            context.BLACKBOX.record("response", trace=trace, status=status,
+                                    method=method, path=label,
+                                    dur_ms=round(dur_s * 1000.0, 3))
+            access_log.debug("%s %s -> %d %.1fms trace=%s", method, path,
+                             status, dur_s * 1000.0, trace or "-")
 
         def _send_raw(self, code: int, data: bytes, ctype: str,
                       headers: tuple = ()) -> None:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            trace = getattr(self, "_trace", None)
+            if trace:
+                # always echo the request's trace id: the client can GET
+                # /api/trace/<id> (or `simon-tpu trace show <id>`) even
+                # when it never supplied one
+                self.send_header("X-Simon-Trace-Id", trace)
             for name, value in headers:
                 self.send_header(name, value)
             self.end_headers()
@@ -951,14 +995,27 @@ def _make_handler(server: SimulationServer):
 
         def _send(self, code: int, payload: Dict[str, Any],
                   headers: tuple = ()) -> None:
+            if code >= 500 and payload.get("code"):
+                # any structured 5xx auto-dumps the black box as a ledger
+                # event: the flight recorder's narrative survives in run
+                # history even if the ring later wraps
+                from open_simulator_tpu.telemetry import context
+
+                context.dump_to_ledger(getattr(self, "_trace", None),
+                                       "http_5xx")
             self._send_raw(code, json.dumps(payload).encode(),
                            "application/json", headers=headers)
 
         def do_GET(self):
+            from open_simulator_tpu.telemetry import context
+
             self._t0 = time.perf_counter()
+            self._trace = context.ensure_trace(
+                self.headers.get(context.TRACE_HEADER))
             in_flight.inc()
             try:
-                self._do_get()
+                with context.trace_scope(self._trace):
+                    self._do_get()
             finally:
                 in_flight.dec()
 
@@ -1017,10 +1074,17 @@ def _make_handler(server: SimulationServer):
             elif self.path == "/api/trace" or self.path.startswith("/api/trace?"):
                 # Chrome-trace JSON of the last POST request's span tree —
                 # the server-side mirror of --trace-out, without toggling
-                # the process-wide jax profiler
+                # the process-wide jax profiler. The span-window mark rides
+                # the black-box "request" event instead of a shared mutable
+                # server attribute, so concurrent workers can't clobber
+                # each other's window.
+                from open_simulator_tpu.telemetry import context
                 from open_simulator_tpu.telemetry.spans import RECORDER
 
-                if server._trace_mark is None:
+                mark_ev = context.BLACKBOX.latest(kind="request",
+                                                  with_field="span_mark",
+                                                  server_id=id(server))
+                if mark_ev is None:
                     # no POST yet: dumping the whole process history would
                     # masquerade as "the last request's timeline"
                     e = SimulationError(
@@ -1032,8 +1096,29 @@ def _make_handler(server: SimulationServer):
                     self._send_raw(
                         200,
                         json.dumps(RECORDER.chrome_trace(
-                            since=server._trace_mark)).encode(),
+                            since=tuple(mark_ev["span_mark"]))).encode(),
                         "application/json")
+            elif self.path.startswith("/api/trace/"):
+                # GET /api/trace/<trace_id>: causal timeline for one
+                # request, reconstructed from the black-box flight
+                # recorder (queue admission -> launch -> fault rungs ->
+                # journal appends -> final status)
+                from urllib.parse import unquote, urlparse
+
+                from open_simulator_tpu.telemetry import context
+
+                tid = unquote(
+                    urlparse(self.path).path[len("/api/trace/"):]).strip("/")
+                tl = context.timeline(tid)
+                if tl is None:
+                    e = SimulationError(
+                        f"trace id {tid!r} not found in the flight recorder",
+                        code="E_NO_TRACE", ref="server",
+                        hint="the black box is a bounded ring — old traces "
+                             "age out; re-run with X-Simon-Trace-Id set")
+                    self._send(_status_for(e), _err_payload(e))
+                else:
+                    self._send(200, tl)
             elif self.path == "/api/session" \
                     or self.path.startswith("/api/session?") \
                     or self.path.startswith("/api/session/"):
@@ -1064,6 +1149,19 @@ def _make_handler(server: SimulationServer):
                 except Exception as e:  # noqa: BLE001
                     err = _internal(e)
                     self._send(_status_for(err), _err_payload(err))
+            elif self.path == "/debug/executables":
+                # per-executable XLA cost profiles harvested at compile
+                # time: flops, bytes accessed, peak HBM, compile seconds
+                from open_simulator_tpu.engine.exec_cache import EXEC_CACHE
+
+                try:
+                    self._send(200, {
+                        "entries": EXEC_CACHE.debug_entries(),
+                        "cost_by_fn": EXEC_CACHE.cost_snapshot(),
+                    })
+                except Exception as e:  # noqa: BLE001
+                    err = _internal(e)
+                    self._send(_status_for(err), _err_payload(err))
             elif self.path == "/debug/profile" or self.path.startswith("/debug/profile?"):
                 # capture a jax profiler trace of the next simulation(s):
                 # /debug/profile?dir=/tmp/simprof starts, a second call
@@ -1081,18 +1179,28 @@ def _make_handler(server: SimulationServer):
                 self._send(404, {"error": "not found"})
 
         def do_POST(self):
+            from open_simulator_tpu.telemetry import context
+
             self._t0 = time.perf_counter()
+            self._trace = context.ensure_trace(
+                self.headers.get(context.TRACE_HEADER))
             in_flight.inc()
             try:
-                self._do_post()
+                with context.trace_scope(self._trace):
+                    self._do_post()
             finally:
                 in_flight.dec()
 
         def do_DELETE(self):
+            from open_simulator_tpu.telemetry import context
+
             self._t0 = time.perf_counter()
+            self._trace = context.ensure_trace(
+                self.headers.get(context.TRACE_HEADER))
             in_flight.inc()
             try:
-                self._do_delete()
+                with context.trace_scope(self._trace):
+                    self._do_delete()
             finally:
                 in_flight.dec()
 
@@ -1223,14 +1331,19 @@ def _make_handler(server: SimulationServer):
             ledger surface + the structured-error-to-status mapping."""
 
             def work():
-                # window marker for GET /api/trace: spans recorded from
-                # execution start belong to this request
+                # span-window marker for GET /api/trace rides a black-box
+                # "request" event: spans recorded from execution start
+                # belong to this request, and concurrent workers each get
+                # their own mark instead of clobbering a shared attribute
+                from open_simulator_tpu.telemetry import context
                 from open_simulator_tpu.telemetry.ledger import (
                     surface_override,
                 )
                 from open_simulator_tpu.telemetry.spans import RECORDER
 
-                server._trace_mark = RECORDER.mark()
+                context.BLACKBOX.record("request", method="POST",
+                                        path=route, server_id=id(server),
+                                        span_mark=RECORDER.mark())
                 try:
                     # the run the handler triggers records its ledger
                     # entry under this route's surface name; the cancel
@@ -1293,7 +1406,11 @@ def _make_handler(server: SimulationServer):
                 err = _internal(e)
                 self._send(_status_for(err), _err_payload(err))
                 return
-            server._trace_mark = RECORDER.mark()
+            from open_simulator_tpu.telemetry import context
+
+            context.BLACKBOX.record("request", method="POST", path=route,
+                                    server_id=id(server),
+                                    span_mark=RECORDER.mark())
             if callable(prepared):
                 # bisect mode: a multi-round journaled sweep — a classic
                 # singleton job with cancellation at round boundaries

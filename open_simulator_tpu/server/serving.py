@@ -106,6 +106,8 @@ STATUS_BY_CODE = {
     "E_NO_SIMULATION": 404,
     "E_NO_RUN": 404,
     "E_NO_SESSION": 404,   # unknown/closed digital-twin session id
+    "E_NO_TRACE": 404,     # trace id absent from the black-box ring
+                           # (unknown, or evicted — the ring is bounded)
     "E_AUDIT": 500,        # the engine's own invariants failed — server bug
     "E_INTERNAL": 500,     # unclassified handler exception (wrapped so
                            # even surprises answer through this table)
@@ -141,6 +143,12 @@ def error_payload(e: SimulationError) -> Dict[str, Any]:
 
 
 # ---- telemetry -----------------------------------------------------------
+
+
+def _blackbox():
+    from open_simulator_tpu.telemetry import context
+
+    return context.BLACKBOX
 
 
 def _resident_metrics():
@@ -292,6 +300,8 @@ class ResidentSnapshotCache:
         for old in dropped:
             old.dev = None
             events.labels(event="drop").inc()
+            _blackbox().record("eviction", site="resident_lru",
+                               digest=old.digest[:12])
         events.labels(event="insert").inc()
         self._gauges()
         return entry
@@ -342,6 +352,8 @@ class ResidentSnapshotCache:
                          for f in dataclasses.fields(padded))
             dev = jax.tree_util.tree_map(jnp.asarray, padded)
             events.labels(event="rehydrate").inc()
+            _blackbox().record("rehydrate", digest=entry.digest[:12],
+                               bytes=int(nbytes))
             if 0 < self.max_bytes < nbytes:
                 # one snapshot larger than the entire budget: serve it
                 # transiently (this launch works; nothing goes resident)
@@ -380,6 +392,8 @@ class ResidentSnapshotCache:
                     entry.dev = None
                     entry.device_bytes = 0
                     events.labels(event="eviction").inc()
+                    _blackbox().record("eviction", site="resident_bytes",
+                                       digest=victim[:12])
                     evicted += 1
                 else:
                     busy.add(victim)
@@ -402,6 +416,8 @@ class ResidentSnapshotCache:
                     e.device_bytes = 0
                     events.labels(event="eviction").inc()
                     dropped += 1
+        _blackbox().record("eviction", site="resident_drop_device",
+                           dropped=dropped)
         self._gauges()
         return dropped
 
@@ -774,6 +790,12 @@ def _launch_group(members: List[PreparedLanes]
         pad[: entry.n_pods] = lead.forced
         arrs = dataclasses.replace(arrs, forced_node=jnp.asarray(pad))
 
+    # the group-launch flight-recorder event: recorded under the worker's
+    # member-tuple trace scope, so it appears in EVERY member's timeline
+    # and each member's siblings are recoverable from its trace tags
+    _blackbox().record("launch", fn="serving_lanes", members=len(members),
+                       lanes=lanes, launch_lanes=bucket,
+                       digest=entry.digest[:12])
     with span("serving.launch", members=len(members), lanes=lanes,
               launch_lanes=bucket):
         # transient retries + the exec-cache OOM rung live inside
@@ -803,7 +825,16 @@ def _run_group(jobs: List[Any], members: List[PreparedLanes],
         # it escape would render as a bare 500 upstream
         for job in jobs:
             if job.result is None:
-                job.result = (status_for(e), error_payload(e))
+                status = status_for(e)
+                job.result = (status, error_payload(e))
+                # per-member error event under the member's OWN trace
+                # (the ambient scope is the whole group's tuple): its
+                # timeline ends in the structured error while a healthy
+                # sibling's ends in a 200
+                _blackbox().record(
+                    "error", trace=getattr(job, "trace", None),
+                    code=getattr(e, "code", "E_INTERNAL"), status=status,
+                    fn="serving_lanes")
 
     cache = members[0].cache
     try:
@@ -844,6 +875,9 @@ def _run_group(jobs: List[Any], members: List[PreparedLanes],
         if job.token is not None and job.token.cancelled:
             err = job.token.error("coalesced launch decode")
             job.result = (status_for(err), error_payload(err))
+            _blackbox().record("error", trace=getattr(job, "trace", None),
+                               code=err.code, status=job.result[0],
+                               fn="serving_lanes")
             continue
         try:
             res = LaneResult(nodes=nodes[sl], headroom=headroom[sl],
@@ -852,9 +886,15 @@ def _run_group(jobs: List[Any], members: List[PreparedLanes],
             job.result = m.decode(res)
         except SimulationError as e:
             job.result = (status_for(e), error_payload(e))
+            _blackbox().record("error", trace=getattr(job, "trace", None),
+                               code=e.code, status=job.result[0],
+                               fn="serving_lanes")
         except Exception as e:  # noqa: BLE001 — one member's decode bug
             # must not poison its siblings' responses
             job.result = (500, {"error": f"{type(e).__name__}: {e}"})
+            _blackbox().record("error", trace=getattr(job, "trace", None),
+                               code="E_INTERNAL", status=500,
+                               fn="serving_lanes")
 
 
 def audit_lane(entry: ResidentEntry, nodes_row: np.ndarray,

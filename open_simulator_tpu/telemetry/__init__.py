@@ -6,6 +6,10 @@ The unified observability layer (ARCHITECTURE.md §8):
                text exposition (GET /metrics renders the default REGISTRY)
   spans.py     nested host-side phase spans -> simon_phase_seconds +
                Chrome-trace JSON export (--trace-out, loads in Perfetto)
+  context.py   causal request tracing (ARCHITECTURE.md §20): the
+               X-Simon-Trace-Id contextvar + the always-on black-box
+               event ring behind GET /api/trace/<id> and
+               `simon-tpu trace show`
   runtime.py   on-demand jax gauges (live buffers, device memory) and
                jit compile-cache hit/miss accounting
   explain.py   per-pod "why this node / why unschedulable" decode of the
@@ -39,5 +43,14 @@ from open_simulator_tpu.telemetry.spans import (  # noqa: F401
     SpanRecorder,
     export_chrome_trace,
     span,
+)
+from open_simulator_tpu.telemetry.context import (  # noqa: F401
+    BLACKBOX,
+    TRACE_HEADER,
+    current_trace,
+    current_traces,
+    ensure_trace,
+    new_trace_id,
+    trace_scope,
 )
 from open_simulator_tpu.telemetry import ledger  # noqa: F401

@@ -1,0 +1,306 @@
+"""Causal request tracing: trace ids + the black-box flight recorder.
+
+The stack's runtime machinery (admission queue, coalesced group
+launches, the §18 degradation ladder, durable journals) was observable
+only in aggregate — counters moved, spans recorded, but nothing tied a
+specific HTTP request to the rungs it walked or the journal frames it
+wrote. This module is the identity spine (ARCHITECTURE.md §20):
+
+**Trace context** — a ``contextvars.ContextVar`` carrying the current
+trace id. The REST handler accepts an inbound ``X-Simon-Trace-Id``
+header (or mints one) and enters ``trace_scope`` for the request; the
+``AdmissionQueue`` captures the id at ``submit`` onto the Job and the
+worker re-enters the scope before running it, so the contextvar
+survives the thread hop. A coalesced group launch runs under a TUPLE of
+every member's trace — one physical launch, N logical requests — so
+fault rungs, retries, and journal appends recorded during the launch
+land in EVERY member's timeline. ``current_trace()`` returns the
+primary (first) id for single-valued consumers (access log, ledger
+RunRecord tags).
+
+**Black box** (``BLACKBOX``) — an always-on bounded ring of runtime
+events: queue transitions, launch spans, fault rungs and attempts,
+evictions, quarantines, journal appends, structured errors — each
+tagged with the ambient trace tuple and a monotonic timestamp. The ring
+is a flight recorder, not a log: recording is a lock + deque append
+(never I/O), overflow drops the OLDEST events, and every recorded
+event counts into ``simon_trace_events_total{kind}``.
+``GET /api/trace/<trace_id>`` and ``simon-tpu trace show <id>``
+reconstruct a trace's events into a causal timeline (queue wait,
+coalesced siblings, rungs walked, attempt numbers, journal writes), and
+the ring auto-dumps as a ledger event (``simon_trace_dumps_total``) on
+any structured 5xx and on drain — the black box survives the crash
+narrative it was recording.
+
+Everything here is HOST machinery (a contextvar, a deque, a lock) —
+nothing runs inside jit/scan scope (graftlint GL4), and the healthy-path
+cost of an unrecorded request is one contextvar read.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import re
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional, Tuple, Union
+
+TRACE_HEADER = "X-Simon-Trace-Id"
+
+# client-supplied ids are path/log material: bound the charset + length
+# instead of trusting the wire (an invalid header gets a fresh id, not
+# an error — tracing must never fail a request)
+_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9._:-]{1,128}$")
+
+TraceLike = Union[str, Tuple[str, ...], List[str], None]
+
+_trace_var: "contextvars.ContextVar[Optional[Tuple[str, ...]]]" = \
+    contextvars.ContextVar("simon_trace", default=None)
+
+
+def new_trace_id() -> str:
+    """Mint a fresh trace id (16 hex chars — short enough for log lines,
+    unique enough for a bounded ring)."""
+    return uuid.uuid4().hex[:16]
+
+
+def valid_trace_id(raw: Optional[str]) -> bool:
+    return bool(raw) and _TRACE_ID_RE.match(raw) is not None
+
+
+def ensure_trace(header_value: Optional[str] = None) -> str:
+    """The trace id for an inbound request: the client's
+    ``X-Simon-Trace-Id`` when well-formed, else a fresh id."""
+    if header_value is not None and valid_trace_id(header_value.strip()):
+        return header_value.strip()
+    return new_trace_id()
+
+
+def _normalize(trace: TraceLike) -> Optional[Tuple[str, ...]]:
+    if trace is None:
+        return None
+    if isinstance(trace, str):
+        return (trace,)
+    out: List[str] = []
+    for t in trace:
+        if t and t not in out:
+            out.append(t)
+    return tuple(out) or None
+
+
+def current_traces() -> Tuple[str, ...]:
+    """Every trace id in scope — a singleton for ordinary requests, the
+    full member tuple inside a coalesced group launch, () outside any
+    request."""
+    return _trace_var.get() or ()
+
+
+def current_trace() -> Optional[str]:
+    """The PRIMARY trace id (first of the tuple) — what single-valued
+    consumers (ledger tags, the access log) record."""
+    traces = _trace_var.get()
+    return traces[0] if traces else None
+
+
+@contextlib.contextmanager
+def trace_scope(trace: TraceLike) -> Iterator[Optional[str]]:
+    """Enter a trace scope: a str for one request, a tuple of member ids
+    for a coalesced group launch, None to run untraced. Yields the
+    primary id. Restores the previous scope on exit (scopes nest — the
+    group tuple shadows the worker's ambient scope for the launch)."""
+    token = _trace_var.set(_normalize(trace))
+    try:
+        yield current_trace()
+    finally:
+        _trace_var.reset(token)
+
+
+# ---- the black box ------------------------------------------------------
+
+
+DEFAULT_RING_SIZE = 4096
+
+
+def _metrics():
+    from open_simulator_tpu.telemetry import counter
+
+    events = counter(
+        "simon_trace_events_total",
+        "black-box flight-recorder events by kind",
+        labelnames=("kind",))
+    dumps = counter(
+        "simon_trace_dumps_total",
+        "black-box auto-dumps to the ledger (structured 5xx, drain)",
+        labelnames=("reason",))
+    return events, dumps
+
+
+class BlackBox:
+    """The bounded flight-recorder ring.
+
+    ``record`` is the hot path: build a small dict, one lock hold, one
+    deque append — never I/O, never raises into the caller. The ring
+    drops OLDEST on overflow (the crash narrative is in the newest
+    events) and counts what it dropped.
+    """
+
+    def __init__(self, maxlen: int = DEFAULT_RING_SIZE):
+        self.maxlen = int(maxlen)
+        self._events: "deque[Dict[str, Any]]" = deque(maxlen=self.maxlen)
+        self._lock = threading.Lock()
+        self._recorded = 0
+        self._dropped = 0
+
+    def record(self, kind: str, trace: TraceLike = None,
+               **fields: Any) -> Dict[str, Any]:
+        """Append one event. ``trace`` overrides the ambient scope (the
+        per-member error path knows its member better than the group
+        tuple); omitted, the event tags the current scope's tuple."""
+        traces = _normalize(trace)
+        if traces is None:
+            traces = current_traces()
+        ev: Dict[str, Any] = {"kind": kind, "t": time.monotonic(),
+                              "traces": traces}
+        ev.update(fields)
+        try:
+            _metrics()[0].labels(kind=kind).inc()
+        except Exception:  # noqa: BLE001 — recording must never fail a request
+            pass
+        with self._lock:
+            if len(self._events) == self.maxlen:
+                self._dropped += 1
+            self._events.append(ev)
+            self._recorded += 1
+        return ev
+
+    def events_for(self, trace_id: str) -> List[Dict[str, Any]]:
+        """Every ring event tagged with the trace (membership match:
+        a group-launch event tagged (a, b, c) belongs to all three)."""
+        with self._lock:
+            return [dict(e) for e in self._events
+                    if trace_id in e["traces"]]
+
+    def latest(self, kind: Optional[str] = None,
+               with_field: Optional[str] = None,
+               **match: Any) -> Optional[Dict[str, Any]]:
+        """The newest event, optionally filtered by kind, by the presence
+        of a field, and/or by field equality — how the bare
+        ``GET /api/trace`` finds ITS server's last request's span window
+        (the ring is process-global; a test process can host several
+        servers)."""
+        with self._lock:
+            for e in reversed(self._events):
+                if kind is not None and e["kind"] != kind:
+                    continue
+                if with_field is not None and with_field not in e:
+                    continue
+                if any(e.get(k) != v for k, v in match.items()):
+                    continue
+                return dict(e)
+        return None
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"events": len(self._events), "capacity": self.maxlen,
+                    "recorded": self._recorded, "dropped": self._dropped}
+
+    def clear(self) -> None:
+        """Test hook — production never clears the recorder."""
+        with self._lock:
+            self._events.clear()
+            self._recorded = 0
+            self._dropped = 0
+
+
+BLACKBOX = BlackBox()
+
+
+# ---- timeline reconstruction --------------------------------------------
+
+
+def timeline(trace_id: str) -> Optional[Dict[str, Any]]:
+    """Reconstruct one trace's causal timeline from the ring.
+
+    Events come back in recording order with ``dt_ms`` relative to the
+    trace's first event, plus a summary: queue wait, launch count and
+    coalesced siblings (the OTHER ids sharing a launch event), rungs
+    walked, attempts fired, journal appends, and the final response
+    status/error code when the ring still holds them. Returns None for
+    an id the ring has never seen (evicted or unknown — the ring is
+    bounded by design)."""
+    evs = BLACKBOX.events_for(trace_id)
+    if not evs:
+        return None
+    t0 = evs[0]["t"]
+    out_events: List[Dict[str, Any]] = []
+    summary: Dict[str, Any] = {
+        "queue_wait_ms": None, "launches": 0, "siblings": [],
+        "rungs": [], "attempts": 0, "journal_appends": 0,
+        "status": None, "error_code": None,
+    }
+    siblings: List[str] = []
+    for e in evs:
+        row = dict(e)
+        row["dt_ms"] = round((e["t"] - t0) * 1000.0, 3)
+        row["traces"] = list(e["traces"])
+        del row["t"]
+        out_events.append(row)
+        kind = e["kind"]
+        if kind == "dequeue" and e.get("wait_ms") is not None:
+            summary["queue_wait_ms"] = e["wait_ms"]
+        elif kind == "launch":
+            summary["launches"] += 1
+            for t in e["traces"]:
+                if t != trace_id and t not in siblings:
+                    siblings.append(t)
+        elif kind == "rung":
+            summary["rungs"].append(
+                {"fn": e.get("fn"), "rung": e.get("rung"),
+                 "code": e.get("code")})
+        elif kind == "attempt":
+            # total launch attempts fired for this trace; per-launch
+            # numbering restarts after a ladder rung (cache_drop etc.)
+            # re-enters the launch wrapper, so count events, don't max
+            summary["attempts"] += 1
+        elif kind == "journal":
+            summary["journal_appends"] += 1
+        elif kind == "response":
+            summary["status"] = e.get("status")
+        elif kind == "error":
+            summary["error_code"] = e.get("code")
+            if e.get("status") is not None:
+                summary["status"] = e.get("status")
+    summary["siblings"] = siblings
+    return {"trace_id": trace_id, "events": out_events, "summary": summary}
+
+
+def dump_to_ledger(trace_id: Optional[str], reason: str) -> None:
+    """Auto-dump the black box as a ledger event (the 5xx/drain hook).
+
+    A compact record — event count, rung/error tallies, the trace id —
+    not the full ring; the live ring stays queryable and the ledger row
+    marks WHERE in run history the incident sits. Never raises (the
+    dump rides error paths that must still answer the client)."""
+    try:
+        from open_simulator_tpu.telemetry import ledger
+
+        tl = timeline(trace_id) if trace_id else None
+        tags: Dict[str, Any] = {"reason": reason}
+        if trace_id:
+            tags["trace"] = trace_id
+        if tl:
+            s = tl["summary"]
+            tags["events"] = str(len(tl["events"]))
+            tags["rungs"] = ",".join(
+                r["rung"] for r in s["rungs"] if r.get("rung")) or ""
+            if s.get("error_code"):
+                tags["code"] = s["error_code"]
+        stats = BLACKBOX.stats()
+        tags["ring_events"] = str(stats["events"])
+        ledger.append_event("trace:dump", tags=tags)
+        _metrics()[1].labels(reason=reason).inc()
+    except Exception:  # noqa: BLE001 — the dump must never mask the 5xx
+        pass

@@ -337,7 +337,14 @@ class RunCapture:
         return out
 
     def finish(self) -> Dict[str, Any]:
-        return {
+        from open_simulator_tpu.telemetry import context as _trace_ctx
+
+        trace = _trace_ctx.current_trace()
+        if trace and "trace" not in self.tags:
+            # the §20 identity spine: the RunRecord names the request
+            # that produced it, so `runs show` ↔ `trace show` cross-link
+            self.tags["trace"] = trace
+        rec = {
             "schema": SCHEMA_VERSION,
             "run_id": uuid.uuid4().hex[:12],
             "ts": round(self._ts, 6),
@@ -350,6 +357,33 @@ class RunCapture:
             "env": _environment(),
             "tags": self.tags,
         }
+        costs = _provided_costs()
+        if costs:
+            # per-executable XLA cost profiles (flops / bytes / peak-HBM
+            # estimate) harvested at compile time — the "why is my run
+            # slow/big" section of `simon-tpu runs show`
+            rec["costs"] = costs
+        return rec
+
+
+# per-executable cost snapshot provider (engine/exec_cache.py registers
+# ExecutableCache.cost_snapshot). A hook instead of an import: the ledger
+# must not depend on the engine layer, and tests can stub it.
+_cost_provider: Optional[Any] = None
+
+
+def set_cost_provider(fn) -> None:
+    global _cost_provider
+    _cost_provider = fn
+
+
+def _provided_costs() -> Dict[str, Any]:
+    if _cost_provider is None:
+        return {}
+    try:
+        return dict(_cost_provider() or {})
+    except Exception:  # noqa: BLE001 — cost accounting is best-effort
+        return {}
 
 
 def append_event(surface: str, tags: Optional[Dict[str, Any]] = None,
@@ -362,6 +396,12 @@ def append_event(surface: str, tags: Optional[Dict[str, Any]] = None,
     led = default_ledger()
     if led is None:
         return None
+    tags = dict(tags or {})
+    from open_simulator_tpu.telemetry import context as _trace_ctx
+
+    trace = _trace_ctx.current_trace()
+    if trace and "trace" not in tags:
+        tags["trace"] = trace
     rec = {
         "schema": SCHEMA_VERSION,
         "run_id": uuid.uuid4().hex[:12],
@@ -373,7 +413,7 @@ def append_event(surface: str, tags: Optional[Dict[str, Any]] = None,
         "metrics": {},
         "result": None,
         "env": _environment(),
-        "tags": dict(tags or {}),
+        "tags": tags,
     }
     from open_simulator_tpu.resilience.faults import DeviceFault
 

@@ -32,6 +32,10 @@ from open_simulator_tpu.telemetry import registry as _registry
 
 # one histogram for every phase span, labeled by phase name
 PHASE_SECONDS = "simon_phase_seconds"
+# counts records the bounded recorder overflowed away (oldest-first) —
+# a chrome-trace export after heavy load is a WINDOW, and this counter
+# is how /debug/stats says so instead of the window lying by omission
+SPANS_DROPPED_TOTAL = "simon_spans_dropped_total"
 
 
 @dataclass(frozen=True)
@@ -58,6 +62,7 @@ class SpanRecorder:
         self._lock = threading.Lock()
         self._epoch = time.perf_counter()
         self._local = threading.local()
+        self.dropped = 0  # records the deque overflowed away (oldest)
 
     # ---- stack (thread-local nesting) ---------------------------------
 
@@ -82,7 +87,19 @@ class SpanRecorder:
             depth=len(self._stack()) if depth is None else depth,
             args=dict(args or {}))
         with self._lock:
+            overflowed = (self._records.maxlen is not None
+                          and len(self._records) == self._records.maxlen)
             self._records.append(rec)
+            if overflowed:
+                self.dropped += 1
+        if overflowed:
+            # overflow was silent at maxlen: the recorder kept the newest
+            # window and nothing said records were lost
+            _registry.counter(
+                SPANS_DROPPED_TOTAL,
+                "span records evicted from the bounded recorder (oldest "
+                "dropped; the retained window stays the newest spans)",
+            ).inc()
 
     def records(self) -> List[SpanRecord]:
         with self._lock:

@@ -1,0 +1,37 @@
+"""GL10 fixture (clean): the §20 cost-gauge family pattern.
+
+Pins the idiom the executable cache and flight recorder use — literal
+callback-gauge families (`simon_exec_cost_*` style, labeled by fn and
+sampled only at render) alongside a module-constant counter family
+with a bounded label set. GL10 must resolve every one of these to a
+declaration. This file must produce ZERO findings under every rule.
+"""
+
+from open_simulator_tpu.telemetry import counter, gauge
+
+TRACE_EVENTS_TOTAL = "simon_fixture_trace_events_total"
+
+
+def declare(snapshot_fn):
+    events = counter(TRACE_EVENTS_TOTAL, "fixture flight-recorder events",
+                     labelnames=("kind",))
+
+    def _field(field):
+        return lambda: {(fn,): v[field] for fn, v in snapshot_fn().items()
+                        if isinstance(v.get(field), (int, float))}
+
+    flops = gauge("simon_fixture_cost_flops",
+                  "fixture per-executable flop estimate",
+                  labelnames=("fn",))
+    # sampled only at render time — steady state pays nothing
+    flops.set_callback(_field("flops"))
+    hbm = gauge("simon_fixture_cost_peak_hbm_bytes",
+                "fixture per-executable peak HBM estimate",
+                labelnames=("fn",))
+    hbm.set_callback(_field("peak_hbm_bytes"))
+    return events, (flops, hbm)
+
+
+def record(snapshot_fn):
+    events, _gauges = declare(snapshot_fn)
+    events.labels(kind="compile").inc()

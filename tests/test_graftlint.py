@@ -393,6 +393,13 @@ def test_gl10_resolved_names_are_clean():
     assert lint_fixture("gl10_ok.py") == []
 
 
+def test_gl10_callback_cost_gauge_families_are_clean():
+    """The §20 idiom: literal callback-gauge cost families
+    (engine/exec_cache.py's simon_exec_cost_* trio) plus a
+    module-constant counter family must all resolve without drift."""
+    assert lint_fixture("gl10_cost_ok.py") == []
+
+
 def test_gl10_drifted_name_fails():
     fs = lint_fixture("gl10_bad.py")
     assert [f.code for f in fs] == ["GL10"]

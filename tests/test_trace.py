@@ -1,0 +1,330 @@
+"""Causal request tracing: trace context, the black-box flight
+recorder, and the /api/trace surfaces (telemetry/context.py,
+ARCHITECTURE.md section 20).
+
+Covers:
+* trace-id minting/validation and contextvar scope semantics (nesting,
+  tuple normalization for coalesced groups);
+* the bounded black-box ring: overflow drops oldest, dropped counting;
+* the span recorder's overflow accounting (simon_spans_dropped_total
+  keeps the NEWEST window);
+* HTTP round-trip: X-Simon-Trace-Id in -> echoed back -> GET
+  /api/trace/<id> reconstructs the causal timeline (queue admission,
+  dequeue wait, coalesced launch, final status);
+* per-request span-window marks (the old single server._trace_mark slot
+  was clobbered by concurrent workers);
+* deterministic fault injection on a coalesced group: the poisoned
+  member's timeline carries its OWN structured error while the sibling
+  shows the shared launch + rungs walked; an injected OOM's timeline
+  records the cache_drop rung with attempt numbers.
+"""
+
+import json
+import textwrap
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from http.server import ThreadingHTTPServer
+
+from open_simulator_tpu.resilience import faults
+from open_simulator_tpu.server.rest import SimulationServer, _make_handler
+from open_simulator_tpu.telemetry import context
+
+CLUSTER_YAML = textwrap.dedent("""
+    apiVersion: v1
+    kind: Node
+    metadata: {name: t0}
+    status:
+      allocatable: {cpu: "8", memory: 16Gi, pods: "110"}
+    ---
+    apiVersion: v1
+    kind: Node
+    metadata: {name: t1}
+    status:
+      allocatable: {cpu: "4", memory: 8Gi, pods: "110"}
+    ---
+    apiVersion: apps/v1
+    kind: Deployment
+    metadata: {name: app, namespace: default}
+    spec:
+      replicas: 2
+      selector: {matchLabels: {app: a}}
+      template:
+        metadata: {labels: {app: a}}
+        spec:
+          containers:
+            - name: c
+              resources: {requests: {cpu: "1", memory: 1Gi}}
+""")
+
+
+# ---- trace context (pure host machinery) ---------------------------------
+
+
+def test_ensure_trace_header_validation():
+    assert context.ensure_trace("req-1.a:b_c") == "req-1.a:b_c"
+    assert context.ensure_trace("  padded-ok  ") == "padded-ok"
+    # invalid ids (charset, length, empty) get a minted id, never an error
+    for bad in (None, "", "has space", "x" * 129, "semi;colon", "a\nb"):
+        minted = context.ensure_trace(bad)
+        assert minted != bad
+        assert context.valid_trace_id(minted)
+    assert context.valid_trace_id(context.new_trace_id())
+
+
+def test_trace_scope_nesting_and_tuple_normalization():
+    assert context.current_trace() is None
+    assert context.current_traces() == ()
+    with context.trace_scope("outer") as primary:
+        assert primary == "outer"
+        assert context.current_traces() == ("outer",)
+        # a coalesced-group tuple SHADOWS the worker's ambient scope
+        with context.trace_scope(["a", "b", "a", "b", "c"]) as p2:
+            assert p2 == "a"  # primary = first member
+            assert context.current_traces() == ("a", "b", "c")  # deduped
+        assert context.current_traces() == ("outer",)  # restored
+        with context.trace_scope(None):
+            assert context.current_trace() is None  # explicit untraced
+    assert context.current_trace() is None
+
+
+def test_blackbox_ring_bounded_drops_oldest():
+    box = context.BlackBox(maxlen=4)
+    for i in range(7):
+        box.record("enqueue", trace=f"t{i}", seq=i)
+    st = box.stats()
+    assert st["events"] == 4 and st["recorded"] == 7 and st["dropped"] == 3
+    # oldest gone, newest retained (the crash narrative is at the end)
+    assert box.events_for("t0") == []
+    assert box.events_for("t6")[0]["seq"] == 6
+    assert box.latest(kind="enqueue")["seq"] == 6
+    assert box.latest(kind="nope") is None
+
+
+def test_timeline_unknown_trace_is_none():
+    assert context.timeline("never-seen-" + context.new_trace_id()) is None
+
+
+def test_blackbox_membership_match_group_tuple():
+    box = context.BlackBox(maxlen=16)
+    with context.trace_scope(("m1", "m2")):
+        box.record("launch", members=2)
+    # one physical launch belongs to BOTH logical requests
+    assert len(box.events_for("m1")) == 1
+    assert len(box.events_for("m2")) == 1
+    assert box.events_for("m1")[0]["traces"] == ("m1", "m2")
+
+
+def test_span_recorder_overflow_counts_and_keeps_newest():
+    from open_simulator_tpu.telemetry import registry
+    from open_simulator_tpu.telemetry.spans import (
+        SPANS_DROPPED_TOTAL,
+        SpanRecorder,
+    )
+
+    rec = SpanRecorder(maxlen=3)
+    before = registry.counter(
+        SPANS_DROPPED_TOTAL, "span records evicted").value()
+    for i in range(5):
+        rec.add(f"phase{i}", t0=float(i), dur=0.001)
+    assert rec.dropped == 2
+    names = [r.name for r in rec.records()]
+    assert names == ["phase2", "phase3", "phase4"]  # newest window kept
+    after = registry.counter(SPANS_DROPPED_TOTAL, "").value()
+    assert after == before + 2
+
+
+# ---- HTTP round-trip ------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def traced_server():
+    srv = SimulationServer(workers=2)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _make_handler(srv))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", srv
+    httpd.shutdown()
+
+
+def _post(url, payload, trace_id=None):
+    headers = {"Content-Type": "application/json"}
+    if trace_id:
+        headers[context.TRACE_HEADER] = trace_id
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), headers=headers)
+    with urllib.request.urlopen(req) as resp:
+        return (resp.status, resp.headers.get(context.TRACE_HEADER),
+                json.loads(resp.read()))
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+@pytest.fixture(scope="module")
+def warm_digest(traced_server):
+    """One warm POST: admits the snapshot + compiles the serving
+    executable; later tests coalesce probes against its digest."""
+    url, _srv = traced_server
+    status, _echo, out = _post(url + "/api/simulate",
+                               {"cluster": {"yaml": CLUSTER_YAML}},
+                               trace_id="warmup-req")
+    assert status == 200
+    return out["snapshot_digest"]
+
+
+def test_trace_roundtrip_header_echo_and_timeline(traced_server,
+                                                  warm_digest):
+    url, _srv = traced_server
+    tid = "roundtrip-" + context.new_trace_id()
+    status, echo, _out = _post(url + "/api/simulate",
+                               {"base": warm_digest}, trace_id=tid)
+    assert status == 200
+    assert echo == tid  # client-supplied id echoed on the response
+    code, tl = _get(url + f"/api/trace/{tid}")
+    assert code == 200 and tl["trace_id"] == tid
+    kinds = [e["kind"] for e in tl["events"]]
+    assert "enqueue" in kinds     # queue admission
+    assert "dequeue" in kinds     # worker pickup, with measured wait
+    assert "launch" in kinds      # the (possibly coalesced) launch
+    assert "response" in kinds    # final status
+    s = tl["summary"]
+    assert s["status"] == 200 and s["error_code"] is None
+    assert s["queue_wait_ms"] is not None and s["launches"] >= 1
+
+
+def test_trace_minted_id_echoed_when_client_sends_none(traced_server,
+                                                       warm_digest):
+    url, _srv = traced_server
+    status, echo, _out = _post(url + "/api/simulate",
+                               {"base": warm_digest})
+    assert status == 200
+    assert context.valid_trace_id(echo)  # server minted one and said so
+    code, tl = _get(url + f"/api/trace/{echo}")
+    assert code == 200 and tl["summary"]["status"] == 200
+
+
+def test_trace_unknown_id_structured_404(traced_server):
+    url, _srv = traced_server
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(url + "/api/trace/no-such-trace")
+    assert ei.value.code == 404
+    body = json.loads(ei.value.read())
+    assert body["code"] == "E_NO_TRACE" and body["hint"]
+
+
+def test_span_windows_are_per_request_not_clobbered(traced_server,
+                                                    warm_digest):
+    """Regression for the racy global last-POST marker: each POST's
+    span-window mark rides its own black-box "request" event, so a
+    second worker's request can no longer clobber the first's window.
+    The bare GET /api/trace keeps its old meaning (the newest window)."""
+    url, srv = traced_server
+    ta = "win-a-" + context.new_trace_id()
+    tb = "win-b-" + context.new_trace_id()
+    _post(url + "/api/simulate", {"base": warm_digest}, trace_id=ta)
+    _post(url + "/api/simulate", {"base": warm_digest}, trace_id=tb)
+    marks = [e for e in context.BLACKBOX.events_for(ta)
+             + context.BLACKBOX.events_for(tb)
+             if e["kind"] == "request" and "span_mark" in e]
+    assert len(marks) == 2  # one retained mark PER request
+    assert marks[0]["span_mark"] != marks[1]["span_mark"]
+    assert all(m["server_id"] == id(srv) for m in marks)
+    # both requests' own timelines survived intact — nothing clobbered
+    for tid in (ta, tb):
+        _code, tl = _get(url + f"/api/trace/{tid}")
+        assert tl["summary"]["launches"] >= 1
+    code, trace_doc = _get(url + "/api/trace")
+    assert code == 200 and "traceEvents" in trace_doc
+
+
+def test_debug_executables_and_stats_surfaces(traced_server, warm_digest):
+    url, _srv = traced_server
+    _code, out = _get(url + "/debug/executables")
+    assert out["entries"], "warmed executable missing from /debug/executables"
+    assert any(row.get("cost", {}).get("compile_s", 0) > 0
+               for row in out["entries"])
+    _code, stats = _get(url + "/debug/stats")
+    assert "spans_dropped" in stats
+    assert stats["blackbox"]["capacity"] > 0
+    assert stats["blackbox"]["events"] > 0
+
+
+# ---- deterministic faults on a coalesced group ----------------------------
+
+
+def _probe_jobs(srv, digest, traces):
+    from open_simulator_tpu.server import serving
+
+    class _FakeJob:
+        def __init__(self, payload, trace):
+            self.payload = payload
+            self.token = None
+            self.result = None
+            self.trace = trace
+
+    return [_FakeJob(serving.prepare_simulate(srv, {"base": digest}), t)
+            for t in traces]
+
+
+def test_poisoned_member_timeline_vs_sibling(traced_server, warm_digest):
+    """One deterministic numeric poison that follows the batch split
+    down to ONE member: that member's timeline ends in its own
+    structured error; the sibling's shows the shared launch (with the
+    poisoned id listed as a coalesced sibling) and no error."""
+    from open_simulator_tpu.server import serving
+
+    url, srv = traced_server
+    bad_t = "poison-" + context.new_trace_id()
+    ok_t = "healthy-" + context.new_trace_id()
+    group = _probe_jobs(srv, warm_digest, [bad_t, ok_t])
+    with faults.injected("fn=serving_lanes,exc=numeric,times=2"):
+        # the worker runs a coalesced group under the member tuple
+        # (resilience/lifecycle.py _run_group) — mirrored here
+        with context.trace_scope((bad_t, ok_t)):
+            serving.execute_group(group)
+    outcomes = sorted((j.result[0], j.result[1].get("code"))
+                      for j in group)
+    assert outcomes == [(200, None), (500, "E_NUMERIC")], outcomes
+
+    _code, bad_tl = _get(url + f"/api/trace/{bad_t}")
+    _code, ok_tl = _get(url + f"/api/trace/{ok_t}")
+    # the poisoned member owns its structured error...
+    assert bad_tl["summary"]["error_code"] == "E_NUMERIC"
+    err = [e for e in bad_tl["events"] if e["kind"] == "error"]
+    assert err and err[0]["traces"] == [bad_t]  # the member's OWN event
+    # ...the sibling answered 200: shared launch recorded, no error
+    assert ok_tl["summary"]["error_code"] is None
+    assert ok_tl["summary"]["launches"] >= 1
+    assert bad_t in ok_tl["summary"]["siblings"]
+    # both walked the same degradation ladder (batch_split rung)
+    for tl in (bad_tl, ok_tl):
+        assert any(r["rung"] == "batch_split"
+                   for r in tl["summary"]["rungs"]), tl["summary"]
+
+
+def test_injected_oom_timeline_records_cache_drop_and_attempts(
+        traced_server, warm_digest):
+    """A fault-plan OOM on the coalesced launch: the timeline shows the
+    cache_drop rung and numbered attempts (initial + post-drop retry)."""
+    from open_simulator_tpu.server import serving
+
+    url, srv = traced_server
+    tid = "oom-" + context.new_trace_id()
+    group = _probe_jobs(srv, warm_digest, [tid])
+    with faults.injected("fn=serving_lanes,exc=oom,times=1"):
+        with context.trace_scope((tid,)):
+            serving.execute_group(group)
+    assert group[0].result[0] == 200  # the ladder absorbed the fault
+    _code, tl = _get(url + f"/api/trace/{tid}")
+    assert any(r["rung"] == "cache_drop" and r["code"] == "E_DEVICE_OOM"
+               for r in tl["summary"]["rungs"]), tl["summary"]
+    attempts = [e["attempt"] for e in tl["events"]
+                if e["kind"] == "attempt"]
+    assert 0 in attempts and len(attempts) >= 2  # numbered retries
+    assert tl["summary"]["attempts"] >= 2

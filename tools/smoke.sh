@@ -201,6 +201,15 @@ if [ "$rc" -ne 0 ]; then
 fi
 
 echo
+echo "== causal tracing (trace-id timelines, journal appends, XLA costs, fault rungs) =="
+make trace-smoke
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: trace-smoke exited $rc" >&2
+  exit "$rc"
+fi
+
+echo
 echo "== simon-tpu explain on the example cluster =="
 env JAX_PLATFORMS=cpu python -m open_simulator_tpu.cli explain \
   -f examples/config.yaml --top-k 2
