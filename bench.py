@@ -139,6 +139,119 @@ def run_batched(snapshot, n_scenarios: int, fail_reasons: bool = False,
     return best, wave_stats
 
 
+def run_mesh_bench(snapshot, n_scenarios: int, mesh_scenario=None,
+                   mesh_node=None, shape: str = "",
+                   preset: str = "northstar-mesh"):
+    """Time the mesh-sharded north-star path (the multi-chip number).
+
+    One single-device reference launch pins the digest; the mesh warm
+    launch must equal it bit-for-bit (GSPMD sharding must never change a
+    placement), and the timed loop donates each round's carry back into
+    the next (ARCHITECTURE §9 x*0 reset — zero realloc per round). The
+    run asserts EXACTLY ONE simon_compile_cache_total{fn=mesh_schedule}
+    miss across the warm launch plus all timed rounds: a recompile per
+    round would be the old per-call jit(vmap(...)) shape returning.
+    Reported as scenarios/sec/chip with device count and mesh split in
+    the tagged ledger record, so `make bench-regress` gates the
+    multi-chip number per mesh shape like every other series."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from open_simulator_tpu.engine.exec_cache import (
+        run_batched_cached,
+        run_mesh_cached,
+    )
+    from open_simulator_tpu.engine.scheduler import device_arrays, make_config
+    from open_simulator_tpu.engine.waves import waves_for
+    from open_simulator_tpu.parallel.sweep import (
+        active_masks_for_counts,
+        make_mesh,
+    )
+    from open_simulator_tpu.telemetry import counter, ledger
+
+    mesh = make_mesh(n_scenario=mesh_scenario, n_node=mesh_node or 1)
+    n_chips = int(mesh.devices.size)
+    split = "x".join(str(s) for s in mesh.shape.values())
+    scen_axis = int(mesh.shape["scenario"])
+    if n_scenarios % scen_axis:
+        raise SystemExit(
+            f"bench: --scenarios {n_scenarios} is not divisible by the mesh "
+            f"scenario axis ({scen_axis}); pick sizes that divide")
+
+    with ledger.run_capture("bench") as lcap:
+        cfg = make_config(snapshot)._replace(fail_reasons=False)
+        arrs = device_arrays(snapshot)
+        max_new = snapshot.n_nodes - snapshot.n_real_nodes
+        counts = [min(i % (max_new + 1), max_new) for i in range(n_scenarios)]
+        masks = jnp.asarray(active_masks_for_counts(snapshot, counts))
+        wave_plan = waves_for(snapshot.arrays, cfg)
+        wave_stats = (wave_plan.stats() if wave_plan is not None
+                      else {"n_waves": 0, "max_wave_width": 0,
+                            "wave_fraction": 0.0, "n_segments": 1})
+
+        # single-device reference: the mesh number only counts if GSPMD
+        # sharding did not move a single placement
+        ref = run_batched_cached(arrs, masks, cfg, waves=wave_plan)
+        ref_digest = ledger.array_result_digest(np.asarray(ref.node))
+
+        misses = counter("simon_compile_cache_total", "",
+                         labelnames=("fn", "event"))
+        m0 = misses.value(fn="mesh_schedule", event="miss")
+        out = run_mesh_cached(arrs, masks, cfg, mesh,
+                              waves=wave_plan)  # compile + warm
+        jax.block_until_ready(out.node)
+        warm_digest = ledger.array_result_digest(np.asarray(out.node))
+        if warm_digest["digest"] != ref_digest["digest"]:
+            raise SystemExit(
+                f"bench: mesh digest {warm_digest['digest']} != "
+                f"single-device {ref_digest['digest']} — the sharded path "
+                f"changed placement")
+
+        best = float("inf")
+        carry = out.state  # donated into round 1 (DEAD after the call)
+        for _ in range(5):
+            t0 = time.perf_counter()
+            out = run_mesh_cached(arrs, masks, cfg, mesh, carry=carry,
+                                  waves=wave_plan)
+            jax.block_until_ready(out.node)
+            best = min(best, time.perf_counter() - t0)
+            carry = out.state
+        miss_delta = int(misses.value(fn="mesh_schedule", event="miss") - m0)
+        if miss_delta != 1:
+            raise SystemExit(
+                f"bench: {miss_delta} mesh_schedule cache misses across the "
+                f"warm + 5 donated rounds (expected exactly 1)")
+        last_digest = ledger.array_result_digest(np.asarray(out.node))
+        if last_digest["digest"] != ref_digest["digest"]:
+            raise SystemExit(
+                f"bench: donated-carry round digest {last_digest['digest']} "
+                f"!= single-device {ref_digest['digest']} — the §9 x*0 "
+                f"reset contract broke under the mesh")
+
+        label = shape or (shape_label(snapshot.n_real_nodes, snapshot.n_pods,
+                                      n_scenarios) + f"_mesh{split}")
+        _bench_gauge().labels(shape=label).set(best)
+        lcap.set_config(cfg, snapshot=snapshot, arrs=arrs)
+        lcap.set_result_info(**last_digest)
+        lcap.tag("preset", preset)
+        lcap.tag("shape", label)
+        lcap.tag("lanes", n_scenarios)
+        lcap.tag("devices", n_chips)
+        lcap.tag("mesh", split)
+        lcap.tag("seconds", round(best, 6))
+        for wk, wv in wave_stats.items():
+            lcap.tag(wk, wv)
+        # same higher-is-better unit as run_batched so the per-shape
+        # bench_regress gate reads one convention everywhere
+        lcap.tag("value", round(snapshot.n_pods * n_scenarios / best, 3))
+        lcap.tag("scenarios_per_sec_per_chip",
+                 round(n_scenarios / best / n_chips, 3))
+    return dict(best=best, wave_stats=wave_stats,
+                digest=ref_digest["digest"], devices=n_chips, mesh=split,
+                label=label, miss_delta=miss_delta)
+
+
 def cpu_baseline_rate(n_nodes: int, rich: bool = False):
     """Single-scenario pods/sec on XLA:CPU (subprocess; own jax init).
 
@@ -208,6 +321,14 @@ PRESETS = {
     # figures, not to the 64-lane series)
     "northstar-wide": dict(nodes=5120, pods=51200, scenarios=256, max_new=64),
     "northstar-rich": dict(nodes=5120, pods=51200, scenarios=64, max_new=64, rich=True),
+    # the multi-chip north star: the SAME northstar shape, lanes sharded
+    # over a ("scenario", "node") GSPMD mesh via the AOT executable cache
+    # (engine/exec_cache.py run_mesh_cached) — scenarios/sec/CHIP with
+    # the digest asserted identical to the single-device path and exactly
+    # ONE mesh_schedule compile across the warm + donated-carry rounds.
+    # Mesh split via --mesh-scenario/--mesh-node (default: all local
+    # devices on the scenario axis, pure data parallel).
+    "northstar-mesh": dict(nodes=5120, pods=51200, scenarios=64, max_new=64),
     "gated": dict(nodes=1024, pods=2048, scenarios=256, max_new=64),
     "default": dict(nodes=1024, pods=2048, scenarios=256, max_new=64, rich=True),
     # multi-tenant pools: per-pool nodeSelectors make consecutive pods'
@@ -508,8 +629,12 @@ def run_serve_bench(n_nodes: int, n_requests: int, n_clients: int):
     return dt, n_probes, n_launches, admitted["digest"], label
 
 
-def main():
-    ap = argparse.ArgumentParser()
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="bench.py",
+        description="Batched capacity-planning throughput benchmark: one "
+                    "JSON line per run, appended to the run ledger and "
+                    "gated round over round by tools/bench_regress.py.")
     ap.add_argument("--preset", choices=sorted(PRESETS), default="default")
     ap.add_argument("--nodes", type=int)
     ap.add_argument("--pods", type=int)
@@ -530,7 +655,20 @@ def main():
         help="time the simulate() path (per-op failure accounting in every "
              "lane) instead of the default sweep path",
     )
-    args = ap.parse_args()
+    ap.add_argument(
+        "--mesh-scenario", type=int,
+        help="northstar-mesh: scenario-axis size of the ('scenario', "
+             "'node') device mesh (default: all local devices, pure data "
+             "parallel); --scenarios must be divisible by it")
+    ap.add_argument(
+        "--mesh-node", type=int,
+        help="northstar-mesh: node-axis size of the device mesh (default "
+             "1; scenario x node must fit the local device count)")
+    return ap
+
+
+def main():
+    args = build_parser().parse_args()
     if args.compile_cache_dir:
         from open_simulator_tpu.engine.exec_cache import enable_persistent_cache
 
@@ -653,6 +791,37 @@ def main():
         if getattr(args, k) is None:
             setattr(args, k, preset[k])
     rich = preset.get("rich", False)
+
+    if args.preset == "northstar-mesh":
+        # multi-chip north star: the same engine, lanes sharded over the
+        # GSPMD mesh through the AOT executable cache — digest asserted
+        # identical to the single-device path, exactly one compile
+        snapshot = build(args.nodes, args.pods, args.max_new)
+        res = run_mesh_bench(snapshot, args.scenarios,
+                             mesh_scenario=args.mesh_scenario,
+                             mesh_node=args.mesh_node, preset=args.preset)
+        print(json.dumps({
+            "metric": f"mesh_scenarios_per_sec_per_chip@{res['label']}",
+            "value": round(args.scenarios / res["best"] / res["devices"], 2),
+            "unit": "scenarios/s/chip",
+            "vs_baseline": 0.0,
+            # the digest-checked single-device path IS the baseline here;
+            # compare this line's per-chip rate to the `northstar` series
+            "baseline": "single_device_same_engine_digest_checked",
+            "preset": args.preset,
+            "devices": res["devices"],
+            "mesh": res["mesh"],
+            "lanes": args.scenarios,
+            "scenarios_per_sec": round(args.scenarios / res["best"], 2),
+            "pods_per_sec": round(args.pods * args.scenarios / res["best"], 1),
+            "digest": res["digest"],
+            "mesh_miss_delta": res["miss_delta"],
+            "n_waves": res["wave_stats"]["n_waves"],
+            "max_wave_width": res["wave_stats"]["max_wave_width"],
+            "wave_fraction": res["wave_stats"]["wave_fraction"],
+            "exec_costs": exec_costs(),
+        }))
+        return
 
     snapshot = build(args.nodes, args.pods, args.max_new, rich=rich,
                      pools=preset.get("pools", 0), bound=preset.get("bound", 0.0))

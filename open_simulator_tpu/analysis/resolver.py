@@ -642,18 +642,21 @@ class TaintChecker:
 # the parsed module set — same philosophy as the tensor rules: precise
 # about THIS repo's conventions, conservative about the rest.
 
-FAULT_WRAPPERS = frozenset({"run_launch", "run_io", "run_wave_launch"})
+FAULT_WRAPPERS = frozenset({"run_launch", "run_io", "run_wave_launch",
+                            "run_cached_launch"})
 
 # The wrappers that establish the *device* fault domain for GL7's
 # hold-spans-a-launch check. run_io is deliberately excluded: holding a
 # lock across serialized disk writes is the ledger/journal design, not a
 # hazard.
-LAUNCH_WRAPPERS = frozenset({"run_launch", "run_wave_launch"})
+LAUNCH_WRAPPERS = frozenset({"run_launch", "run_wave_launch",
+                             "run_cached_launch"})
 
 # Device-dispatching entry points (the PR-14 audit list): calling any of
 # these fires compiled work on the accelerator.
 DISPATCH_FNS = frozenset({"schedule_pods", "batched_schedule",
-                          "run_batched_cached", "mesh_schedule"})
+                          "run_batched_cached", "run_mesh_cached",
+                          "mesh_schedule"})
 
 
 def wrapper_name(call: ast.Call, imports: Dict[str, str]) -> str:

@@ -1284,9 +1284,13 @@ def main(argv=None) -> int:
         )
 
     if args.command == "gen-doc":
-        from open_simulator_tpu.cli.gendoc import generate_docs
+        from open_simulator_tpu.cli.gendoc import (
+            generate_bench_doc,
+            generate_docs,
+        )
 
         generate_docs(build_parser(), args.dir)
+        generate_bench_doc(args.dir)
         print(f"docs written to {args.dir}")
         return 0
 

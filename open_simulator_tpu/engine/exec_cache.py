@@ -49,6 +49,7 @@ tests/fixtures/lint/gl4_execcache_ok.py).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import hashlib
 import logging
 import os
@@ -475,6 +476,69 @@ def _zeros_carry_batch(arrs, cfg, lanes: int):
         lambda x: jnp.zeros((lanes,) + x.shape, x.dtype), proto)
 
 
+@functools.lru_cache(maxsize=32)
+def batched_lane_fn(cfg, waves, with_weights: bool):
+    """The batched scan body as a MODULE-LEVEL function of its static
+    configuration — (cfg, waves, weights-mode) — instead of a per-call
+    closure. One Python callable per static config means jax's own
+    function-identity cache can also see reuse, and (more importantly)
+    the mesh path below traces EXACTLY the program the single-device AOT
+    path traces: lanes vmapped over (mask_row, carry_row[, w_row]), the
+    donated carry reset in place per the §9 x*0 contract. cfg is a
+    hashable EngineConfig NamedTuple and waves a hashable WavePlan (both
+    already serve as executable-cache key components)."""
+    import jax
+
+    from open_simulator_tpu.engine.scheduler import schedule_pods
+
+    if with_weights:
+        def fnw(a, m, c, w):
+            def lane(mask_row, carry_row, w_row):
+                return schedule_pods(a, mask_row, cfg,
+                                     state=_fresh_lane_state(carry_row, a),
+                                     state_is_fresh=True, waves=waves,
+                                     weights=w_row)
+
+            return jax.vmap(lane)(m, c, w)
+
+        return fnw
+
+    def fn(a, m, c):
+        def lane(mask_row, carry_row):
+            return schedule_pods(a, mask_row, cfg,
+                                 state=_fresh_lane_state(carry_row, a),
+                                 state_is_fresh=True, waves=waves)
+
+        return jax.vmap(lane)(m, c)
+
+    return fn
+
+
+def _check_lane_weights(cfg, weights, lanes: int):
+    """Shared [S, K] validation for the single-device and mesh paths:
+    a traced cfg with no explicit weights runs every lane at the
+    config's own vector (digest-identical to constant mode); passing
+    weights with ``traced_weights`` off is an error."""
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.engine.scheduler import WEIGHT_FIELDS, weight_vector
+
+    if cfg.traced_weights and weights is None:
+        weights = np.tile(weight_vector(cfg), (lanes, 1))
+    if weights is None:
+        return None
+    if not cfg.traced_weights:
+        raise ValueError(
+            "per-lane weights need cfg.traced_weights (the constant "
+            "engine bakes its weights into the executable)")
+    weights = jnp.asarray(weights, jnp.float32)
+    if weights.shape != (lanes, len(WEIGHT_FIELDS)):
+        raise ValueError(
+            f"weights must be [{lanes}, {len(WEIGHT_FIELDS)}] "
+            f"(lanes x WEIGHT_FIELDS), got {tuple(weights.shape)}")
+    return weights
+
+
 def run_batched_cached(arrs, masks, cfg, carry=None,
                        fn_name: str = "batched_schedule", waves=None,
                        weights=None, retries: int = 2,
@@ -501,56 +565,22 @@ def run_batched_cached(arrs, masks, cfg, carry=None,
     import jax
     import jax.numpy as jnp
 
-    from open_simulator_tpu.engine.scheduler import (
-        WEIGHT_FIELDS,
-        schedule_pods,
-        weight_vector,
-    )
-
     masks = jnp.asarray(masks)
     lanes = int(masks.shape[0])
-    if cfg.traced_weights and weights is None:
-        weights = np.tile(weight_vector(cfg), (lanes, 1))
-    if weights is not None:
-        if not cfg.traced_weights:
-            raise ValueError(
-                "per-lane weights need cfg.traced_weights (the constant "
-                "engine bakes its weights into the executable)")
-        weights = jnp.asarray(weights, jnp.float32)
-        if weights.shape != (lanes, len(WEIGHT_FIELDS)):
-            raise ValueError(
-                f"weights must be [{lanes}, {len(WEIGHT_FIELDS)}] "
-                f"(lanes x WEIGHT_FIELDS), got {tuple(weights.shape)}")
+    weights = _check_lane_weights(cfg, weights, lanes)
     if carry is None:
         carry = _zeros_carry_batch(arrs, cfg, lanes)
     key = (fn_name, cfg, _shape_sig(arrs), (lanes,) + tuple(masks.shape[1:]),
            str(masks.dtype), waves,
            None if weights is None else tuple(weights.shape),
            tuple(str(d) for d in jax.devices()))
+    fn = batched_lane_fn(cfg, waves, weights is not None)
 
     def build():
         if weights is None:
-            def fn(a, m, c):
-                def lane(mask_row, carry_row):
-                    return schedule_pods(a, mask_row, cfg,
-                                         state=_fresh_lane_state(carry_row, a),
-                                         state_is_fresh=True, waves=waves)
-
-                return jax.vmap(lane)(m, c)
-
             return jax.jit(fn, donate_argnums=(2,)).lower(
                 arrs, masks, carry).compile()
-
-        def fnw(a, m, c, w):
-            def lane(mask_row, carry_row, w_row):
-                return schedule_pods(a, mask_row, cfg,
-                                     state=_fresh_lane_state(carry_row, a),
-                                     state_is_fresh=True, waves=waves,
-                                     weights=w_row)
-
-            return jax.vmap(lane)(m, c, w)
-
-        return jax.jit(fnw, donate_argnums=(2,)).lower(
+        return jax.jit(fn, donate_argnums=(2,)).lower(
             arrs, masks, carry, weights).compile()
 
     from open_simulator_tpu.resilience import faults
@@ -575,19 +605,157 @@ def run_batched_cached(arrs, masks, cfg, carry=None,
         # immediately after, so the sync costs no pipelining.
         return jax.block_until_ready(out)
 
-    try:
-        return faults.run_launch(fn_name, fire, retries=retries,
-                                 backoff_s=backoff_s)
-    except faults.DeviceFault as f:
-        if f.transient or f.code != faults.E_DEVICE_OOM:
-            raise
-        # OOM rung: evict every cached executable (their buffers and
-        # scratch are what crowd the device) and re-compile + re-launch
-        # once from fresh buffers — bit-identical outputs, later
-        faults.record_rung(fn_name, "cache_drop", f.code)
-        EXEC_CACHE.clear()
-        return faults.run_launch(fn_name, fire, retries=retries,
-                                 backoff_s=backoff_s)
+    # OOM rung: run_cached_launch evicts every cached executable (their
+    # buffers and scratch are what crowd the device) and re-compiles +
+    # re-launches once from fresh buffers — bit-identical outputs, later
+    return faults.run_cached_launch(fn_name, fire, evict=EXEC_CACHE.clear,
+                                    retries=retries, backoff_s=backoff_s)
+
+
+def _mesh_input_shardings(arrs, mesh):
+    """Per-field NamedShardings for a SnapshotArrays under the GSPMD mesh.
+
+    The per-node resource state — the NODE_AXIS_FIRST fields: alloc,
+    gpu_slot, vg_cap, ... — splits across the "node" mesh axis; that is
+    the state that actually scales with cluster size. The class-table
+    fields whose node axis comes SECOND (topo_onehot, has_key,
+    class_*) replicate: their leading axis is a vocab of
+    constraint/topology classes read by dynamic domain gathers inside
+    the scan (`state.dom_count[k1i, :, g]` and friends), and the SPMD
+    partitioner cannot split those gathers — a "node" split there fails
+    HLO verification after partitioning ("slice dim size greater than
+    dynamic slice dimension"). They are vocab x N tables, small next to
+    the [N, R] state, so replication costs little HBM. Pod-axis and
+    vocab fields replicate too (every lane reads all pods). Returned as
+    a SnapshotArrays of shardings — the registered pytree doubles as
+    the in_shardings tree."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    def spec_for(name: str, x) -> NamedSharding:
+        nd = np.asarray(x).ndim
+        if name in NODE_AXIS_FIRST:
+            return NamedSharding(mesh, P("node", *([None] * (nd - 1))))
+        return NamedSharding(mesh, P())
+
+    out = {f.name: spec_for(f.name, getattr(arrs, f.name))
+           for f in dataclasses.fields(arrs)}
+    return type(arrs)(**out)
+
+
+def run_mesh_cached(arrs, masks, cfg, mesh, carry=None,
+                    fn_name: str = "mesh_schedule", waves=None,
+                    weights=None, retries: int = 2,
+                    backoff_s: float = 0.05):
+    """`run_batched_cached` under a GSPMD mesh: the SAME module-level
+    lane-fn, AOT-compiled with in/out shardings — scenario lanes split
+    across the "scenario" mesh axis, node-major snapshot fields across
+    the "node" axis — and cached under the single-device key EXTENDED by
+    the mesh axis split. Same-bucket mesh launches are zero recompiles
+    (`simon_compile_cache_total{fn=mesh_schedule}`), and because the
+    traced program is identical to the single-device path's, outputs are
+    digest-identical (the PR-7 multichip contract, now on the cached
+    executable).
+
+    Carry donation holds under the mesh: the donated state batch is
+    sharded like the lane axis (every leaf `P("scenario", ...)`), its
+    in_sharding equals the output state's out_sharding, so XLA aliases
+    the buffers shard-for-shard and resets them in place per the §9 x*0
+    contract — after the call the passed-in state is DEAD. `weights` is
+    the [S, K] traced lane matrix, sharded along the scenario axis like
+    the masks. Inputs are placed with `jax.device_put` against the
+    declared shardings up front (a no-op for already-placed donated
+    state / pre-sharded arrays), so callers may hand host arrays
+    straight in."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    masks = jnp.asarray(masks)
+    lanes = int(masks.shape[0])
+    weights = _check_lane_weights(cfg, weights, lanes)
+    if carry is None:
+        carry = _zeros_carry_batch(arrs, cfg, lanes)
+    # the single-device key + the mesh axis split and the mesh's own
+    # device set (a different split of the same chips is a different
+    # partitioned program; jax.devices() alone cannot see that)
+    axis_split = tuple((str(name), int(size))
+                       for name, size in mesh.shape.items())
+    key = (fn_name, cfg, _shape_sig(arrs), (lanes,) + tuple(masks.shape[1:]),
+           str(masks.dtype), waves,
+           None if weights is None else tuple(weights.shape),
+           axis_split, tuple(str(d) for d in mesh.devices.flat))
+    fn = batched_lane_fn(cfg, waves, weights is not None)
+
+    lane_sh = NamedSharding(mesh, P("scenario"))
+    arrs_sh = _mesh_input_shardings(arrs, mesh)
+    mask_sh = NamedSharding(mesh, P("scenario", None))
+    carry_sh = jax.tree_util.tree_map(lambda _: lane_sh, carry)
+    w_sh = NamedSharding(mesh, P("scenario", None))
+    # place every input against its declared sharding BEFORE lowering —
+    # a no-op for data already resident there (the donated state from
+    # the previous round), a resharding copy for host arrays and for
+    # arrays placed differently (e.g. parallel.sweep.shard_arrays'
+    # HBM-distribution layout); pjit rejects committed args whose
+    # sharding disagrees with in_shardings, so placement cannot be
+    # deferred to launch time
+    arrs = jax.device_put(arrs, arrs_sh)
+    masks = jax.device_put(masks, mask_sh)
+    carry = jax.device_put(carry, carry_sh)
+    if weights is not None:
+        weights = jax.device_put(weights, w_sh)
+    # every output follows the lane axis, the state included — matching
+    # the donated carry's in_sharding so donation aliases shard-for-shard
+    from open_simulator_tpu.engine.scheduler import ScheduleOutput
+
+    out_sh = ScheduleOutput(
+        node=lane_sh, fail_counts=lane_sh, feasible=lane_sh,
+        gpu_pick=lane_sh, vol_pick=lane_sh, topk_node=lane_sh,
+        topk_score=lane_sh, topk_parts=lane_sh, state=carry_sh)
+
+    def build():
+        if weights is None:
+            return jax.jit(
+                fn, donate_argnums=(2,),
+                in_shardings=(arrs_sh, mask_sh, carry_sh),
+                out_shardings=out_sh,
+            ).lower(arrs, masks, carry).compile()
+        return jax.jit(
+            fn, donate_argnums=(2,),
+            in_shardings=(arrs_sh, mask_sh, carry_sh, w_sh),
+            out_shardings=out_sh,
+        ).lower(arrs, masks, carry, weights).compile()
+
+    from open_simulator_tpu.resilience import faults
+
+    # donated carry backs the FIRST attempt only; re-attempts (transient
+    # retry, cache_drop rung) run from a fresh sharded zeros batch —
+    # value-identical, the executable resets the carry either way
+    holder = {"carry": carry}
+
+    def fire():
+        compiled = EXEC_CACHE.get_or_compile(key, fn_name, build)
+        c = holder.pop("carry", None)
+        if c is None:
+            # a re-attempt (the donated batch died with the failed
+            # launch): fresh sharded zeros, value-identical
+            c = jax.device_put(_zeros_carry_batch(arrs, cfg, lanes),
+                               carry_sh)
+        if weights is None:
+            out = compiled(arrs, masks, c)
+        else:
+            out = compiled(arrs, masks, c, weights)
+        # block INSIDE the fault domain (async dispatch would surface a
+        # real device fault at the caller's host read, unclassified)
+        return jax.block_until_ready(out)
+
+    # OOM rung: cache_drop evicts every cached executable — the mesh
+    # executables with everything else — recompiles, and re-launches once
+    # from a fresh sharded carry; bit-identical outputs, later. Anything
+    # non-OOM re-raises for the caller's mesh -> single_device ladder.
+    return faults.run_cached_launch(fn_name, fire, evict=EXEC_CACHE.clear,
+                                    retries=retries, backoff_s=backoff_s)
 
 
 def stack_fleet_arrays(arrs_list):

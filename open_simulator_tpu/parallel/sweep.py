@@ -31,12 +31,12 @@ from open_simulator_tpu.engine.exec_cache import (
     bucketed_device_arrays,
     enable_persistent_cache,
     run_batched_cached,
+    run_mesh_cached,
 )
 from open_simulator_tpu.engine.scheduler import (
     EngineConfig,
     ScheduleOutput,
     device_arrays,
-    schedule_pods,
 )
 
 _log = logging.getLogger(__name__)
@@ -164,63 +164,36 @@ def batched_schedule(
 
     `carry` is an optional DONATED state batch (a previous round's
     `out.state`, dead after this call) whose buffers back this run's
-    carry — only the AOT path supports it.
+    carry. Both paths support it: under a mesh the donated batch is
+    sharded like the lane axis and reset in place shard-for-shard (the
+    §9 x*0 contract, unchanged).
 
     `waves` is an optional static engine.waves.WavePlan for THIS arrs +
     cfg (lane activation does not enter the plan — footprints are
     computed activation-agnostic, so one plan serves every lane). Both
-    the AOT path (plan in the cache key) and the mesh-sharded path
-    (plan closed over the jitted lane fn) honor it.
+    paths carry the plan in the executable-cache key.
 
     `weights` is the per-lane [S, K] traced score-weight matrix under
-    ``cfg.traced_weights`` (the tune subsystem's policy-variant lanes;
-    AOT path only). A traced cfg with no explicit weights runs every
-    lane at the config's own vector — digest-identical to constant mode
-    — so the capacity sweeps accept traced configs unchanged.
+    ``cfg.traced_weights`` (the tune subsystem's policy-variant lanes),
+    sharded along the scenario axis under a mesh. A traced cfg with no
+    explicit weights runs every lane at the config's own vector —
+    digest-identical to constant mode — so the capacity sweeps accept
+    traced configs unchanged.
     """
     if mesh is None or mesh.empty:
         return run_batched_cached(arrs, active_batch, cfg, carry=carry,
                                   waves=waves, weights=weights,
                                   retries=retries, backoff_s=backoff_s)
-    if weights is not None:
-        raise ValueError(
-            "per-lane weights require mesh=None (the AOT path); a traced "
-            "cfg without explicit weights runs at its own vector")
-    if carry is not None:
-        raise ValueError("carry donation requires mesh=None (the AOT path)")
-    fn = jax.vmap(lambda a: schedule_pods(arrs, a, cfg, waves=waves))
-    lane = NamedSharding(mesh, P("scenario"))
-    fn = jax.jit(
-        fn,
-        in_shardings=(NamedSharding(mesh, P("scenario", None)),),
-        out_shardings=ScheduleOutput(
-            node=lane, fail_counts=lane, feasible=lane, gpu_pick=lane,
-            vol_pick=lane, topk_node=lane, topk_score=lane,
-            topk_parts=lane,
-            state=jax.tree_util.tree_map(lambda _: lane, _state_proto(arrs)),
-        ),
-    )
-    from open_simulator_tpu.resilience import faults
-
-    def fire():
-        placed = jax.device_put(
-            active_batch, NamedSharding(mesh, P("scenario", None)))
-        # block inside the fault domain: GSPMD dispatch is async, and a
-        # chip lost mid-execution must classify HERE (the host read in
-        # _execute_sweep is outside this wrapper)
-        return jax.block_until_ready(fn(placed))
-
-    # the mesh-sharded launch boundary of the device fault domain; a
-    # deterministic E_DEVICE_LOST here is what the single-device rung in
-    # _execute_sweep catches (a lost chip takes the whole mesh with it)
-    return faults.run_launch("mesh_schedule", fire, retries=retries,
-                             backoff_s=backoff_s)
-
-
-def _state_proto(arrs):
-    from open_simulator_tpu.engine.scheduler import init_state
-
-    return init_state(arrs)
+    # the mesh-sharded launch boundary of the device fault domain, now
+    # through the AOT executable cache (engine/exec_cache.py): the SAME
+    # module-level lane-fn the single-device path compiles, AOT-lowered
+    # with in/out shardings and cached under the key + mesh axis split —
+    # same-bucket mesh launches are zero recompiles, and a deterministic
+    # E_DEVICE_LOST still classifies here for the single-device rung in
+    # _execute_sweep (a lost chip takes the whole mesh with it)
+    return run_mesh_cached(arrs, active_batch, cfg, mesh, carry=carry,
+                           waves=waves, weights=weights,
+                           retries=retries, backoff_s=backoff_s)
 
 
 def shard_arrays(arrs, mesh: Mesh):
@@ -567,7 +540,7 @@ def capacity_bisect(
                 "best_count_so_far": sat[0] if sat else None,
                 "sweep_id": journal.sweep_id if journal else None}
 
-    carry_holder = {"carry": None}     # donated across rounds (mesh=None)
+    carry_holder = {"carry": None}     # donated across rounds (both paths)
 
     def probe(counts_round: List[int]) -> None:
         # counts already replayed from a checkpoint are never re-executed;
@@ -588,8 +561,8 @@ def capacity_bisect(
             nodes, _, headroom, vg_used, gpu, vol, errs, state = _execute_sweep(
                 arrs, masks, sweep_cfg, mesh, False, retries, backoff_s,
                 isolate_trials, n_pods=n_pods,
-                carry=carry_holder["carry"] if mesh is None else None,
-                return_state=mesh is None, waves=wave_plan)
+                carry=carry_holder["carry"],
+                return_state=True, waves=wave_plan)
         carry_holder["carry"] = state
         fresh: Dict[int, dict] = {}
         for i, c in enumerate(cs):

@@ -635,6 +635,29 @@ def run_io(fn: str, op: Callable[[], T], *, retries: int = 2,
                       max_backoff_s=max_backoff_s, jitter=jitter, rng=rng)
 
 
+def run_cached_launch(fn: str, launch: Callable[[], T], *,
+                      evict: Callable[[], None], retries: int = 2,
+                      backoff_s: float = 0.05) -> T:
+    """``run_launch`` with the cached-executable OOM rung, shared by
+    every AOT-cache-backed launch — the single-device batched path AND
+    the mesh-sharded path: a deterministic ``E_DEVICE_OOM`` means the
+    cache's resident executables (and the buffers they pin) are what
+    crowd the device, so the rung records ``cache_drop``, calls
+    ``evict`` (the executable cache's ``clear`` — mesh executables are
+    evicted with everything else), and re-launches ONCE from freshly
+    compiled code and fresh buffers. Outputs are bit-identical, just
+    later. Anything that is not a deterministic OOM re-raises for the
+    caller's own ladder (mesh -> single_device, lane isolation)."""
+    try:
+        return run_launch(fn, launch, retries=retries, backoff_s=backoff_s)
+    except DeviceFault as f:
+        if f.transient or f.code != E_DEVICE_OOM:
+            raise
+        record_rung(fn, "cache_drop", f.code)
+        evict()
+        return run_launch(fn, launch, retries=retries, backoff_s=backoff_s)
+
+
 def run_wave_launch(fn: str, launch_with_plan: Callable[[Any], T],
                     wave_plan: Any) -> Tuple[T, Any]:
     """``run_launch`` with the waves -> scan degradation rung, shared by

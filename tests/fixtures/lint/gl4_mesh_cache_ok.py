@@ -1,0 +1,86 @@
+"""GL4 fixture (clean): the SAFE pattern for mesh-sharded AOT cache
+bookkeeping (companion to gl4_execcache_ok.py, which covers the
+single-device cache).
+
+The mesh path of the executable cache (engine/exec_cache.py
+run_mesh_cached) adds two things on top of the single-device LRU, both
+of which must stay HOST control flow on HOST values:
+
+* the lane function is built ONCE at module level (lru_cache on static
+  config) — never a fresh `jit(vmap(lambda ...))` per call, the shape
+  GL6 rejects in gl6_regression_percall_vmap.py;
+* the cache key extends with the mesh AXIS SPLIT and device ids —
+  strings and ints read from mesh metadata BEFORE the jit boundary, so
+  the `if key in cache` branch never touches a traced value and a
+  different mesh split can never collide with a compiled executable for
+  another split.
+
+Sharding objects (NamedSharding/PartitionSpec) are host metadata too:
+constructing them and passing them to in_shardings/out_shardings is not
+device work. This file must produce ZERO findings; the traced body
+stays pure jnp.
+"""
+
+import functools
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from open_simulator_tpu.telemetry import counter
+
+_CACHE = OrderedDict()
+_CAPACITY = 2
+
+
+@functools.lru_cache(maxsize=8)
+def _lane_fn(scale):
+    # built once per static config (scale is hashable host data): the
+    # SAME traced program backs every mesh split, so digests agree
+    def lane(xs, mask):
+        # traced scope: pure jnp math — no cache reads, no metrics, no
+        # host branches on traced values
+        return jnp.sum(xs * mask) * scale
+
+    return jax.vmap(lane, in_axes=(None, 0))
+
+
+def run_mesh_cached(values, masks, mesh, scale=2.0):
+    xs = jnp.asarray(values)
+    ms = jnp.asarray(masks)
+    # axis split + device ids are HOST metadata on the mesh object —
+    # reading them is not a device sync, and keying on them keeps one
+    # compiled executable per mesh shape
+    axis_split = tuple((str(n), int(s)) for n, s in mesh.shape.items())
+    devices = tuple(str(d) for d in mesh.devices.flat)
+    key = (tuple(xs.shape), tuple(ms.shape), str(xs.dtype), float(scale),
+           axis_split, devices)
+    compiled = _CACHE.get(key)
+    if compiled is None:  # host branch on a host value: safe
+        counter("fixture_mesh_cache_total",
+                labelnames=("event",)).labels(event="miss").inc()
+        # sharding specs are host-side metadata; the lane axis shards
+        # over "scenario", the payload replicates
+        lane_sh = NamedSharding(mesh, P("scenario"))
+        repl_sh = NamedSharding(mesh, P())
+        xs = jax.device_put(xs, repl_sh)
+        ms = jax.device_put(ms, lane_sh)
+        compiled = jax.jit(
+            _lane_fn(scale),
+            in_shardings=(repl_sh, lane_sh),
+            out_shardings=lane_sh,
+        ).lower(xs, ms).compile()
+        _CACHE[key] = compiled
+        while len(_CACHE) > _CAPACITY:
+            _CACHE.popitem(last=False)
+            counter("fixture_mesh_cache_total",
+                    labelnames=("event",)).labels(event="eviction").inc()
+    else:
+        counter("fixture_mesh_cache_total",
+                labelnames=("event",)).labels(event="hit").inc()
+        _CACHE.move_to_end(key)
+    out = compiled(xs, ms)
+    return np.asarray(out)  # device -> host OUTSIDE the jit

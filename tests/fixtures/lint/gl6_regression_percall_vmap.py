@@ -1,0 +1,24 @@
+"""GL6 regression fixture: the pre-ISSUE-19 mesh-path compile leak.
+
+`batched_schedule`'s mesh branch used to build a FRESH
+`jit(vmap(lambda ...))` closure on every call and invoke it
+immediately — so every bisect round recompiled the whole lane program
+(seconds of XLA work per probe) and none of it ran inside the fault
+domain. The immediate invoke of a jit result must flag GL6; the
+sanctioned shape (module-level lane fn through the AOT cache, launched
+via faults.run_cached_launch) lives in gl4_mesh_cache_ok.py.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _lane(arrs, mask, scale):
+    return jnp.sum(arrs * mask) * scale
+
+
+def sweep_round(arrs, masks, scale):
+    # the leak: a fresh closure per call defeats jit's weak-ref cache,
+    # and the immediate invoke dispatches outside the fault domain
+    out = jax.jit(jax.vmap(lambda m: _lane(arrs, m, scale)))(masks)
+    return out

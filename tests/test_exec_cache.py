@@ -216,6 +216,53 @@ def test_batched_exec_cache_reuse_and_donation():
         np.asarray(out1.state.headroom)
 
 
+def test_mesh_exec_cache_reuse_and_donation():
+    """ISSUE 19: two same-bucket MESH launches compile exactly once
+    (`simon_compile_cache_total{fn=mesh_schedule}` miss delta == 1), the
+    donated-carry round is bit-identical to a fresh round (the §9 x*0
+    reset, now sharded), and [S, K] traced weight lanes run under the
+    mesh — digest-identical to constant mode."""
+    import jax
+
+    from open_simulator_tpu.engine.exec_cache import run_mesh_cached
+    from open_simulator_tpu.engine.scheduler import weight_vector
+    from open_simulator_tpu.parallel.sweep import make_mesh
+
+    assert len(jax.devices()) >= 2  # conftest forces 8 virtual devices
+    mesh = make_mesh(n_scenario=2, n_node=1, devices=jax.devices()[:2])
+    snap = _snapshot(n_pods=8, max_new=3)
+    cfg = make_config(snap)
+    arrs, _, _ = exec_cache.bucketed_device_arrays(snap.arrays)
+    lane_masks = np.zeros((2, arrs.alloc.shape[0]), dtype=bool)
+    lane_masks[:, :snap.n_nodes] = active_masks_for_counts(snap, [0, 3])
+
+    miss = lambda: _counter("simon_compile_cache_total",  # noqa: E731
+                            fn="mesh_schedule", event="miss")
+    m0 = miss()
+    out1 = run_mesh_cached(arrs, lane_masks, cfg, mesh)
+    assert miss() - m0 == 1
+    nodes1 = np.asarray(out1.node)
+    # same bucket -> pure cache hit, zero recompiles
+    out2 = run_mesh_cached(arrs, lane_masks, cfg, mesh)
+    assert miss() - m0 == 1
+    np.testing.assert_array_equal(np.asarray(out2.node), nodes1)
+    # round 3 donates round 2's sharded state; identical results, still
+    # the one executable
+    out3 = run_mesh_cached(arrs, lane_masks, cfg, mesh, carry=out2.state)
+    assert miss() - m0 == 1
+    np.testing.assert_array_equal(np.asarray(out3.node), nodes1)
+    # the donated carry is dead — reading it must fail loudly
+    with pytest.raises(Exception, match="deleted|donated"):
+        np.asarray(out2.state.headroom)
+
+    # [S, K] traced weight lanes under the mesh: every lane at the
+    # config's own vector must reproduce the constant-mode digest
+    cfg_t = cfg._replace(traced_weights=True)
+    w = np.tile(weight_vector(cfg_t), (2, 1))
+    out_w = run_mesh_cached(arrs, lane_masks, cfg_t, mesh, weights=w)
+    np.testing.assert_array_equal(np.asarray(out_w.node), nodes1)
+
+
 def test_persistent_cache_writes_executables(tmp_path):
     """--compile-cache-dir must actually persist compiles: jax freezes its
     on-disk cache as "disabled" on the first (import-time) compile, so

@@ -390,6 +390,75 @@ def test_mesh_single_device_rung_digest_identical():
                           rung="single_device") == b + 1
 
 
+def test_mesh_cache_drop_rung_digest_identical():
+    """ISSUE 19: E_DEVICE_OOM on a CACHED mesh launch walks cache_drop —
+    the mesh executables are evicted with everything else, the program
+    recompiles (exactly one new `mesh_schedule` cache miss), and the
+    re-launch runs from a FRESH sharded carry (the donated one died with
+    the failed attempt) — outputs digest-identical, just later."""
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.engine.scheduler import device_arrays, make_config
+    from open_simulator_tpu.parallel import sweep as sweep_mod
+    from open_simulator_tpu.testing.synthetic import synthetic_snapshot
+
+    snap = synthetic_snapshot(n_nodes=4, n_pods=8, max_new=2)
+    cfg = make_config(snap)._replace(fail_reasons=False)
+    mesh = sweep_mod.make_mesh(n_scenario=2, n_node=1)
+    arrs = device_arrays(snap)
+    masks = jnp.asarray(sweep_mod.active_masks_for_counts(snap, [0, 2]))
+
+    healthy = sweep_mod.batched_schedule(arrs, masks, cfg, mesh=mesh,
+                                         backoff_s=0.0)
+    d_healthy = ledger.array_result_digest(np.asarray(healthy.node))["digest"]
+
+    def miss():
+        return telemetry.counter("simon_compile_cache_total",
+                                 labelnames=("fn", "event")).value(
+                                     fn="mesh_schedule", event="miss")
+
+    b = _rungs().value(fn="mesh_schedule", rung="cache_drop")
+    m0 = miss()
+    with faults.injected("fn=mesh_schedule,exc=oom,times=1"):
+        # the donated carry backs the attempt that OOMs; the rung's
+        # re-launch must rebuild a fresh sharded zeros batch
+        degraded = sweep_mod.batched_schedule(arrs, masks, cfg, mesh=mesh,
+                                              carry=healthy.state,
+                                              backoff_s=0.0)
+    d_degraded = ledger.array_result_digest(np.asarray(degraded.node))["digest"]
+    assert d_degraded == d_healthy
+    assert _rungs().value(fn="mesh_schedule", rung="cache_drop") == b + 1
+    # the warm hit OOM'd, the cache was dropped, and the re-launch
+    # recompiled: exactly one fresh miss
+    assert miss() - m0 == 1
+
+
+def test_mesh_lost_chip_bisect_donated_carry_digest_identical():
+    """E_DEVICE_LOST on every round of a donated-carry mesh bisect: each
+    round walks mesh -> single_device and the final plan is still
+    ledger-digest-identical to a plain single-device bisect (the
+    multichip contract holds through the fallback's carry handoff)."""
+    from open_simulator_tpu.engine.scheduler import make_config
+    from open_simulator_tpu.parallel import sweep as sweep_mod
+    from open_simulator_tpu.testing.synthetic import synthetic_snapshot
+
+    snap = synthetic_snapshot(n_nodes=4, n_pods=8, max_new=2)
+    cfg = make_config(snap)
+    mesh = sweep_mod.make_mesh(n_scenario=3, n_node=1)
+    healthy = sweep_mod.capacity_bisect(snap, cfg, max_new=2, lanes=3,
+                                        backoff_s=0.0)
+    b = _rungs().value(fn="mesh_schedule", rung="single_device")
+    with faults.injected("fn=mesh_schedule,exc=device_lost,times=99"):
+        degraded = sweep_mod.capacity_bisect(snap, cfg, max_new=2, mesh=mesh,
+                                             lanes=3, backoff_s=0.0)
+    assert not degraded.trial_errors
+    assert degraded.best_count == healthy.best_count
+    assert (ledger.plan_digest(degraded)["digest"]
+            == ledger.plan_digest(healthy)["digest"])
+    assert _rungs().value(fn="mesh_schedule",
+                          rung="single_device") >= b + 1
+
+
 def _pools_cluster(n_nodes=8, n_pods=24, pools=4):
     """A multi-tenant cluster whose disjoint pool footprints give
     simulate() a real wave plan (the waves -> scan rung needs one)."""

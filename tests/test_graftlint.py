@@ -139,6 +139,15 @@ def test_gl4_execcache_safe_pattern_is_clean():
     assert lint_fixture("gl4_execcache_ok.py") == []
 
 
+def test_gl4_mesh_cache_safe_pattern_is_clean():
+    """Mesh-path cache bookkeeping — a module-level lru_cache'd lane fn,
+    the cache key extended with the mesh axis split + device ids (host
+    metadata), sharding specs built host-side around the AOT
+    lower().compile() — the pattern engine/exec_cache.py run_mesh_cached
+    follows, must not trip GL4 (or any rule)."""
+    assert lint_fixture("gl4_mesh_cache_ok.py") == []
+
+
 def test_gl4_waves_safe_pattern_is_clean():
     """The host-side wave partitioner next to jit scope — numpy conflict
     analysis BEFORE the trace, the plan entering jit only as static
@@ -283,6 +292,19 @@ def test_gl6_regression_unwrapped_sync_fails():
     assert invoke.line == line_of("gl6_regression_unwrapped.py",
                                   "out = fn(xs)")
     assert "run_launch" in sync.hint
+
+
+def test_gl6_regression_percall_vmap_immediate_invoke_fails():
+    """The pre-ISSUE-19 mesh-branch shape: a fresh jit(vmap(lambda ...))
+    built and INVOKED per call — a full recompile per bisect round,
+    dispatched outside the fault domain — must flag GL6 at the invoke
+    line; the sanctioned mesh-cache shape is gl4_mesh_cache_ok.py."""
+    fs = lint_fixture("gl6_regression_percall_vmap.py")
+    assert {f.code for f in fs} == {"GL6"}
+    invoke = by_symbol(fs, "jit(...)(...) immediate invoke")[0]
+    assert invoke.line == line_of("gl6_regression_percall_vmap.py",
+                                  "jax.jit(jax.vmap(lambda m:")
+    assert "run_launch" in invoke.hint
 
 
 # ---- GL7: lock-order safety ---------------------------------------------
