@@ -4,7 +4,7 @@
 
 .PHONY: test lint smoke bench bench-smoke bench-regress lifecycle-smoke \
 	multichip-smoke campaign-smoke replay-smoke session-smoke serve-smoke \
-	tune-smoke fault-smoke journal-smoke trace-smoke
+	tune-smoke fault-smoke journal-smoke trace-smoke live-smoke
 
 test:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -116,6 +116,15 @@ journal-smoke:
 # traced load still exits 0
 trace-smoke:
 	env JAX_PLATFORMS=cpu python tools/trace_smoke.py
+
+# live-operations gate (telemetry/live.py): a real server must stream
+# the black box over GET /api/events (an SSE follower sees the same
+# causal sequence /api/trace/<id> reconstructs), drop a stalled
+# subscriber's events without blocking any worker, expose the per-owner
+# device-memory ledger on /debug/stats + /metrics, render one
+# `simon-tpu top --once` frame, and end live streams cleanly on SIGTERM
+live-smoke:
+	env JAX_PLATFORMS=cpu python tools/live_smoke.py
 
 # regression gate over the run ledger (SIMON_LEDGER_DIR or
 # BENCH_LEDGER_DIR=... make bench-regress): the newest bench record per

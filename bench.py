@@ -67,6 +67,17 @@ def exec_costs() -> dict:
     return out
 
 
+def devmem_peak() -> int:
+    """High-watermark of devmem-ledger-registered device bytes so far in
+    this process (telemetry/live.py) — rides every bench JSON line and
+    tagged RunRecord so the bench trajectory records memory alongside
+    scenarios/sec (ROADMAP item 1's remaining-HBM-lever work reads this
+    series)."""
+    from open_simulator_tpu.telemetry import live
+
+    return int(live.DEVMEM.peak_total())
+
+
 def run_batched(snapshot, n_scenarios: int, fail_reasons: bool = False,
                 shape: str = "", preset: str = ""):
     """Time the capacity-sweep product path: what-if lanes run with
@@ -136,6 +147,7 @@ def run_batched(snapshot, n_scenarios: int, fail_reasons: bool = False,
         # higher-is-better throughput: the number bench_regress.py compares
         # against the trailing median of this shape's prior records
         lcap.tag("value", round(snapshot.n_pods * n_scenarios / best, 3))
+        lcap.tag("devmem_peak_bytes", devmem_peak())
     return best, wave_stats
 
 
@@ -247,6 +259,7 @@ def run_mesh_bench(snapshot, n_scenarios: int, mesh_scenario=None,
         lcap.tag("value", round(snapshot.n_pods * n_scenarios / best, 3))
         lcap.tag("scenarios_per_sec_per_chip",
                  round(n_scenarios / best / n_chips, 3))
+        lcap.tag("devmem_peak_bytes", devmem_peak())
     return dict(best=best, wave_stats=wave_stats,
                 digest=ref_digest["digest"], devices=n_chips, mesh=split,
                 label=label, miss_delta=miss_delta)
@@ -404,6 +417,7 @@ def run_campaign_bench(n_clusters: int, nodes: int, pods: int):
             lcap.tag("value", round(n_clusters / dt, 3))
             lcap.tag("quarantined", report["totals"]["quarantined"])
             lcap.tag("report_digest", report["digest"])
+            lcap.tag("devmem_peak_bytes", devmem_peak())
         return dt, report, label
     finally:
         shutil.rmtree(root, ignore_errors=True)
@@ -452,6 +466,7 @@ def run_replay_bench(n_nodes: int, n_batches: int, batch_pods: int):
         lcap.tag("value", round(steps / dt, 3))
         lcap.tag("events_per_sec", round(events / dt, 3))
         lcap.tag("report_digest", report["digest"])
+        lcap.tag("devmem_peak_bytes", devmem_peak())
     return dt, report, label
 
 
@@ -502,6 +517,7 @@ def run_session_bench(n_sessions: int, n_nodes: int, n_batches: int,
         lcap.tag("value", round(n_events / dt, 3))
         lcap.tag("reuse_ratio", len(td["events"]))
         lcap.tag("trajectory_digest", sessions[0].digest)
+        lcap.tag("devmem_peak_bytes", devmem_peak())
     assert all(s.digest == sessions[0].digest for s in sessions), (
         "identical sessions fed identical events diverged")
     return dt, n_events, sessions[0].digest, label
@@ -540,6 +556,7 @@ def run_tune_bench(n_nodes: int, n_pods: int, variants: int, rounds: int):
         lcap.tag("value", round(n_variants / dt, 3))
         lcap.tag("pareto", len(report["pareto"]))
         lcap.tag("tune_digest", report["digest"])
+        lcap.tag("devmem_peak_bytes", devmem_peak())
     return dt, report, label
 
 
@@ -621,6 +638,7 @@ def run_serve_bench(n_nodes: int, n_requests: int, n_clients: int):
             lcap.tag("launches", n_launches)
             lcap.tag("reuse_ratio", n_probes)
             lcap.tag("placement_digest", admitted["digest"])
+            lcap.tag("devmem_peak_bytes", devmem_peak())
         assert len(results) == n_probes, (len(results), n_probes)
         assert all(r["digest"] == admitted["digest"] for r in results), (
             "a coalesced probe diverged from the admitting run's digest")
@@ -696,6 +714,7 @@ def main():
             "completed": report["totals"]["completed"],
             "report_digest": report["digest"],
             "exec_costs": exec_costs(),
+            "devmem_peak_bytes": devmem_peak(),
         }))
         return
     if args.preset == "replay":
@@ -719,6 +738,7 @@ def main():
             "pending_final": report["totals"]["pending"],
             "report_digest": report["digest"],
             "exec_costs": exec_costs(),
+            "devmem_peak_bytes": devmem_peak(),
         }))
         return
     if args.preset == "session":
@@ -741,6 +761,7 @@ def main():
             "reuse_ratio": n_events // preset["sessions"],
             "trajectory_digest": digest,
             "exec_costs": exec_costs(),
+            "devmem_peak_bytes": devmem_peak(),
         }))
         return
     if args.preset == "tune":
@@ -763,6 +784,7 @@ def main():
             "pareto_points": len(report["pareto"]),
             "tune_digest": report["digest"],
             "exec_costs": exec_costs(),
+            "devmem_peak_bytes": devmem_peak(),
         }))
         return
     if args.preset == "serve":
@@ -785,6 +807,7 @@ def main():
             "reuse_ratio": n_probes,
             "placement_digest": digest,
             "exec_costs": exec_costs(),
+            "devmem_peak_bytes": devmem_peak(),
         }))
         return
     for k in ("nodes", "pods", "scenarios", "max_new"):
@@ -820,6 +843,7 @@ def main():
             "max_wave_width": res["wave_stats"]["max_wave_width"],
             "wave_fraction": res["wave_stats"]["wave_fraction"],
             "exec_costs": exec_costs(),
+            "devmem_peak_bytes": devmem_peak(),
         }))
         return
 
@@ -921,6 +945,7 @@ def main():
             pl["scenarios"] / pl_dt, 2)
         out["pools_wave_stats"] = pl_stats
     out["exec_costs"] = exec_costs()
+    out["devmem_peak_bytes"] = devmem_peak()
     print(json.dumps(out))
 
 

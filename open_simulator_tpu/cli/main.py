@@ -188,6 +188,33 @@ def build_parser() -> argparse.ArgumentParser:
              "rung and retry schedule is reproducibly testable (also "
              "honors SIMON_FAULT_PLAN; a malformed plan is a startup "
              "error here, not a per-request surprise)")
+    sp.add_argument(
+        "--blackbox-events", default="", metavar="N",
+        help="black-box flight-recorder ring capacity (events): the "
+             "bounded ring behind GET /api/trace/<id> and GET "
+             "/api/events drops its OLDEST events past this (default "
+             "4096; also honors SIMON_BLACKBOX_EVENTS; a malformed "
+             "size is a startup error, not a lost incident)")
+
+    tp = sub.add_parser(
+        "top",
+        help="live terminal view of a running server",
+        description="Redraw-in-place operations view over GET "
+                    "/debug/stats and GET /metrics: admission-queue "
+                    "depth and wait, in-flight launches with trace ids, "
+                    "device-memory owners with high-watermarks "
+                    "(simon_devmem_bytes), resident snapshots/sessions, "
+                    "per-launch latency percentiles "
+                    "(simon_launch_seconds), and event-feed fan-out "
+                    "state. No curses — plain ANSI clear-and-redraw, "
+                    "safe over ssh; --once prints a single frame "
+                    "(snapshot mode, scripts/smoke)")
+    tp.add_argument("--server", default="http://127.0.0.1:8899",
+                    help="base URL of the running simon-tpu server")
+    tp.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between redraws")
+    tp.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no redraw loop)")
 
     ch = sub.add_parser(
         "chaos",
@@ -1046,6 +1073,177 @@ def _trace_main(args) -> int:
     return 0
 
 
+def _fmt_bytes(n) -> str:
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return (f"{n:.0f}{unit}" if unit == "B"
+                    else f"{n:.1f}{unit}")
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+def _parse_buckets(metrics_text: str, name: str) -> dict:
+    """{fn: sorted [(le_bound, cumulative_count), ...]} parsed from the
+    Prometheus exposition — `top` computes launch percentiles
+    client-side from the histogram buckets (the server only exports
+    count/sum directly)."""
+    import re as _re
+
+    pat = _re.compile(r"^" + _re.escape(name)
+                      + r"_bucket\{(.*)\}\s+([0-9.eE+-]+|inf)\s*$")
+    out: dict = {}
+    for ln in metrics_text.splitlines():
+        m = pat.match(ln)
+        if not m:
+            continue
+        labels = dict(_re.findall(r'([A-Za-z_][A-Za-z0-9_]*)="([^"]*)"',
+                                  m.group(1)))
+        le = labels.pop("le", None)
+        if le is None:
+            continue
+        fn = labels.get("fn", "")
+        bound = float("inf") if le in ("+Inf", "inf") else float(le)
+        out.setdefault(fn, []).append((bound, float(m.group(2))))
+    for fn in out:
+        out[fn].sort()
+    return out
+
+
+def _bucket_quantile(buckets, q: float):
+    """Linear-interpolated quantile from cumulative histogram buckets
+    (the standard Prometheus histogram_quantile estimate). None when
+    the histogram is empty."""
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    target = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, cum in buckets:
+        if cum >= target:
+            if bound == float("inf"):
+                return prev_bound  # the conventional +Inf clamp
+            width = bound - prev_bound
+            inside = cum - prev_cum
+            if inside <= 0:
+                return bound
+            return prev_bound + width * (target - prev_cum) / inside
+        prev_bound, prev_cum = bound, cum
+    return prev_bound
+
+
+def _render_top_frame(base: str, stats: dict, metrics_text: str) -> str:
+    """One `simon-tpu top` frame as a string (testable without a tty)."""
+    lines = []
+    lines.append(
+        f"simon-tpu top — {base}   uptime {stats.get('uptime_s', '?')}s   "
+        f"requests {stats.get('requests', '?')}  "
+        f"simulations {stats.get('simulations', '?')}  "
+        f"errors {stats.get('errors', '?')}  "
+        f"rss {stats.get('max_rss_mib', '?')}MiB")
+    queue = stats.get("queue") or {}
+    lines.append("queue     " + (" ".join(
+        f"{k}={v}" for k, v in sorted(queue.items())) or "-"))
+    feed = stats.get("events_feed") or {}
+    bb = stats.get("blackbox") or {}
+    lines.append(
+        f"feed      subscribers={feed.get('subscribers', 0)} "
+        f"published={feed.get('published', 0)} "
+        f"dropped={feed.get('dropped', 0)}   "
+        f"blackbox {bb.get('events', 0)}/{bb.get('capacity', 0)} "
+        f"(dropped={bb.get('dropped', 0)})")
+    devmem = stats.get("devmem") or {}
+    owners = devmem.get("owners") or {}
+    peaks = devmem.get("peaks") or {}
+    lines.append("")
+    lines.append(f"{'devmem owner':<22}{'bytes':>12}{'peak':>12}")
+    for owner in sorted(set(owners) | set(peaks)):
+        lines.append(f"  {owner:<20}{_fmt_bytes(owners.get(owner, 0)):>12}"
+                     f"{_fmt_bytes(peaks.get(owner, 0)):>12}")
+    lines.append(f"  {'TOTAL':<20}{_fmt_bytes(devmem.get('total', 0)):>12}"
+                 f"{_fmt_bytes(devmem.get('peak_total', 0)):>12}")
+    resident = stats.get("resident_snapshots") or {}
+    lines.append(
+        f"resident  snapshots={resident.get('resident', 0)}"
+        f"/{resident.get('entries', 0)} "
+        f"bytes={_fmt_bytes(resident.get('resident_bytes', 0))} "
+        f"budget={_fmt_bytes(resident.get('max_resident_bytes', 0))}")
+    inflight = devmem.get("inflight") or []
+    lines.append("")
+    if inflight:
+        lines.append("in-flight launches:")
+        for row in inflight:
+            lines.append(f"  {row.get('fn', '?'):<20} "
+                         f"trace={row.get('trace') or '-':<18} "
+                         f"age={row.get('age_ms', 0):.0f}ms")
+    else:
+        lines.append("in-flight launches: none")
+    launches = stats.get("launches") or {}
+    buckets = _parse_buckets(metrics_text, "simon_launch_seconds")
+    lines.append("")
+    lines.append(f"{'launch fn':<22}{'count':>8}{'mean':>10}"
+                 f"{'p50':>10}{'p90':>10}{'p99':>10}")
+    for fn in sorted(set(launches) | set(buckets)):
+        row = launches.get(fn) or {}
+        bk = buckets.get(fn) or []
+
+        def pct(q):
+            v = _bucket_quantile(bk, q)
+            return f"{v * 1000.0:.1f}ms" if v is not None else "-"
+
+        lines.append(f"  {fn:<20}{row.get('count', 0):>8}"
+                     f"{row.get('mean_ms', 0):>8.1f}ms"
+                     f"{pct(0.5):>10}{pct(0.9):>10}{pct(0.99):>10}")
+    if not launches and not buckets:
+        lines.append("  (no launches yet)")
+    return "\n".join(lines)
+
+
+def _top_main(args) -> int:
+    """simon-tpu top: live redraw-in-place operations view (no curses —
+    plain ANSI clear+home per frame, one plain frame with --once)."""
+    import json as _json
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    base = args.server.rstrip("/")
+
+    def fetch():
+        with urllib.request.urlopen(
+                urllib.request.Request(base + "/debug/stats", method="GET"),
+                timeout=30) as r:
+            stats = _json.loads(r.read())
+        with urllib.request.urlopen(
+                urllib.request.Request(base + "/metrics", method="GET"),
+                timeout=30) as r:
+            metrics_text = r.read().decode("utf-8", "replace")
+        return stats, metrics_text
+
+    try:
+        while True:
+            try:
+                stats, metrics_text = fetch()
+            except (OSError, urllib.error.URLError) as e:
+                print(f"error: cannot reach {base}: {e}", file=sys.stderr)
+                return 1
+            frame = _render_top_frame(base, stats, metrics_text)
+            if args.once:
+                print(frame)
+                return 0
+            # ANSI clear + cursor home: redraw in place without curses
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            _time.sleep(max(0.2, float(args.interval)))
+    except KeyboardInterrupt:
+        return 0
+
+
 def main(argv=None) -> int:
     _init_logging()
     parser = build_parser()
@@ -1266,6 +1464,19 @@ def main(argv=None) -> int:
             except SimulationError as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 2
+        blackbox_events = None
+        if args.blackbox_events:
+            # same eager-validation contract as --fault-plan: a typo'd
+            # ring size is a structured startup error, not a ring that
+            # silently stayed at the default through an incident
+            from open_simulator_tpu.telemetry import context
+
+            try:
+                blackbox_events = context.configure_ring(
+                    args.blackbox_events)
+            except SimulationError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
         return serve(
             address=args.address,
             port=args.port,
@@ -1281,7 +1492,11 @@ def main(argv=None) -> int:
             max_sessions=args.max_sessions,
             max_resident_bytes=int(args.max_resident_mib) * 1024 * 1024,
             workers=args.workers,
+            blackbox_events=blackbox_events,
         )
+
+    if args.command == "top":
+        return _top_main(args)
 
     if args.command == "gen-doc":
         from open_simulator_tpu.cli.gendoc import (

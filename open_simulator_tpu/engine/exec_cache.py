@@ -283,6 +283,21 @@ def harvest_cost(compiled) -> Dict[str, Any]:
     return out
 
 
+def _carry_nbytes(carry) -> int:
+    """Summed device bytes of a carry batch's leaves — what the devmem
+    ledger accounts for a donated carry while a launch owns it."""
+    import jax
+
+    return sum(int(getattr(leaf, "nbytes", 0) or 0)
+               for leaf in jax.tree_util.tree_leaves(carry))
+
+
+def _key_digest(key: Tuple) -> str:
+    """Stable short digest of a cache key — the devmem ledger's and
+    /debug/executables' holder identity for an executable."""
+    return hashlib.sha256(repr(key).encode()).hexdigest()[:12]
+
+
 def _shape_sig(arrs) -> Tuple:
     out = []
     for f in dataclasses.fields(arrs):
@@ -358,6 +373,9 @@ class ExecutableCache:
                         compile_ms=round(compile_s * 1000.0, 3),
                         flops=cost.get("flops"),
                         peak_hbm_bytes=cost.get("peak_hbm_bytes"))
+        from open_simulator_tpu.telemetry import live
+
+        evicted: List[Tuple] = []
         with self._lock:
             self._entries[key] = compiled
             self._entries.move_to_end(key)
@@ -366,6 +384,15 @@ class ExecutableCache:
                 k, _ = self._entries.popitem(last=False)
                 self._costs.pop(k, None)
                 self._count(fn_name, "eviction")
+                evicted.append(k)
+        # devmem ledger: an AOT executable holds its generated code on
+        # device — registered by cache-key digest, released on eviction
+        code_bytes = int((cost.get("memory") or {})
+                         .get("generated_code_size_in_bytes") or 0)
+        live.DEVMEM.register(live.OWNER_EXECUTABLES,
+                             _key_digest(key), code_bytes)
+        for k in evicted:
+            live.DEVMEM.release(live.OWNER_EXECUTABLES, _key_digest(k))
         return compiled
 
     def _install_hooks(self) -> None:
@@ -399,6 +426,17 @@ class ExecutableCache:
                   sample("peak_hbm_bytes"))
         ledger.set_cost_provider(self.cost_snapshot)
 
+        # the devmem ledger's in-flight estimator: a launch of fn is
+        # assumed to touch its newest executable's peak-HBM estimate
+        # (registered as a hook — telemetry must not import the engine)
+        from open_simulator_tpu.telemetry import live
+
+        def estimate(fn: str):
+            v = (self.cost_snapshot().get(fn) or {}).get("peak_hbm_bytes")
+            return float(v) if isinstance(v, (int, float)) else None
+
+        live.set_inflight_estimator(estimate)
+
     def cost_snapshot(self) -> Dict[str, Dict[str, Any]]:
         """Per-fn cost summary ({fn: {flops, bytes_accessed,
         peak_hbm_bytes, compile_s, entries}}; the newest entry's profile
@@ -428,7 +466,7 @@ class ExecutableCache:
             fn = cost.pop("fn", key[0] if key else "?")
             rows.append({
                 "fn": fn,
-                "key": hashlib.sha256(repr(key).encode()).hexdigest()[:12],
+                "key": _key_digest(key),
                 "cost": cost,
             })
         return rows
@@ -437,6 +475,9 @@ class ExecutableCache:
         with self._lock:
             self._entries.clear()
             self._costs.clear()
+        from open_simulator_tpu.telemetry import live
+
+        live.DEVMEM.release_owner(live.OWNER_EXECUTABLES)
 
     def __len__(self) -> int:
         with self._lock:
@@ -608,8 +649,16 @@ def run_batched_cached(arrs, masks, cfg, carry=None,
     # OOM rung: run_cached_launch evicts every cached executable (their
     # buffers and scratch are what crowd the device) and re-compiles +
     # re-launches once from fresh buffers — bit-identical outputs, later
-    return faults.run_cached_launch(fn_name, fire, evict=EXEC_CACHE.clear,
-                                    retries=retries, backoff_s=backoff_s)
+    from open_simulator_tpu.telemetry import live
+
+    carry_key = f"{fn_name}:{id(holder):x}"
+    live.DEVMEM.register(live.OWNER_CARRIES, carry_key, _carry_nbytes(carry))
+    try:
+        return faults.run_cached_launch(fn_name, fire,
+                                        evict=EXEC_CACHE.clear,
+                                        retries=retries, backoff_s=backoff_s)
+    finally:
+        live.DEVMEM.release(live.OWNER_CARRIES, carry_key)
 
 
 def _mesh_input_shardings(arrs, mesh):
@@ -754,8 +803,16 @@ def run_mesh_cached(arrs, masks, cfg, mesh, carry=None,
     # executables with everything else — recompiles, and re-launches once
     # from a fresh sharded carry; bit-identical outputs, later. Anything
     # non-OOM re-raises for the caller's mesh -> single_device ladder.
-    return faults.run_cached_launch(fn_name, fire, evict=EXEC_CACHE.clear,
-                                    retries=retries, backoff_s=backoff_s)
+    from open_simulator_tpu.telemetry import live
+
+    carry_key = f"{fn_name}:{id(holder):x}"
+    live.DEVMEM.register(live.OWNER_CARRIES, carry_key, _carry_nbytes(carry))
+    try:
+        return faults.run_cached_launch(fn_name, fire,
+                                        evict=EXEC_CACHE.clear,
+                                        retries=retries, backoff_s=backoff_s)
+    finally:
+        live.DEVMEM.release(live.OWNER_CARRIES, carry_key)
 
 
 def stack_fleet_arrays(arrs_list):
@@ -834,7 +891,14 @@ def run_fleet_batched(arrs_batch, masks, cfg,
         # real fault at the caller's host read, unclassified)
         return jax.block_until_ready(compiled(arrs_batch, masks, c))
 
-    return faults.run_launch(fn_name, fire)
+    from open_simulator_tpu.telemetry import live
+
+    carry_key = f"{fn_name}:{id(holder):x}"
+    live.DEVMEM.register(live.OWNER_CARRIES, carry_key, _carry_nbytes(carry))
+    try:
+        return faults.run_launch(fn_name, fire)
+    finally:
+        live.DEVMEM.release(live.OWNER_CARRIES, carry_key)
 
 
 # ---- persistent compilation cache --------------------------------------

@@ -525,6 +525,7 @@ class ReplaySession:
             # defining step semantics — the replay-resume argument)
         self._prog = prog
         self._world = world
+        self._register_devmem()
         if self._controllers is None:
             ctrls = [controller_from_dict(c)
                      for c in self._controller_specs]
@@ -533,6 +534,29 @@ class ReplaySession:
                 for c in ctrls:
                     c.load_state(states.get(c.name) or {})
             self._controllers = ctrls
+
+    def _register_devmem(self) -> None:
+        """Account this session's device-resident bytes (the program's
+        padded master snapshot on device) in the devmem ledger — keyed
+        by session id, so a universe-growing rebuild replaces rather
+        than double-counts."""
+        from open_simulator_tpu.telemetry import live
+
+        nbytes = 0
+        try:
+            import jax
+
+            nbytes = sum(
+                int(getattr(leaf, "nbytes", 0) or 0)
+                for leaf in jax.tree_util.tree_leaves(self._prog.dev_master))
+        except Exception:  # noqa: BLE001 — an estimate, never a failure
+            pass
+        live.DEVMEM.register(live.OWNER_SESSIONS, self.session_id, nbytes)
+
+    def _release_devmem(self) -> None:
+        from open_simulator_tpu.telemetry import live
+
+        live.DEVMEM.release(live.OWNER_SESSIONS, self.session_id)
 
     def _ensure_resident(self) -> None:
         if self.closed:
@@ -552,6 +576,7 @@ class ReplaySession:
         self._prog = None
         self._world = None
         self._controllers = None
+        self._release_devmem()
         _session_metrics()[5].inc()  # evictions_total
 
     # -- settling ----------------------------------------------------------
@@ -572,6 +597,7 @@ class ReplaySession:
         world.active = old_world.active.copy()
         self._prog = prog
         self._world = world
+        self._register_devmem()  # same key: replaces the old estimate
 
     def _settle(self, ev: TraceEvent,
                 journal_event: Optional[Dict[str, Any]] = None
@@ -907,6 +933,7 @@ class ReplaySession:
         self._prog = None
         self._world = None
         self._controllers = None
+        self._release_devmem()
         return {"session_id": self.session_id, "closed": True,
                 "steps": len(self.rows), "digest": self.digest}
 

@@ -81,6 +81,7 @@ import logging
 import os
 import re
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple, TypeVar
 
@@ -585,6 +586,7 @@ def run_launch(fn: str, launch: Callable[[], T], *, retries: int = 2,
     Unclassified exceptions and ``SimulationError``\\ s (cancellation
     included) pass through untouched."""
     from open_simulator_tpu.resilience.retry import run_with_retries
+    from open_simulator_tpu.telemetry import live
     from open_simulator_tpu.telemetry.context import BLACKBOX
 
     # attempt numbers in the flight recorder: a retried transient shows
@@ -597,7 +599,16 @@ def run_launch(fn: str, launch: Callable[[], T], *, retries: int = 2,
         counter["n"] = n + 1
         BLACKBOX.record("attempt", fn=fn, attempt=n)
         maybe_inject(fn)
-        return launch()
+        # the devmem ledger accounts this launch's transfers/scratch for
+        # its duration, and only a launch that RETURNS observes into
+        # simon_launch_seconds — the histogram is device run time of
+        # completed work (callers block inside `launch`), not the cost
+        # of faults (those are counted by code, not timed)
+        with live.DEVMEM.inflight(fn):
+            t0 = time.perf_counter()
+            out = launch()
+        live.observe_launch(fn, time.perf_counter() - t0)
+        return out
 
     try:
         return run_with_retries(

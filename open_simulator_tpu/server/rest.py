@@ -209,6 +209,7 @@ _KNOWN_PATHS = frozenset({
     "/api/explain", "/api/deploy-apps", "/api/scale-apps", "/api/chaos",
     "/api/capacity", "/api/simulate", "/api/campaign", "/api/replay",
     "/api/runs", "/api/trace", "/api/session", "/api/tune",
+    "/api/events",
 })
 
 
@@ -250,7 +251,13 @@ class SimulationServer:
                  drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S,
                  max_sessions: int = DEFAULT_MAX_SESSIONS,
                  max_resident_bytes: int = serving.DEFAULT_MAX_RESIDENT_BYTES,
-                 workers: int = DEFAULT_WORKERS):
+                 workers: int = DEFAULT_WORKERS,
+                 blackbox_events: Optional[int] = None):
+        from open_simulator_tpu.telemetry import context
+
+        # flight-recorder capacity (--blackbox-events / the environment);
+        # eager-validated E_SPEC — a typo fails startup, not an incident
+        context.configure_ring(blackbox_events)
         self.cluster_config = cluster_config
         # recorded API dump standing in for the reference's 10 live
         # informers (pkg/server/server.go:97-137; no cluster access here)
@@ -347,12 +354,16 @@ class SimulationServer:
         # cluster lands on the same digest); gauges drain to 0
         resident = self._snapshots.stats()
         self._snapshots.drop_all()
-        from open_simulator_tpu.telemetry import context, ledger
+        from open_simulator_tpu.telemetry import context, ledger, live
 
         # the black box auto-dumps on drain: the flight recorder's last
         # word lands in run history beside the drain record
         context.BLACKBOX.record("drain", clean=bool(clean))
         context.dump_to_ledger(None, "drain")
+        # close every live event-feed stream AFTER the drain event above
+        # (subscribers see it as their last event) and BEFORE the ledger
+        # row below — the SSE handler threads unblock and return
+        live.FEED.close_all()
         run_id = ledger.append_event(
             "server:drain",
             tags={"requests": self._stats["requests"],
@@ -361,6 +372,7 @@ class SimulationServer:
                   "drained_clean": bool(clean),
                   "resident_snapshots": resident["entries"],
                   "resident_bytes": resident["resident_bytes"],
+                  "blackbox_dropped": context.BLACKBOX.stats()["dropped"],
                   **session_info,
                   **self._queue.stats()},
             wall_s=time.monotonic() - t0)
@@ -375,7 +387,7 @@ class SimulationServer:
 
         import jax
 
-        from open_simulator_tpu.telemetry import context
+        from open_simulator_tpu.telemetry import context, live
         from open_simulator_tpu.telemetry.spans import RECORDER
 
         ru = resource.getrusage(resource.RUSAGE_SELF)
@@ -393,6 +405,12 @@ class SimulationServer:
             # the black-box ring's fill/drop state
             "spans_dropped": RECORDER.dropped,
             "blackbox": context.BLACKBOX.stats(),
+            # live-ops surface (§21): who holds device bytes (owners +
+            # watermarks + in-flight launches), the event feed's fan-out
+            # state, and per-fn launch run-time summaries
+            "devmem": live.DEVMEM.stats(),
+            "events_feed": live.FEED.stats(),
+            "launches": live.launch_stats(),
         }
 
     def toggle_profile(self, trace_dir: str = "") -> Dict[str, Any]:
@@ -1006,6 +1024,91 @@ def _make_handler(server: SimulationServer):
             self._send_raw(code, json.dumps(payload).encode(),
                            "application/json", headers=headers)
 
+        def _stream_events(self):
+            """GET /api/events: the live-ops stream (ARCHITECTURE.md
+            §21) as server-sent events over the black-box feed — a
+            bounded replay of the newest ring events (?replay=N,
+            default 64), then with ?follow=1 live events as they
+            record, until the client disconnects or drain closes every
+            subscriber. ?queue=N bounds THIS subscriber's queue
+            (clamped to [1, 8192]) — smaller means lossier under
+            bursts, which the smoke uses to prove drops never stall. Runs on this connection's own handler thread
+            (GETs never enter the admission queue) reading from ITS
+            bounded subscription queue — a slow client only ever loses
+            its own events, never anyone's requests."""
+            from urllib.parse import parse_qs, urlparse
+
+            from open_simulator_tpu.telemetry import context, live
+
+            q = parse_qs(urlparse(self.path).query)
+            follow = (q.get("follow") or ["0"])[0] \
+                not in ("", "0", "false", "no")
+            try:
+                replay_n = int((q.get("replay") or ["64"])[0])
+            except ValueError:
+                replay_n = 64
+            try:
+                queue_n = int((q.get("queue")
+                               or [str(live.DEFAULT_SUBSCRIBER_QUEUE)])[0])
+            except ValueError:
+                queue_n = live.DEFAULT_SUBSCRIBER_QUEUE
+            queue_n = max(1, min(queue_n, 8192))
+
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            trace = getattr(self, "_trace", None)
+            if trace:
+                self.send_header("X-Simon-Trace-Id", trace)
+            # no Content-Length: the stream ends when the connection does
+            self.send_header("Connection", "close")
+            self.end_headers()
+
+            def emit(ev):
+                data = dict(ev)
+                t = data.pop("t", None)
+                if t is not None:
+                    data["t_mono"] = round(float(t), 6)
+                data["traces"] = list(data.get("traces") or ())
+                body = json.dumps(data, default=str)
+                self.wfile.write(
+                    f"event: {data.get('kind', 'event')}\n"
+                    f"data: {body}\n\n".encode())
+                self.wfile.flush()
+
+            sub = None
+            try:
+                for ev in context.BLACKBOX.tail(replay_n):
+                    emit(ev)
+                if follow:
+                    sub = live.FEED.subscribe(maxsize=queue_n)
+                    while not sub.closed.is_set():
+                        ev = sub.get(timeout=0.5)
+                        if ev is None:
+                            if sub.closed.is_set():
+                                break  # drain closed the feed
+                            # idle: a comment line keeps proxies and the
+                            # client's read loop alive without an event
+                            self.wfile.write(b": keepalive\n\n")
+                            self.wfile.flush()
+                            continue
+                        emit(ev)
+                    # events queued before close still belong to this
+                    # stream — flush them so the final `drain` record is
+                    # the follower's last frame, not a casualty of the
+                    # close racing the loop's own closed-check
+                    while True:
+                        ev = sub.get(timeout=0.05)
+                        if ev is None:
+                            break
+                        emit(ev)
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass  # the client went away — the normal SSE ending
+            finally:
+                if sub is not None:
+                    live.FEED.unsubscribe(sub)
+                self._account(200)
+
         def do_GET(self):
             from open_simulator_tpu.telemetry import context
 
@@ -1140,6 +1243,9 @@ def _make_handler(server: SimulationServer):
                     server._stats["errors"] += 1
                     err = _internal(e)
                     self._send(_status_for(err), _err_payload(err))
+            elif self.path == "/api/events" \
+                    or self.path.startswith("/api/events?"):
+                self._stream_events()
             elif self.path == "/debug/stats":
                 # profiling surface, the gin pprof analog
                 # (/root/reference/pkg/server/server.go:148-152): process +
@@ -1516,7 +1622,8 @@ def serve(address: str = "127.0.0.1", port: int = 8899, cluster_config: str = ""
           drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S,
           max_sessions: int = DEFAULT_MAX_SESSIONS,
           max_resident_bytes: int = serving.DEFAULT_MAX_RESIDENT_BYTES,
-          workers: int = DEFAULT_WORKERS) -> int:
+          workers: int = DEFAULT_WORKERS,
+          blackbox_events: Optional[int] = None) -> int:
     if kubeconfig:
         # validate up front so a real kubeconfig fails fast with the
         # record-a-dump recipe instead of 500s per request
@@ -1533,7 +1640,8 @@ def serve(address: str = "127.0.0.1", port: int = 8899, cluster_config: str = ""
                                   drain_timeout_s=drain_timeout_s,
                                   max_sessions=max_sessions,
                                   max_resident_bytes=max_resident_bytes,
-                                  workers=workers)
+                                  workers=workers,
+                                  blackbox_events=blackbox_events)
     httpd = ThreadingHTTPServer((address, port), _make_handler(sim_server))
 
     def _drain_and_stop(signame: str) -> None:

@@ -262,6 +262,22 @@ class ResidentSnapshotCache:
             bytes_g.set(sum(e.device_bytes for e in self._entries.values()))
             entries_g.set(len(self._entries))
 
+    def _devmem_key(self, digest: str) -> str:
+        # instance-scoped: two servers in one test process may cache the
+        # same digest; their ledger entries must not alias
+        return f"{id(self):x}:{digest[:12]}"
+
+    def _devmem_register(self, digest: str, nbytes: int) -> None:
+        from open_simulator_tpu.telemetry import live
+
+        live.DEVMEM.register(live.OWNER_RESIDENT,
+                             self._devmem_key(digest), nbytes)
+
+    def _devmem_release(self, digest: str) -> None:
+        from open_simulator_tpu.telemetry import live
+
+        live.DEVMEM.release(live.OWNER_RESIDENT, self._devmem_key(digest))
+
     def stats(self) -> Dict[str, Any]:
         with self._guard:
             entries = list(self._entries.values())
@@ -299,6 +315,8 @@ class ResidentSnapshotCache:
                 dropped.append(old)
         for old in dropped:
             old.dev = None
+            old.device_bytes = 0
+            self._devmem_release(old.digest)
             events.labels(event="drop").inc()
             _blackbox().record("eviction", site="resident_lru",
                                digest=old.digest[:12])
@@ -363,6 +381,7 @@ class ResidentSnapshotCache:
             entry.dev = dev
             entry.device_bytes = int(nbytes)
             entry.last_touch = time.monotonic()
+            self._devmem_register(entry.digest, int(nbytes))
         self.evict_overflow(keep=entry.digest)
         self._gauges()
         return dev
@@ -391,6 +410,7 @@ class ResidentSnapshotCache:
                 if got:
                     entry.dev = None
                     entry.device_bytes = 0
+                    self._devmem_release(victim)
                     events.labels(event="eviction").inc()
                     _blackbox().record("eviction", site="resident_bytes",
                                        digest=victim[:12])
@@ -414,6 +434,7 @@ class ResidentSnapshotCache:
                 if got and e.resident:
                     e.dev = None
                     e.device_bytes = 0
+                    self._devmem_release(e.digest)
                     events.labels(event="eviction").inc()
                     dropped += 1
         _blackbox().record("eviction", site="resident_drop_device",
@@ -424,10 +445,13 @@ class ResidentSnapshotCache:
     def drop_all(self) -> None:
         """Release every entry (drain/tests); gauges drain to 0."""
         with self._guard:
-            for e in self._entries.values():
+            dropped = list(self._entries.values())
+            for e in dropped:
                 e.dev = None
                 e.device_bytes = 0
             self._entries.clear()
+        for e in dropped:
+            self._devmem_release(e.digest)
         self._gauges()
 
 

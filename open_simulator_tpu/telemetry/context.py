@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import os
 import re
 import threading
 import time
@@ -123,6 +124,10 @@ def trace_scope(trace: TraceLike) -> Iterator[Optional[str]]:
 
 DEFAULT_RING_SIZE = 4096
 
+# ring capacity override (validated in configure_ring; the server's
+# --blackbox-events flag wins over the environment)
+BLACKBOX_EVENTS_ENV = "SIMON_BLACKBOX_EVENTS"
+
 
 def _metrics():
     from open_simulator_tpu.telemetry import counter
@@ -153,6 +158,20 @@ class BlackBox:
         self._lock = threading.Lock()
         self._recorded = 0
         self._dropped = 0
+        # live-feed fan-out (telemetry/live.py attaches while SSE
+        # subscribers exist); called OUTSIDE the ring lock, exceptions
+        # swallowed — a listener can never fail or deadlock a request
+        self._listeners: List[Any] = []
+
+    def add_listener(self, fn) -> None:
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
 
     def record(self, kind: str, trace: TraceLike = None,
                **fields: Any) -> Dict[str, Any]:
@@ -174,7 +193,35 @@ class BlackBox:
                 self._dropped += 1
             self._events.append(ev)
             self._recorded += 1
+            listeners = tuple(self._listeners)
+        for fn in listeners:
+            try:
+                fn(ev)
+            except Exception:  # noqa: BLE001 — a listener never fails a request
+                pass
         return ev
+
+    def tail(self, n: int) -> List[Dict[str, Any]]:
+        """The newest ``n`` events, oldest first — the SSE replay
+        prefix a new /api/events subscriber catches up from."""
+        n = max(0, int(n))
+        with self._lock:
+            if n == 0:
+                return []
+            return [dict(e) for e in
+                    list(self._events)[max(0, len(self._events) - n):]]
+
+    def resize(self, maxlen: int) -> None:
+        """Re-bound the ring, keeping the NEWEST events (the crash
+        narrative); anything shed by a shrink counts as dropped."""
+        maxlen = int(maxlen)
+        if maxlen <= 0:
+            raise ValueError("ring size must be positive")
+        with self._lock:
+            shed = max(0, len(self._events) - maxlen)
+            self._events = deque(self._events, maxlen=maxlen)
+            self.maxlen = maxlen
+            self._dropped += shed
 
     def events_for(self, trace_id: str) -> List[Dict[str, Any]]:
         """Every ring event tagged with the trace (membership match:
@@ -216,6 +263,30 @@ class BlackBox:
 
 
 BLACKBOX = BlackBox()
+
+
+def configure_ring(value: Optional[Union[int, str]] = None) -> int:
+    """Resize the flight recorder from ``--blackbox-events`` or the
+    ``SIMON_BLACKBOX_EVENTS`` environment (flag wins; neither set leaves
+    the ring alone). Validated EAGERLY to a structured E_SPEC — a typo'd
+    size fails server startup, not the first overloaded incident."""
+    raw = value if value is not None else os.environ.get(BLACKBOX_EVENTS_ENV)
+    if raw is None or (isinstance(raw, str) and not raw.strip()):
+        return BLACKBOX.maxlen
+    from open_simulator_tpu.errors import SimulationError
+
+    try:
+        size = int(str(raw).strip())
+        if size <= 0:
+            raise ValueError
+    except ValueError:
+        raise SimulationError(
+            f"blackbox ring size must be a positive integer, got {raw!r}",
+            code="E_SPEC", field="blackbox_events",
+            hint=f"--blackbox-events N / {BLACKBOX_EVENTS_ENV}=N, N >= 1",
+        ) from None
+    BLACKBOX.resize(size)
+    return size
 
 
 # ---- timeline reconstruction --------------------------------------------

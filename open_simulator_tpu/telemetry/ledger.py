@@ -524,6 +524,14 @@ class Ledger:
                     f.write(line)
 
         faults.run_io("ledger_append", write)
+        # witness the append in the flight recorder (and through it the
+        # live event feed) — AFTER the durable write, so a failed append
+        # raises without a phantom event; record() never writes the
+        # ledger back, so there is no recursion
+        from open_simulator_tpu.telemetry.context import BLACKBOX
+
+        BLACKBOX.record("ledger", surface=record.get("surface"),
+                        run_id=record.get("run_id"))
 
     def records(self, surface: Optional[str] = None,
                 limit: Optional[int] = None) -> List[Dict[str, Any]]:

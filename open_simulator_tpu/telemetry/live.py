@@ -1,0 +1,455 @@
+"""Live operations telemetry: devmem ledger + streaming event feed.
+
+The stack could *reconstruct* what happened (the run ledger, the §20
+trace timelines) but not *watch* it happen, and device-memory pressure —
+the force behind the resident-cache LRU and the ``cache_drop`` /
+``resident_drop`` fault rungs — was visible only as a jax-wide
+``memory_stats`` gauge with no attribution to who holds the bytes. Two
+pieces close that (ARCHITECTURE.md §21):
+
+**Device-memory ledger** (``DEVMEM``) — every device-resident holder
+registers its bytes under an owner category:
+
+  ================== =====================================================
+  owner              registrant
+  ================== =====================================================
+  resident_snapshots ``ResidentSnapshotCache`` entries (server/serving.py)
+  sessions           resident digital-twin sessions (replay/session.py)
+  executables        AOT-compiled programs (engine/exec_cache.py)
+  carry_batches      donated scan-carry batches while a launch owns them
+  inflight_launch    transfers/scratch of a launch inside the fault domain
+  ================== =====================================================
+
+The ledger exposes ``simon_devmem_bytes{owner}`` and per-owner
+high-watermarks (``simon_devmem_peak_bytes{owner}``), and ``reconcile()``
+compares the registered total against the bytes ``jax.live_arrays()``
+actually holds — unattributed bytes beyond the tolerance flag a leak
+(a device array somebody forgot to release). Registration is a dict
+write under one lock; holders that only *estimate* their bytes (an
+executable's code size, a session's encoded universe) err on the
+registered side, which can only mask in the harmless direction
+(registered >= live never flags).
+
+**Event feed** (``FEED``) — fan-out of the black-box flight recorder
+(every ``BLACKBOX.record`` — queue transitions, launches, rungs,
+journal/ledger appends, responses) to per-subscriber bounded queues.
+``GET /api/events?follow=1`` serves it as SSE. Publishing NEVER blocks
+the worker: a slow subscriber's full queue drops the event and counts it
+(``simon_events_dropped_total``); drain closes every subscriber so the
+server can exit. The listener attaches to the ring only while
+subscribers exist — an unwatched server pays nothing.
+
+``simon_launch_seconds{fn}`` is the per-launch device-run-time histogram
+the fault domain records around every ``launch()`` (distinct from the
+compile-time cost estimates exec_cache harvests); ``simon-tpu top``
+renders its percentiles.
+
+Everything here is HOST machinery (dicts, locks, queues) — nothing runs
+inside jit/scan scope (graftlint GL4).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from open_simulator_tpu.telemetry import registry as _registry
+
+# owner categories (a fixed vocabulary keeps the gauge family bounded)
+OWNER_RESIDENT = "resident_snapshots"
+OWNER_SESSIONS = "sessions"
+OWNER_EXECUTABLES = "executables"
+OWNER_CARRIES = "carry_batches"
+OWNER_INFLIGHT = "inflight_launch"
+
+# default per-subscriber queue bound: deep enough for a bursty coalesced
+# launch, small enough that one stuck reader caps at a few hundred dicts
+DEFAULT_SUBSCRIBER_QUEUE = 512
+
+# reconcile tolerance: jax always holds a few small transient arrays
+# (weakrefs mid-collection, constants) that no owner can claim
+DEFAULT_TOLERANCE_BYTES = 1 << 20
+
+
+def _metrics():
+    return (
+        _registry.counter(
+            "simon_events_published_total",
+            "black-box events fanned out to live event-feed subscribers"),
+        _registry.counter(
+            "simon_events_dropped_total",
+            "events dropped at a slow subscriber's full queue (the feed "
+            "never blocks the worker)"),
+        _registry.gauge(
+            "simon_events_subscribers",
+            "live event-feed subscribers (GET /api/events?follow=1)"),
+    )
+
+
+def launch_histogram() -> _registry.Histogram:
+    """The per-launch device-run-time histogram the fault domain feeds
+    (faults.run_launch times the ``launch()`` call itself — the device
+    executing, not compiling)."""
+    return _registry.histogram(
+        "simon_launch_seconds",
+        "device run time per completed launch inside the fault domain, "
+        "by launch fn (compile time excluded — see simon_exec_cost_*)",
+        labelnames=("fn",))
+
+
+def observe_launch(fn: str, seconds: float) -> None:
+    try:
+        launch_histogram().labels(fn=fn).observe(float(seconds))
+    except Exception:  # noqa: BLE001 — telemetry must never fail a launch
+        pass
+
+
+def launch_stats() -> Dict[str, Dict[str, float]]:
+    """{fn: {count, sum_s, mean_ms}} read back from the histogram — the
+    /debug/stats section `simon-tpu top` falls back on when it cannot
+    scrape bucket lines."""
+    hist = launch_histogram()
+    out: Dict[str, Dict[str, float]] = {}
+    with hist._lock:
+        children = {k: (c.count, c.sum) for k, c in hist._children.items()}
+    for key, (count, total) in sorted(children.items()):
+        fn = key[0] if key else ""
+        out[fn] = {
+            "count": int(count),
+            "sum_s": round(float(total), 6),
+            "mean_ms": round(1000.0 * total / count, 3) if count else 0.0,
+        }
+    return out
+
+
+# ---- the device-memory ledger -------------------------------------------
+
+
+class DeviceMemLedger:
+    """Thread-safe per-owner device-byte accounting with high-watermarks.
+
+    ``register`` upserts (owner, key) -> nbytes; ``release`` forgets it.
+    Totals and per-owner peaks are maintained under one lock so the
+    ``simon_devmem_bytes{owner}`` gauge callbacks and the reconciliation
+    pass read a consistent snapshot. Keys are holder identities (a
+    snapshot digest, a session id, an executable-key digest) so
+    re-registration on update replaces rather than double-counts.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple[str, str], int] = {}
+        self._peaks: Dict[str, int] = {}
+        self._peak_total = 0
+        # in-flight launch metadata (trace + start) for `simon-tpu top`
+        self._inflight: Dict[str, Dict[str, Any]] = {}
+        self._seq = itertools.count()
+        self._estimator: Optional[Callable[[str], Optional[float]]] = None
+
+    # -- registration ------------------------------------------------------
+
+    def register(self, owner: str, key: str, nbytes: int) -> int:
+        """Upsert one holder's bytes. Returns the registered size."""
+        nbytes = max(0, int(nbytes))
+        _install_gauges()
+        with self._lock:
+            self._entries[(owner, str(key))] = nbytes
+            total = 0
+            by_owner: Dict[str, int] = {}
+            for (o, _), b in self._entries.items():
+                by_owner[o] = by_owner.get(o, 0) + b
+                total += b
+            cur = by_owner.get(owner, 0)
+            if cur > self._peaks.get(owner, 0):
+                self._peaks[owner] = cur
+            if total > self._peak_total:
+                self._peak_total = total
+        return nbytes
+
+    def release(self, owner: str, key: str) -> int:
+        """Forget one holder. Returns the bytes released (0 if unknown)."""
+        with self._lock:
+            return self._entries.pop((owner, str(key)), 0)
+
+    def release_owner(self, owner: str) -> int:
+        """Forget every holder of one owner (cache clear / drain)."""
+        with self._lock:
+            victims = [k for k in self._entries if k[0] == owner]
+            freed = sum(self._entries.pop(k) for k in victims)
+        return freed
+
+    # -- in-flight launches ------------------------------------------------
+
+    def set_inflight_estimator(
+            self, fn: Optional[Callable[[str], Optional[float]]]) -> None:
+        """Bytes estimate for an in-flight launch of a given fn — the
+        exec cache registers its peak-HBM cost snapshot here (a hook, not
+        an import: telemetry must not depend on the engine layer)."""
+        self._estimator = fn
+
+    @contextlib.contextmanager
+    def inflight(self, fn: str,
+                 nbytes: Optional[int] = None) -> Iterator[None]:
+        """Account one launch's transfers/scratch for its duration. Bytes
+        come from the explicit argument or the estimator (0 when neither
+        knows — the entry still witnesses the launch for `top`)."""
+        if nbytes is None and self._estimator is not None:
+            try:
+                est = self._estimator(fn)
+                nbytes = int(est) if est else 0
+            except Exception:  # noqa: BLE001 — estimate only, never fail
+                nbytes = 0
+        from open_simulator_tpu.telemetry import context
+
+        key = f"{fn}#{next(self._seq)}"
+        self.register(OWNER_INFLIGHT, key, nbytes or 0)
+        with self._lock:
+            self._inflight[key] = {"fn": fn,
+                                   "trace": context.current_trace(),
+                                   "t0": time.monotonic()}
+        try:
+            yield
+        finally:
+            self.release(OWNER_INFLIGHT, key)
+            with self._lock:
+                self._inflight.pop(key, None)
+
+    def inflight_entries(self) -> List[Dict[str, Any]]:
+        now = time.monotonic()
+        with self._lock:
+            rows = [dict(v) for v in self._inflight.values()]
+        for r in rows:
+            r["age_ms"] = round((now - r.pop("t0")) * 1000.0, 3)
+        return rows
+
+    # -- reads -------------------------------------------------------------
+
+    def totals(self) -> Dict[str, int]:
+        with self._lock:
+            out: Dict[str, int] = {}
+            for (o, _), b in self._entries.items():
+                out[o] = out.get(o, 0) + b
+        return out
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(self._entries.values())
+
+    def peaks(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._peaks)
+
+    def peak_total(self) -> int:
+        with self._lock:
+            return self._peak_total
+
+    def stats(self) -> Dict[str, Any]:
+        """The /debug/stats section: owners, watermarks, in-flight."""
+        return {"owners": self.totals(), "total": self.total(),
+                "peaks": self.peaks(), "peak_total": self.peak_total(),
+                "inflight": self.inflight_entries()}
+
+    def reset(self) -> None:
+        """Test hook: forget everything, watermarks included."""
+        with self._lock:
+            self._entries.clear()
+            self._peaks.clear()
+            self._peak_total = 0
+            self._inflight.clear()
+
+    # -- reconciliation ----------------------------------------------------
+
+    def reconcile(self,
+                  tolerance_bytes: int = DEFAULT_TOLERANCE_BYTES
+                  ) -> Dict[str, Any]:
+        """Compare registered bytes against what jax actually holds.
+
+        ``jax.live_arrays()`` is ground truth for device-array bytes;
+        owners whose estimates cover non-array state (executable code,
+        encoded-universe projections) may legitimately exceed it.
+        ``unattributed_bytes`` — live bytes beyond every registration —
+        is the leak signal: a device array nobody registered. Flagged
+        past the tolerance (jax always holds a few transient arrays)."""
+        live_bytes = 0
+        live_count = 0
+        per_device: Dict[str, int] = {}
+        try:
+            import jax
+
+            for a in jax.live_arrays():
+                n = int(getattr(a, "nbytes", 0) or 0)
+                live_bytes += n
+                live_count += 1
+                try:
+                    dev = str(next(iter(a.devices())))
+                except Exception:  # noqa: BLE001 — deleted/donated array
+                    dev = "?"
+                per_device[dev] = per_device.get(dev, 0) + n
+        except Exception:  # noqa: BLE001 — no jax runtime: host-only truth
+            pass
+        registered = self.total()
+        unattributed = max(0, live_bytes - registered)
+        return {
+            "registered_bytes": registered,
+            "owners": self.totals(),
+            "live_bytes": live_bytes,
+            "live_arrays": live_count,
+            "live_bytes_by_device": per_device,
+            "unattributed_bytes": unattributed,
+            "tolerance_bytes": int(tolerance_bytes),
+            "leak_suspected": unattributed > int(tolerance_bytes),
+        }
+
+DEVMEM = DeviceMemLedger()
+
+_gauges_installed = False
+
+
+def _install_gauges() -> None:
+    """Bind the callback gauges once, lazily, to the PROCESS ledger
+    (``DEVMEM``) — never to a transient instance: a test's throwaway
+    ``DeviceMemLedger()`` must not steal the callbacks, and a process
+    that never registers device memory never touches the registry."""
+    global _gauges_installed
+    if _gauges_installed:
+        return
+    _gauges_installed = True
+
+    def current() -> Dict[Tuple[str, ...], float]:
+        return {(o,): float(b) for o, b in DEVMEM.totals().items()}
+
+    def peaks() -> Dict[Tuple[str, ...], float]:
+        return {(o,): float(b) for o, b in DEVMEM.peaks().items()}
+
+    _registry.gauge(
+        "simon_devmem_bytes",
+        "device-resident bytes by registered owner (resident "
+        "snapshots, sessions, executables, carry batches, in-flight "
+        "launches)", labelnames=("owner",)).set_callback(current)
+    _registry.gauge(
+        "simon_devmem_peak_bytes",
+        "high-watermark of device-resident bytes per owner since "
+        "process start", labelnames=("owner",)).set_callback(peaks)
+
+
+def set_inflight_estimator(fn) -> None:
+    DEVMEM.set_inflight_estimator(fn)
+
+
+# ---- the event feed ------------------------------------------------------
+
+
+class Subscription:
+    """One subscriber's bounded queue. ``get`` returns the next event
+    dict or None on timeout; ``closed`` is set by drain (or unsubscribe),
+    after which the reader should stop. The publisher NEVER blocks on
+    this queue — overflow drops the event and counts it."""
+
+    def __init__(self, maxsize: int):
+        self.q: "queue.Queue[Optional[Dict[str, Any]]]" = \
+            queue.Queue(maxsize=max(1, int(maxsize)))
+        self.dropped = 0
+        self.closed = threading.Event()
+
+    def get(self, timeout: float = 0.5) -> Optional[Dict[str, Any]]:
+        try:
+            return self.q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def close(self) -> None:
+        self.closed.set()
+        try:
+            # wake a blocked reader; a full queue needs no wake — the
+            # reader is behind and will see `closed` on its next loop
+            self.q.put_nowait(None)
+        except queue.Full:
+            pass
+
+
+class EventFeed:
+    """Fan-out of black-box events to bounded per-subscriber queues.
+
+    The ring listener attaches on the first subscriber and detaches with
+    the last, so an unwatched server's record() hot path never calls out.
+    ``publish`` is drop-on-full per subscriber — one stalled SSE client
+    loses ITS events (counted), every other consumer and the worker
+    thread proceed untouched."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs: List[Subscription] = []
+        self._attached = False
+
+    def _on_event(self, ev: Dict[str, Any]) -> None:
+        self.publish(ev)
+
+    def subscribe(self,
+                  maxsize: int = DEFAULT_SUBSCRIBER_QUEUE) -> Subscription:
+        from open_simulator_tpu.telemetry import context
+
+        sub = Subscription(maxsize)
+        with self._lock:
+            self._subs.append(sub)
+            if not self._attached:
+                context.BLACKBOX.add_listener(self._on_event)
+                self._attached = True
+        return sub
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        from open_simulator_tpu.telemetry import context
+
+        sub.close()
+        with self._lock:
+            if sub in self._subs:
+                self._subs.remove(sub)
+            if not self._subs and self._attached:
+                context.BLACKBOX.remove_listener(self._on_event)
+                self._attached = False
+
+    def publish(self, ev: Dict[str, Any]) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        if not subs:
+            return
+        published, dropped, _ = _metrics()
+        published.inc()
+        for sub in subs:
+            if sub.closed.is_set():
+                continue
+            try:
+                sub.q.put_nowait(ev)
+            except queue.Full:
+                sub.dropped += 1
+                dropped.inc()
+
+    def close_all(self) -> None:
+        """Drain hook: close every subscriber (their streams end, their
+        handler threads return) and detach from the ring."""
+        from open_simulator_tpu.telemetry import context
+
+        with self._lock:
+            subs = list(self._subs)
+            self._subs.clear()
+            if self._attached:
+                context.BLACKBOX.remove_listener(self._on_event)
+                self._attached = False
+        for sub in subs:
+            sub.close()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            subs = list(self._subs)
+        published, dropped, subscribers = _metrics()
+        subscribers.set(len(subs))
+        return {"subscribers": len(subs),
+                "published": int(published.value()),
+                "dropped": int(dropped.value()),
+                "subscriber_dropped": sum(s.dropped for s in subs)}
+
+
+FEED = EventFeed()

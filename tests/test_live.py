@@ -1,0 +1,517 @@
+"""Live operations telemetry: the devmem ledger, the streaming event
+feed, and the launch histogram (telemetry/live.py, ARCHITECTURE.md
+section 21).
+
+Covers:
+* DeviceMemLedger upsert/release semantics, per-owner totals and
+  high-watermarks, in-flight launch accounting via the estimator hook;
+* reconcile(): a deliberately planted UNREGISTERED device array trips
+  the leak flag; registering it clears the flag;
+* the event feed: bounded per-subscriber queues where a slow consumer
+  drops (counted) and never blocks publish; listener attach/detach on
+  the black-box ring; close_all ends every subscriber;
+* black-box listener fan-out outside the ring lock (exceptions
+  swallowed), tail(), resize() keeping the newest events;
+* configure_ring: flag/env validation into a structured E_SPEC error;
+* telemetry/runtime.py device-memory gauge, BOTH branches: allocator
+  memory_stats where the backend has them, summed live-array nbytes
+  (stat=live_nbytes) where it does not;
+* faults.run_launch observing simon_launch_seconds and witnessing the
+  in-flight entry only for the launch's duration;
+* multi-worker HTTP: concurrent traced launches on workers=2 land in
+  the histogram and the devmem/debug sections without clobbering; the
+  SSE stream (/api/events) shows the same causal kinds the
+  /api/trace/<id> timeline reconstructs, and drain closes followers.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from http.server import ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+
+from open_simulator_tpu.errors import SimulationError
+from open_simulator_tpu.resilience import faults
+from open_simulator_tpu.telemetry import context, live
+from open_simulator_tpu.telemetry import runtime as tel_runtime
+
+
+# ---- the devmem ledger ----------------------------------------------------
+
+
+def test_ledger_register_release_totals_and_peaks():
+    led = live.DeviceMemLedger()
+    led.register("sessions", "s1", 100)
+    led.register("sessions", "s2", 50)
+    led.register("executables", "e1", 7)
+    assert led.totals() == {"sessions": 150, "executables": 7}
+    assert led.total() == 157
+    # upsert replaces, never double-counts
+    led.register("sessions", "s1", 10)
+    assert led.totals()["sessions"] == 60
+    # peaks remember the high-watermark, not the current value
+    assert led.peaks()["sessions"] == 150
+    assert led.peak_total() == 157
+    assert led.release("sessions", "s2") == 50
+    assert led.release("sessions", "nope") == 0
+    assert led.release_owner("sessions") == 10
+    assert led.totals() == {"executables": 7}
+    st = led.stats()
+    assert st["total"] == 7 and st["peak_total"] == 157
+    assert st["inflight"] == []
+    led.reset()
+    assert led.total() == 0 and led.peak_total() == 0
+
+
+def test_ledger_negative_bytes_clamped():
+    led = live.DeviceMemLedger()
+    assert led.register("sessions", "s", -5) == 0
+    assert led.total() == 0
+
+
+def test_inflight_uses_estimator_and_releases():
+    led = live.DeviceMemLedger()
+    led.set_inflight_estimator(
+        lambda fn: 4096 if fn == "batched_schedule" else None)
+    with led.inflight("batched_schedule"):
+        assert led.totals()[live.OWNER_INFLIGHT] == 4096
+        rows = led.inflight_entries()
+        assert len(rows) == 1
+        assert rows[0]["fn"] == "batched_schedule"
+        assert rows[0]["age_ms"] >= 0
+    assert led.totals().get(live.OWNER_INFLIGHT, 0) == 0
+    assert led.inflight_entries() == []
+    # explicit bytes beat the estimator; a broken estimator is harmless
+    with led.inflight("batched_schedule", nbytes=8):
+        assert led.totals()[live.OWNER_INFLIGHT] == 8
+    led.set_inflight_estimator(lambda fn: 1 / 0)
+    with led.inflight("other"):
+        assert led.totals()[live.OWNER_INFLIGHT] == 0
+    assert led.peaks()[live.OWNER_INFLIGHT] == 4096
+
+
+def test_reconcile_flags_planted_unregistered_array():
+    led = live.DeviceMemLedger()
+    baseline = led.reconcile()["unattributed_bytes"]
+    plant = jnp.zeros((2 * 1024 * 1024,), dtype=jnp.float32)  # 8 MiB
+    plant.block_until_ready()
+    tol = baseline + (4 << 20)
+    r = led.reconcile(tolerance_bytes=tol)
+    # the planted array is live but NOBODY registered it: leak
+    assert r["unattributed_bytes"] >= baseline + (8 << 20) - (1 << 20)
+    assert r["leak_suspected"], r
+    assert r["live_arrays"] >= 1 and r["live_bytes_by_device"]
+    # owning up clears the flag at the same tolerance
+    led.register(live.OWNER_SESSIONS, "plant", int(plant.nbytes))
+    r2 = led.reconcile(tolerance_bytes=tol)
+    assert not r2["leak_suspected"], r2
+    assert r2["registered_bytes"] >= 8 << 20
+    del plant
+
+
+def test_module_ledger_gauges_render_on_registry():
+    from open_simulator_tpu.telemetry import registry
+    live.DEVMEM.register(live.OWNER_EXECUTABLES, "test-gauge-probe", 123)
+    try:
+        text = registry.REGISTRY.render_prometheus()
+        assert 'simon_devmem_bytes{owner="executables"}' in text
+        assert 'simon_devmem_peak_bytes{owner="executables"}' in text
+    finally:
+        live.DEVMEM.release(live.OWNER_EXECUTABLES, "test-gauge-probe")
+
+
+# ---- the event feed -------------------------------------------------------
+
+
+def test_feed_slow_subscriber_drops_never_blocks():
+    feed = live.EventFeed()
+    fast = feed.subscribe(maxsize=64)
+    slow = feed.subscribe(maxsize=1)
+    try:
+        t0 = time.perf_counter()
+        for i in range(10):
+            feed.publish({"kind": "launch", "seq": i})
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 0.5  # publish never blocked on the full queue
+        assert slow.dropped == 9
+        got = [fast.get(timeout=1.0)["seq"] for _ in range(10)]
+        assert got == list(range(10))  # the healthy subscriber saw all
+        assert slow.get(timeout=1.0)["seq"] == 0  # oldest kept, rest lost
+        st = feed.stats()
+        assert st["subscribers"] == 2
+        assert st["subscriber_dropped"] == 9
+    finally:
+        feed.unsubscribe(fast)
+        feed.unsubscribe(slow)
+    assert feed.stats()["subscribers"] == 0
+
+
+def test_feed_attaches_listener_only_while_subscribed():
+    feed = live.EventFeed()
+    box = context.BLACKBOX
+    base = len(box._listeners)
+    sub = feed.subscribe()
+    assert len(box._listeners) == base + 1
+    sub2 = feed.subscribe()
+    assert len(box._listeners) == base + 1  # one listener, many subs
+    feed.unsubscribe(sub)
+    assert len(box._listeners) == base + 1
+    feed.unsubscribe(sub2)
+    assert len(box._listeners) == base  # last one out detaches
+    # a ring record while subscribed lands in the queue, trace included
+    sub3 = feed.subscribe()
+    try:
+        with context.trace_scope("feed-live-1"):
+            box.record("launch", fn="x")
+        ev = sub3.get(timeout=2.0)
+        assert ev["kind"] == "launch" and "feed-live-1" in ev["traces"]
+    finally:
+        feed.unsubscribe(sub3)
+
+
+def test_feed_close_all_ends_subscribers():
+    feed = live.EventFeed()
+    subs = [feed.subscribe() for _ in range(3)]
+    feed.close_all()
+    for s in subs:
+        assert s.closed.is_set()
+        assert s.get(timeout=0.2) is None  # the wake-up sentinel
+    assert feed.stats()["subscribers"] == 0
+    # closing is idempotent and publish-after-close is a no-op
+    feed.publish({"kind": "launch"})
+    feed.close_all()
+
+
+def test_blackbox_listener_exceptions_swallowed():
+    box = context.BlackBox(maxlen=8)
+    seen = []
+
+    def bad(ev):
+        raise RuntimeError("listener bug")
+
+    def good(ev):
+        seen.append(ev["kind"])
+
+    box.add_listener(bad)
+    box.add_listener(good)
+    box.add_listener(good)  # dedup: registered once
+    box.record("enqueue")
+    box.record("launch")
+    assert seen == ["enqueue", "launch"]
+    assert box.stats()["events"] == 2  # the ring recorded despite `bad`
+    box.remove_listener(bad)
+    box.remove_listener(good)
+    box.remove_listener(good)  # second remove is a no-op
+    box.record("response")
+    assert seen == ["enqueue", "launch"]
+
+
+def test_blackbox_tail_and_resize_keep_newest():
+    box = context.BlackBox(maxlen=8)
+    for i in range(6):
+        box.record("enqueue", seq=i)
+    tail = box.tail(3)
+    assert [e["seq"] for e in tail] == [3, 4, 5]  # oldest-first window
+    assert box.tail(0) == []
+    tail[0]["seq"] = 99  # copies: mutating the tail never edits the ring
+    assert box.tail(3)[0]["seq"] == 3
+    box.resize(2)
+    st = box.stats()
+    assert st["capacity"] == 2 and st["events"] == 2
+    assert st["dropped"] == 4  # shed on shrink is honest accounting
+    assert [e["seq"] for e in box.tail(10)] == [4, 5]
+    box.resize(16)
+    assert box.stats()["capacity"] == 16
+    assert [e["seq"] for e in box.tail(10)] == [4, 5]  # grow keeps all
+    with pytest.raises(ValueError):
+        box.resize(0)
+
+
+def test_configure_ring_flag_env_and_validation(monkeypatch):
+    original = context.BLACKBOX.maxlen
+    try:
+        assert context.configure_ring(64) == 64
+        assert context.BLACKBOX.maxlen == 64
+        monkeypatch.setenv(context.BLACKBOX_EVENTS_ENV, "128")
+        assert context.configure_ring() == 128
+        monkeypatch.delenv(context.BLACKBOX_EVENTS_ENV)
+        # no flag, no env: untouched
+        assert context.configure_ring() == 128
+        assert context.configure_ring("") == 128
+        for bad in ("zero", "0", "-3", "1.5"):
+            with pytest.raises(SimulationError) as ei:
+                context.configure_ring(bad)
+            assert ei.value.code == "E_SPEC"
+            assert ei.value.field == "blackbox_events"
+    finally:
+        context.BLACKBOX.resize(original)
+
+
+# ---- the runtime device-memory gauge (both branches) ----------------------
+
+
+class _RichDevice:
+    def __str__(self):
+        return "FAKE:0"
+
+    def memory_stats(self):
+        return {"bytes_in_use": 123.0, "peak_bytes_in_use": 456.0,
+                "bytes_limit": 789.0, "irrelevant": 1.0}
+
+
+class _BlindDevice:
+    def __init__(self, name):
+        self._name = name
+
+    def __str__(self):
+        return self._name
+
+    def memory_stats(self):
+        raise RuntimeError("no allocator stats on this backend")
+
+
+def test_device_memory_stats_allocator_branch(monkeypatch):
+    monkeypatch.setattr(jax, "devices", lambda: [_RichDevice()])
+    out = tel_runtime._device_memory_stats()
+    assert out == {("FAKE:0", "bytes_in_use"): 123.0,
+                   ("FAKE:0", "peak_bytes_in_use"): 456.0,
+                   ("FAKE:0", "bytes_limit"): 789.0}
+
+
+def test_device_memory_stats_live_nbytes_fallback(monkeypatch):
+    arr = jnp.arange(1024, dtype=jnp.int32)  # 4 KiB, device-resident
+    arr.block_until_ready()
+    dev = str(next(iter(arr.devices())))
+    monkeypatch.setattr(
+        jax, "devices", lambda: [_BlindDevice(dev), _BlindDevice("GHOST:9")])
+    out = tel_runtime._device_memory_stats()
+    # the blind device reports what live arrays hold, labelled distinctly
+    assert out[(dev, "live_nbytes")] >= float(arr.nbytes)
+    # a blind device holding nothing still renders (explicit zero)
+    assert out[("GHOST:9", "live_nbytes")] == 0.0
+    assert not any(stat == "bytes_in_use" for _, stat in out)
+    del arr
+
+
+# ---- the launch histogram + in-flight witness ------------------------------
+
+
+def test_run_launch_observes_histogram_and_inflight():
+    fn = "live_test_launch"
+    before = live.launch_stats().get(fn, {"count": 0})["count"]
+    witnessed = []
+
+    def launch():
+        witnessed.append(
+            [r for r in live.DEVMEM.inflight_entries() if r["fn"] == fn])
+        time.sleep(0.01)
+        return "ok"
+
+    assert faults.run_launch(fn, launch) == "ok"
+    after = live.launch_stats()[fn]
+    assert after["count"] == before + 1
+    assert after["sum_s"] > 0 and after["mean_ms"] > 0
+    # the launch saw ITS OWN in-flight entry; it is gone afterwards
+    assert len(witnessed[0]) == 1
+    assert not [r for r in live.DEVMEM.inflight_entries()
+                if r["fn"] == fn]
+
+
+def test_run_launch_failure_not_observed():
+    fn = "live_test_launch_fail"
+
+    def boom():
+        raise RuntimeError("not a classified fault")
+
+    with pytest.raises(RuntimeError):
+        faults.run_launch(fn, boom)
+    assert fn not in live.launch_stats()
+    assert not [r for r in live.DEVMEM.inflight_entries()
+                if r["fn"] == fn]
+
+
+# ---- multi-worker HTTP: histogram, devmem sections, SSE ~ timeline --------
+
+
+CLUSTER_YAML = """
+apiVersion: v1
+kind: Node
+metadata: {name: lv0}
+status:
+  allocatable: {cpu: "8", memory: 16Gi, pods: "110"}
+---
+apiVersion: apps/v1
+kind: Deployment
+metadata: {name: app, namespace: default}
+spec:
+  replicas: 2
+  selector: {matchLabels: {app: lv}}
+  template:
+    metadata: {labels: {app: lv}}
+    spec:
+      containers:
+        - name: c
+          resources: {requests: {cpu: "1", memory: 1Gi}}
+"""
+
+
+@pytest.fixture()
+def live_server():
+    from open_simulator_tpu.server.rest import (
+        SimulationServer,
+        _make_handler,
+    )
+
+    srv = SimulationServer(workers=2)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _make_handler(srv))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", srv, \
+        httpd.server_address[1]
+    srv.begin_drain()  # closes any leftover SSE subscribers
+    httpd.shutdown()
+
+
+def _post(url, payload, trace_id=None):
+    headers = {"Content-Type": "application/json"}
+    if trace_id:
+        headers[context.TRACE_HEADER] = trace_id
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), headers=headers)
+    with urllib.request.urlopen(req, timeout=300) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=300) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_multiworker_histogram_devmem_and_sse(live_server):
+    url, srv, port = live_server
+    fn = "serving_lanes"
+    base_count = live.launch_stats().get(fn, {"count": 0})["count"]
+
+    # follow the stream BEFORE the load so every event is witnessed live
+    frames = []
+    ended = threading.Event()
+
+    def follow():
+        sock = socket.create_connection(("127.0.0.1", port), timeout=120)
+        sock.sendall((f"GET /api/events?follow=1&replay=0 HTTP/1.1\r\n"
+                      f"Host: 127.0.0.1:{port}\r\n\r\n").encode())
+        buf = b""
+        headers_done = False
+        try:
+            while True:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                if not headers_done:
+                    idx = buf.find(b"\r\n\r\n")
+                    if idx < 0:
+                        continue
+                    headers_done = True
+                    buf = buf[idx + 4:]
+                while b"\n\n" in buf:
+                    frame, buf = buf.split(b"\n\n", 1)
+                    data = [ln[6:] for ln in frame.decode().splitlines()
+                            if ln.startswith("data: ")]
+                    if data:
+                        frames.append(json.loads(data[0]))
+        except OSError:
+            pass
+        finally:
+            ended.set()
+            sock.close()
+
+    reader = threading.Thread(target=follow, daemon=True)
+    reader.start()
+    deadline = time.time() + 15
+    while live.FEED.stats()["subscribers"] < 1:
+        assert time.time() < deadline, "subscriber never attached"
+        time.sleep(0.02)
+
+    status, out = _post(url + "/api/simulate",
+                        {"cluster": {"yaml": CLUSTER_YAML}},
+                        trace_id="live-mw-warm")
+    assert status == 200
+    digest = out["snapshot_digest"]
+
+    # concurrent probes across BOTH workers
+    results = []
+    lock = threading.Lock()
+
+    def fire(i):
+        r = _post(url + "/api/simulate", {"base": digest},
+                  trace_id=f"live-mw-{i}")
+        with lock:
+            results.append(r)
+
+    threads = [threading.Thread(target=fire, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert all(s == 200 for s, _ in results), results
+
+    # histogram: every completed launch observed exactly once — two
+    # workers never clobber each other's counts (coalescing may merge
+    # probes into fewer launches, so bound both sides)
+    stats = live.launch_stats()[fn]
+    grew = stats["count"] - base_count
+    assert 1 <= grew <= 7, stats
+    code, dbg = _get(url + "/debug/stats")
+    assert code == 200
+    assert dbg["launches"][fn]["count"] == stats["count"]
+    dm = dbg["devmem"]
+    assert dm["owners"].get("resident_snapshots", 0) > 0, dm
+    assert dm["peak_total"] >= dm["total"], dm
+    assert dbg["events_feed"]["subscribers"] >= 1
+
+    # the stream saw one probe's causal kinds; the timeline agrees
+    tid = "live-mw-0"
+    deadline = time.time() + 15
+    while True:
+        mine = [f for f in frames if tid in (f.get("traces") or [])]
+        kinds = {f["kind"] for f in mine}
+        if {"enqueue", "launch", "response"} <= kinds:
+            break
+        assert time.time() < deadline, (
+            "stream never showed the causal sequence", kinds)
+        time.sleep(0.05)
+    code, tl = _get(url + f"/api/trace/{tid}")
+    assert code == 200
+    timeline_kinds = {e["kind"] for e in tl["events"]}
+    assert {k for k in kinds} <= timeline_kinds, (kinds, timeline_kinds)
+
+    # drain closes the follower; its final frame is the drain record
+    srv.begin_drain()
+    assert ended.wait(30), "stream did not end on drain"
+    assert frames and frames[-1]["kind"] == "drain", frames[-5:]
+
+
+def test_events_replay_endpoint_without_follow(live_server):
+    url, srv, _port = live_server
+    status, _ = _post(url + "/api/simulate",
+                      {"cluster": {"yaml": CLUSTER_YAML}},
+                      trace_id="live-replay-1")
+    assert status == 200
+    req = urllib.request.Request(url + "/api/events?replay=16")
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        body = resp.read().decode()
+    events = [json.loads(ln[6:]) for ln in body.splitlines()
+              if ln.startswith("data: ")]
+    assert 0 < len(events) <= 16
+    assert any("live-replay-1" in (e.get("traces") or []) for e in events)
+    assert all("t_mono" in e for e in events)

@@ -210,6 +210,15 @@ if [ "$rc" -ne 0 ]; then
 fi
 
 echo
+echo "== live operations (event stream, devmem ledger, simon-tpu top) =="
+make live-smoke
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "smoke FAILED: live-smoke exited $rc" >&2
+  exit "$rc"
+fi
+
+echo
 echo "== simon-tpu explain on the example cluster =="
 env JAX_PLATFORMS=cpu python -m open_simulator_tpu.cli explain \
   -f examples/config.yaml --top-k 2
